@@ -1,0 +1,25 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh so
+sharding/collective tests run anywhere (SURVEY.md section 4 implication b)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("VELES_BACKEND", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def cpu_device():
+    from veles_tpu.backends import Device
+    return Device(backend="cpu")
+
+
+@pytest.fixture
+def numpy_device():
+    from veles_tpu.backends import Device
+    return Device(backend="numpy")

@@ -1,0 +1,244 @@
+"""Tests for config, mutable, prng, logger, cmdline, pickling
+(reference analogs: test_config, test_mutable, test_random)."""
+
+import io
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.config import Config, root, validate_kwargs
+from veles_tpu.mutable import Bool, LinkableAttribute
+from veles_tpu import prng
+from veles_tpu.distributable import Pickleable
+
+
+class TestConfig:
+    def test_autovivify(self):
+        cfg = Config("test")
+        cfg.a.b.c = 13
+        assert cfg.a.b.c == 13
+        assert isinstance(cfg.a.x, Config)
+
+    def test_update(self):
+        cfg = Config("test")
+        cfg.update({"x": 1, "sub": {"y": 2}})
+        assert cfg.x == 1
+        assert cfg.sub.y == 2
+        cfg(sub={"z": 3})
+        assert cfg.sub.y == 2 and cfg.sub.z == 3
+
+    def test_protect(self):
+        cfg = Config("test")
+        cfg.key = 5
+        cfg.protect("key")
+        with pytest.raises(AttributeError):
+            cfg.key = 6
+        assert cfg.key == 5
+
+    def test_get_unset(self):
+        cfg = Config("test")
+        assert cfg.get("nothing", 42) == 42
+        cfg.present = 1
+        assert cfg.get("present") == 1
+
+    def test_as_dict_roundtrip(self):
+        cfg = Config("test")
+        cfg.update({"a": 1, "b": {"c": [1, 2]}})
+        restored = pickle.loads(pickle.dumps(cfg))
+        assert restored.a == 1
+        assert restored.b.c == [1, 2]
+
+    def test_root_defaults(self):
+        assert root.common.engine.get("precision_type") in (
+            "float32", "bfloat16", "float16")
+
+    def test_print(self):
+        out = io.StringIO()
+        cfg = Config("t")
+        cfg.a = 1
+        cfg.print_(out=out)
+        assert "a: 1" in out.getvalue()
+
+    def test_validate_kwargs_warns(self):
+        with pytest.warns(UserWarning):
+            validate_kwargs(object(), bad=Config("unset"))
+
+
+class TestBool:
+    def test_assign(self):
+        flag = Bool()
+        assert not flag
+        flag <<= True
+        assert flag
+
+    def test_derived_or(self):
+        a, b = Bool(False), Bool(False)
+        c = a | b
+        assert not c
+        a <<= True
+        assert c          # live: sees the operand change
+        a <<= False
+        b <<= True
+        assert c
+
+    def test_derived_and_invert_xor(self):
+        a, b = Bool(True), Bool(False)
+        assert not (a & b)
+        b <<= True
+        assert a & b
+        assert not ~a
+        assert a ^ Bool(False)
+        assert not (a ^ b)
+
+    def test_on_change(self):
+        calls = []
+        flag = Bool(False)
+        flag.on_change = calls.append
+        flag <<= True
+        flag <<= True  # no change
+        flag <<= False
+        assert len(calls) == 2
+
+    def test_pickle(self):
+        a = Bool(True)
+        b = pickle.loads(pickle.dumps(a))
+        assert bool(b)
+
+
+class _Src:
+    pass
+
+
+class _Dst:
+    pass
+
+
+class TestLinkableAttribute:
+    def test_one_way(self):
+        src, dst = _Src(), _Dst()
+        src.value = 13
+        LinkableAttribute(dst, "value", src, "value")
+        assert dst.value == 13
+        src.value = 14
+        assert dst.value == 14
+        with pytest.raises(AttributeError):
+            dst.value = 15
+
+    def test_two_way(self):
+        src, dst = _Src(), _Dst()
+        src.v = 1
+        LinkableAttribute(dst, "v", src, "v", two_way=True)
+        dst.v = 99
+        assert src.v == 99
+
+    def test_different_names(self):
+        src, dst = _Src(), _Dst()
+        src.output = "x"
+        LinkableAttribute(dst, "input", src, "output")
+        assert dst.input == "x"
+
+    def test_independent_instances(self):
+        s1, s2 = _Src(), _Src()
+        d1, d2 = _Dst(), _Dst()
+        s1.q, s2.q = 1, 2
+        LinkableAttribute(d1, "q", s1, "q")
+        LinkableAttribute(d2, "q", s2, "q")
+        assert d1.q == 1 and d2.q == 2
+
+
+class TestPrng:
+    def test_reproducible(self):
+        a = prng.RandomGenerator("t", seed=42)
+        b = prng.RandomGenerator("t", seed=42)
+        arr1 = numpy.zeros(16)
+        arr2 = numpy.zeros(16)
+        a.fill(arr1)
+        b.fill(arr2)
+        assert numpy.array_equal(arr1, arr2)
+
+    def test_state_roundtrip(self):
+        rng = prng.RandomGenerator("t", seed=7)
+        rng.uniform(size=10)
+        state = pickle.dumps(rng)
+        expected = rng.uniform(size=5)
+        restored = pickle.loads(state)
+        assert numpy.array_equal(restored.uniform(size=5), expected)
+
+    def test_registry(self):
+        assert prng.get("k1") is prng.get("k1")
+        assert prng.get("k1") is not prng.get("k2")
+
+    def test_jax_key_stream_deterministic(self):
+        a = prng.RandomGenerator("t", seed=99)
+        b = prng.RandomGenerator("t", seed=99)
+        import jax
+        k1, k2 = a.jax_key(), a.jax_key()
+        m1 = b.jax_key()
+        assert jax.numpy.array_equal(k1, m1)
+        assert not jax.numpy.array_equal(k1, k2)
+
+    def test_shuffle_deterministic(self):
+        a = prng.RandomGenerator("t", seed=5)
+        arr = numpy.arange(100)
+        a.shuffle(arr)
+        b = prng.RandomGenerator("t", seed=5)
+        arr2 = numpy.arange(100)
+        b.shuffle(arr2)
+        assert numpy.array_equal(arr, arr2)
+
+
+class _Transient(Pickleable):
+    def __init__(self):
+        super(_Transient, self).__init__()
+        self.keep = 1
+
+    def init_unpickled(self):
+        super(_Transient, self).init_unpickled()
+        self.scratch_ = "recreated"
+
+
+class TestPickleable:
+    def test_transient_excluded(self):
+        obj = _Transient()
+        obj.scratch_ = "dirty"
+        restored = pickle.loads(pickle.dumps(obj))
+        assert restored.keep == 1
+        assert restored.scratch_ == "recreated"
+
+
+class TestCmdline:
+    def test_registry_collects(self):
+        from veles_tpu.cmdline import (CommandLineBase, build_parser)
+
+        class Contributor(CommandLineBase):
+            @classmethod
+            def init_parser(cls, parser):
+                parser.add_argument("--contributed-flag", default="x")
+                return parser
+
+        parser = build_parser()
+        args = parser.parse_args(["--contributed-flag", "y"])
+        assert args.contributed_flag == "y"
+
+
+class TestLogger:
+    def test_event_file(self, tmp_path):
+        from veles_tpu import logger as vlog
+        from veles_tpu.logger import Logger, set_event_file
+        path = tmp_path / "events.jsonl"
+        set_event_file(str(path))
+        try:
+            obj = Logger()
+            obj.event("step", "begin", idx=1)
+            obj.event("step", "end", idx=1)
+            with pytest.raises(ValueError):
+                obj.event("step", "sometimes")
+        finally:
+            set_event_file(None)
+        import json
+        lines = [json.loads(line) for line in
+                 path.read_text().strip().splitlines()]
+        assert len(lines) == 2
+        assert lines[0]["kind"] == "begin"
+        assert lines[1]["idx"] == 1

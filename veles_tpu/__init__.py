@@ -1,0 +1,32 @@
+"""VELES-TPU: a TPU-native distributed deep-learning platform.
+
+A ground-up rebuild of the capabilities of Samsung VELES
+(https://github.com/devbib/veles) designed for TPUs: JAX/XLA for the
+compute path, Pallas for custom kernels, ``jax.sharding`` meshes and ICI
+collectives for scale-out, with the reference's unit/workflow graph UX,
+loaders, snapshots, services, and meta-optimization preserved on top.
+
+Quick start::
+
+    import veles_tpu
+    veles_tpu.run(MyWorkflow, config)          # like `veles wf.py cfg.py`
+
+Reference parity citations throughout the tree point at file:line in the
+upstream checkout (mounted read-only during development).
+"""
+
+__version__ = "0.1.0"
+__license__ = "Apache 2.0"
+
+from veles_tpu.config import root  # noqa: F401
+from veles_tpu.mutable import Bool, LinkableAttribute  # noqa: F401
+from veles_tpu.units import Unit, IUnit  # noqa: F401
+from veles_tpu.workflow import Workflow, NoMoreJobs  # noqa: F401
+from veles_tpu.distributable import (  # noqa: F401
+    Distributable, IDistributable, Pickleable, TriviallyDistributable)
+
+
+def run(workflow_class, config=None, **kwargs):
+    """Programmatic entry point (reference: veles/__init__.py:142)."""
+    from veles_tpu.__main__ import Main
+    return Main().run_workflow(workflow_class, config, **kwargs)

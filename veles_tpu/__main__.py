@@ -1,0 +1,179 @@
+"""CLI entry point: ``python -m veles_tpu <workflow.py> [config.py]``.
+
+TPU-native counterpart of reference veles/__main__.py:136.  This grows
+with the framework; current stages: special opts, seeding, config apply
+(python file via runpy + ``key=value`` overrides), workflow load, snapshot
+restore, run modes (standalone; master/slave once the control plane
+lands).
+"""
+
+import argparse
+import os
+import runpy
+import sys
+
+from veles_tpu import prng
+from veles_tpu.config import load_site_configs, root
+from veles_tpu.logger import set_event_file, set_file_logging, setup_logging
+
+__all__ = ["Main", "main"]
+
+
+class Main(object):
+    """Drives one training/serving session."""
+
+    EXIT_SUCCESS = 0
+    EXIT_FAILURE = 1
+
+    def init_parser(self):
+        from veles_tpu.cmdline import build_parser
+        parser = build_parser()
+        parser.add_argument("workflow", nargs="?",
+                            help="workflow python file or module")
+        parser.add_argument("config", nargs="?", default=None,
+                            help="config python file ('-' for none)")
+        parser.add_argument("overrides", nargs="*", default=[],
+                            help="config overrides: root.path.key=value")
+        parser.add_argument("-r", "--random-seed", default=None,
+                            help="seed (int, hex with 0x, or file path)")
+        parser.add_argument("-d", "--device", default=None,
+                            help="backend: tpu | cpu | numpy | auto")
+        parser.add_argument("-w", "--snapshot", default=None,
+                            help="restore from snapshot file")
+        parser.add_argument("-f", "--log-file", default=None)
+        parser.add_argument("--event-file", default=None,
+                            help="JSON-lines trace event sink")
+        parser.add_argument("-v", "--verbose", action="store_true")
+        parser.add_argument("--result-file", default=None)
+        parser.add_argument("--dry-run", choices=("load", "init"),
+                            default=None)
+        parser.add_argument("--dump-graph", default=None,
+                            help="write the graphviz dot file and exit")
+        return parser
+
+    def _seed(self, spec):
+        if spec is None:
+            return
+        if os.path.exists(spec):
+            with open(spec, "rb") as fin:
+                seed = fin.read(8)
+        else:
+            seed = int(spec, 0)
+        prng.get().seed(seed)
+        prng.get("second").seed(seed if isinstance(seed, int)
+                                else seed[::-1])
+
+    def _apply_config(self, path, overrides):
+        if path and path != "-":
+            runpy.run_path(path, init_globals={"root": root})
+        for override in overrides:
+            if "=" not in override:
+                raise ValueError("override must be key=value: %r" % override)
+            key, value = override.split("=", 1)
+            node = root
+            parts = key.split(".")
+            if parts[0] == "root":
+                parts = parts[1:]
+            for part in parts[:-1]:
+                node = getattr(node, part)
+            try:
+                import ast
+                value = ast.literal_eval(value)
+            except (ValueError, SyntaxError):
+                pass
+            setattr(node, parts[-1], value)
+
+    def _load_workflow_module(self, spec):
+        if os.path.exists(spec):
+            sys.path.insert(0, os.path.dirname(os.path.abspath(spec)))
+            name = os.path.splitext(os.path.basename(spec))[0]
+            import importlib
+            return importlib.import_module(name)
+        import importlib
+        return importlib.import_module(spec)
+
+    def run_workflow(self, workflow_class, config=None, snapshot=None,
+                     device=None, **kwargs):
+        """Programmatic run (the ``veles_tpu.run(...)`` path)."""
+        from veles_tpu.launcher import Launcher
+        if config:
+            root.update(config)
+        launcher = Launcher()
+        if snapshot:
+            from veles_tpu.snapshotter import SnapshotterBase
+            workflow = SnapshotterBase.import_file(snapshot)
+            workflow.workflow = launcher
+            workflow.restored_from_snapshot_ = True
+        else:
+            workflow = workflow_class(launcher, **kwargs)
+        launcher.initialize(device=device)
+        launcher.run()
+        return workflow
+
+    def run(self, argv=None):
+        load_site_configs()
+        parser = self.init_parser()
+        args, extra = parser.parse_known_args(argv)
+        overrides = list(args.overrides) + [
+            e for e in extra if "=" in e and not e.startswith("-")]
+        setup_logging(level=10 if args.verbose else 20)
+        if args.log_file:
+            set_file_logging(args.log_file)
+        if args.event_file:
+            set_event_file(args.event_file)
+        self._seed(args.random_seed)
+        if args.device:
+            root.common.engine.backend = args.device
+        if args.result_file:
+            root.common.result_file = args.result_file
+        if not args.workflow:
+            parser.print_help()
+            return self.EXIT_FAILURE
+        self._apply_config(args.config, overrides)
+        module = self._load_workflow_module(args.workflow)
+        if args.dry_run == "load":
+            return self.EXIT_SUCCESS
+        run_fn = getattr(module, "run", None)
+        if run_fn is None:
+            raise SystemExit(
+                "workflow file must define run(load, main)")
+        # The reference's run(load, main) protocol: load builds/restores
+        # the workflow, main initializes+runs it.
+        from veles_tpu.launcher import Launcher
+        launcher = Launcher()
+        state = {}
+
+        def load(workflow_class, **kwargs):
+            if args.snapshot:
+                from veles_tpu.snapshotter import SnapshotterBase
+                workflow = SnapshotterBase.import_file(args.snapshot)
+                workflow.workflow = launcher
+                workflow.restored_from_snapshot_ = True
+                state["workflow"] = workflow
+                return workflow, True
+            state["workflow"] = workflow_class(launcher, **kwargs)
+            return state["workflow"], False
+
+        def main(**kwargs):
+            if args.dump_graph:
+                with open(args.dump_graph, "w") as fout:
+                    fout.write(state["workflow"].generate_graph())
+                return
+            launcher.initialize(**kwargs)
+            if args.dry_run == "init":
+                return
+            launcher.run()
+
+        run_fn(load, main)
+        workflow = state.get("workflow")
+        if workflow is not None and args.result_file:
+            workflow.write_results(args.result_file)
+        return self.EXIT_SUCCESS
+
+
+def main(argv=None):
+    return Main().run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,302 @@
+"""Device backends.
+
+TPU-native counterpart of reference veles/backends.py:166,184,190-197.
+The registry/priority/auto-selection design is preserved; the devices are:
+
+- :class:`TPUDevice` — JAX on TPU.  The unit of execution is a jitted XLA
+  computation, not a hand-launched kernel; ``device`` here mostly carries
+  placement (which ``jax.Device`` / mesh), dtype policy, and the autotune
+  table for Pallas kernels.
+- :class:`CPUDevice` — JAX on host CPU.  Same code path as TPU (XLA:CPU +
+  Pallas interpreter), used for tests and as the portable fallback.
+- :class:`NumpyDevice` — pure-numpy pseudo-device, always available;
+  units run their ``numpy_*`` methods (reference: backends.py:918).
+
+Selection: ``Device(backend="tpu"|"cpu"|"numpy"|"auto")`` or the
+``VELES_BACKEND`` env var / ``root.common.engine.backend`` config.  ``auto``
+picks the highest-priority available backend (tpu 30 > cpu 20 > numpy 10),
+mirroring the reference's cuda 30 > ocl 20 > numpy 10 ladder.
+"""
+
+import json
+import os
+import threading
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.distributable import Pickleable
+
+__all__ = ["Device", "TPUDevice", "CPUDevice", "NumpyDevice",
+           "BackendRegistry"]
+
+
+class BackendRegistry(type):
+    backends = {}
+
+    def __init__(cls, name, bases, namespace):
+        super(BackendRegistry, cls).__init__(name, bases, namespace)
+        backend = namespace.get("BACKEND")
+        if backend is not None:
+            BackendRegistry.backends[backend] = cls
+
+
+class Device(Pickleable, metaclass=BackendRegistry):
+    """Base device; ``Device(backend=...)`` dispatches to a subclass."""
+
+    BACKEND = None
+    PRIORITY = 0
+
+    def __new__(cls, *args, **kwargs):
+        if cls is not Device:
+            return super(Device, cls).__new__(cls)
+        backend = kwargs.get("backend")
+        if backend is None:
+            backend = os.environ.get("VELES_BACKEND") or \
+                root.common.engine.get("backend", "auto")
+        if backend == "auto":
+            chosen = None
+            for sub in sorted(BackendRegistry.backends.values(),
+                              key=lambda c: -c.PRIORITY):
+                if sub.available():
+                    chosen = sub
+                    break
+            if chosen is None:
+                raise RuntimeError("no available backend")
+            return super(Device, chosen).__new__(chosen)
+        try:
+            sub = BackendRegistry.backends[backend]
+        except KeyError:
+            raise ValueError("unknown backend %r (known: %s)" % (
+                backend, sorted(BackendRegistry.backends)))
+        return super(Device, sub).__new__(sub)
+
+    def __init__(self, **kwargs):
+        kwargs.pop("backend", None)
+        super(Device, self).__init__(**kwargs)
+        self._computing_power = None
+
+    @classmethod
+    def available(cls):
+        return False
+
+    @property
+    def backend_name(self):
+        return self.BACKEND
+
+    @property
+    def exists(self):
+        """True when real accelerated hardware backs this device."""
+        return False
+
+    @property
+    def is_async(self):
+        """True when execution is asynchronous (needs explicit sync for
+        honest timings — the reference's --sync-run concern)."""
+        return False
+
+    def sync(self):
+        pass
+
+    def thread_pool_attach(self, pool):
+        """Per-thread attach hook (reference pushes CUDA contexts here;
+        JAX needs nothing, kept for unit-compat)."""
+
+    def thread_pool_detach(self):
+        pass
+
+    @property
+    def max_group_size(self):
+        return 1
+
+    @property
+    def computing_power(self):
+        """Benchmark-derived rating used for job load balancing
+        (reference: accelerated_units.py:768-778)."""
+        if self._computing_power is None:
+            self._computing_power = self._measure_power()
+        return self._computing_power
+
+    def _measure_power(self):
+        import time
+        size = 1024
+        a = numpy.random.RandomState(13).rand(size, size).astype(numpy.float32)
+        fn = self.matmul_fn()
+        fn(a, a)  # warm-up / compile
+        start = time.time()
+        for _ in range(3):
+            result = fn(a, a)
+        self.sync_result(result)
+        elapsed = (time.time() - start) / 3
+        return 1000.0 / max(elapsed, 1e-9)
+
+    def matmul_fn(self):
+        return lambda a, b: numpy.dot(a, b)
+
+    def sync_result(self, result):
+        pass
+
+    def __repr__(self):
+        return "<%s backend=%s>" % (type(self).__name__, self.BACKEND)
+
+
+class _JaxDevice(Device):
+    """Shared implementation for JAX-backed devices."""
+
+    PLATFORM = None
+
+    def __init__(self, **kwargs):
+        self.device_index = kwargs.pop("device_index", 0)
+        super(_JaxDevice, self).__init__(**kwargs)
+        self.init_unpickled()
+
+    def init_unpickled(self):
+        super(_JaxDevice, self).init_unpickled()
+        self._jax_device_ = None
+
+    @classmethod
+    def available(cls):
+        try:
+            import jax
+            return len(jax.devices(cls.PLATFORM)) > 0
+        except Exception:
+            return False
+
+    @property
+    def jax_device(self):
+        if self._jax_device_ is None:
+            import jax
+            self._jax_device_ = jax.devices(self.PLATFORM)[self.device_index]
+        return self._jax_device_
+
+    @property
+    def exists(self):
+        return True
+
+    @property
+    def is_async(self):
+        return True
+
+    def sync(self):
+        import jax
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+
+    def sync_result(self, result):
+        if hasattr(result, "block_until_ready"):
+            result.block_until_ready()
+
+    def matmul_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def mm(a, b):
+            return jnp.dot(a, b)
+
+        def run(a, b):
+            return mm(jax.device_put(a, self.jax_device),
+                      jax.device_put(b, self.jax_device))
+        return run
+
+    def put(self, array):
+        import jax
+        return jax.device_put(array, self.jax_device)
+
+    def __getstate__(self):
+        state = super(_JaxDevice, self).__getstate__()
+        state["_computing_power"] = None
+        return state
+
+
+class TPUDevice(_JaxDevice):
+    """JAX on TPU.  Fulfils the north-star role of BASELINE.json: the
+    backend that compiles accelerated units to XLA computations."""
+
+    BACKEND = "tpu"
+    PRIORITY = 30
+    PLATFORM = None  # default platform = accelerator when present
+
+    @classmethod
+    def available(cls):
+        try:
+            import jax
+            return jax.default_backend() not in ("cpu",)
+        except Exception:
+            return False
+
+    @property
+    def jax_device(self):
+        if self._jax_device_ is None:
+            import jax
+            self._jax_device_ = jax.devices()[self.device_index]
+        return self._jax_device_
+
+
+class CPUDevice(_JaxDevice):
+    """JAX on host CPU — test/interpreter backend, same code path."""
+
+    BACKEND = "cpu"
+    PRIORITY = 20
+    PLATFORM = "cpu"
+
+
+class NumpyDevice(Device):
+    """Pure numpy pseudo-device; always available."""
+
+    BACKEND = "numpy"
+    PRIORITY = 10
+
+    @classmethod
+    def available(cls):
+        return True
+
+
+class DeviceInfo(object):
+    """Per-chip autotune table for Pallas kernel tile sizes.
+
+    TPU analog of the reference's ``devices/device_infos.json`` block-size
+    database (reference: backends.py:88-143).  Keyed by device kind and
+    op signature; persisted under the cache dir.
+    """
+
+    _lock = threading.Lock()
+
+    def __init__(self, device_kind):
+        self.device_kind = device_kind
+        self.table = {}
+        self._path = os.path.join(
+            root.common.dirs.get("cache", "/tmp"), "device_infos.json")
+        self._load()
+
+    def _load(self):
+        try:
+            with open(self._path) as fin:
+                data = json.load(fin)
+            self.table = data.get(self.device_kind, {})
+        except (OSError, ValueError):
+            self.table = {}
+
+    def get(self, op_key, default=None):
+        return self.table.get(op_key, default)
+
+    def put(self, op_key, value):
+        self.table[op_key] = value
+        self._save()
+
+    def _save(self):
+        with DeviceInfo._lock:
+            data = {}
+            try:
+                with open(self._path) as fin:
+                    data = json.load(fin)
+            except (OSError, ValueError):
+                pass
+            data[self.device_kind] = self.table
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as fout:
+                json.dump(data, fout, indent=1, sort_keys=True)
+            os.replace(tmp, self._path)

@@ -1,0 +1,49 @@
+"""Command-line argument registry.
+
+TPU-native counterpart of the reference's metaclass-based argparse
+aggregation (reference: veles/cmdline.py:61,86).  Any class whose metaclass
+is :class:`CommandLineArgumentsRegistry` (or that subclasses
+:class:`CommandLineBase`) may define a classmethod ``init_parser(parser)``
+adding its own flags; :func:`build_parser` folds every registered class's
+flags into one parser for the CLI.
+"""
+
+import argparse
+
+__all__ = ["CommandLineArgumentsRegistry", "CommandLineBase", "build_parser"]
+
+
+class CommandLineArgumentsRegistry(type):
+    """Metaclass collecting classes that contribute CLI arguments."""
+
+    classes = []
+
+    def __init__(cls, name, bases, namespace):
+        super(CommandLineArgumentsRegistry, cls).__init__(
+            name, bases, namespace)
+        if "init_parser" in namespace:
+            CommandLineArgumentsRegistry.classes.append(cls)
+
+
+class CommandLineBase(object, metaclass=CommandLineArgumentsRegistry):
+    """Convenience base for classes contributing CLI arguments."""
+
+    @classmethod
+    def init_parser(cls, parser):
+        return parser
+
+
+def build_parser(**kwargs):
+    """Build one parser from every registered contributor."""
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu",
+        description="VELES-TPU: a TPU-native distributed deep learning "
+                    "platform", **kwargs)
+    seen = set()
+    for cls in CommandLineArgumentsRegistry.classes:
+        init = cls.__dict__.get("init_parser")
+        if init is None or init in seen:
+            continue
+        seen.add(init)
+        init.__get__(None, cls)(parser)
+    return parser

@@ -1,0 +1,148 @@
+"""Pickling base and the master-slave distribution contract.
+
+TPU-native counterpart of reference veles/distributable.py:48,136,222.
+
+:class:`Pickleable` — attributes whose name ends with ``_`` are transient
+and excluded from pickles; ``init_unpickled`` re-creates them after load.
+``stripped_pickle`` mode produces wire-sized payloads for the control plane.
+
+:class:`Distributable` — per-unit data-exchange methods used by the job
+farming control plane (genetics / ensembles / elastic loaders).  On-pod
+tensor exchange does NOT go through this path in the TPU build: gradient
+and weight merging compiles to ``jax.lax.psum`` over ICI inside the jitted
+step (see veles_tpu/parallel/).  This contract remains for job-level
+elasticity, exactly the split SURVEY.md section 7 prescribes.
+"""
+
+import threading
+
+from veles_tpu.logger import Logger
+
+__all__ = ["Pickleable", "Distributable", "TriviallyDistributable",
+           "IDistributable"]
+
+#: Seconds to wait on the data lock before warning about a likely deadlock
+#: (reference: distributable.py:139-157 uses 4 s).
+DEADLOCK_TIMEOUT = 4.0
+
+
+class Pickleable(Logger):
+    """Base class with transient-attribute pickling rules."""
+
+    def __init__(self, **kwargs):
+        super(Pickleable, self).__init__(**kwargs)
+        self.stripped_pickle = False
+        self.init_unpickled()
+
+    def init_unpickled(self):
+        """(Re)create transient state. Called from ``__init__`` and after
+        unpickling. Subclasses must call ``super().init_unpickled()``."""
+        parent = super(Pickleable, self)
+        if hasattr(parent, "init_unpickled"):
+            parent.init_unpickled()
+
+    def __getstate__(self):
+        state = {}
+        for key, value in self.__dict__.items():
+            if key.endswith("_"):
+                continue
+            state[key] = value
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.init_unpickled()
+
+
+class IDistributable(object):
+    """Documentation-only interface for the distribution contract."""
+
+    def generate_data_for_master(self):
+        """Return the update payload this unit sends to the master."""
+
+    def generate_data_for_slave(self, slave):
+        """Return the job payload for ``slave`` (None -> nothing to send;
+        False -> not ready, the requester waits at the sync point)."""
+
+    def apply_data_from_master(self, data):
+        """Consume a job payload on the slave."""
+
+    def apply_data_from_slave(self, data, slave):
+        """Merge an update payload on the master."""
+
+    def drop_slave(self, slave):
+        """Called when ``slave`` dies; requeue its pending work."""
+
+
+class Distributable(Pickleable):
+    """Thread-safe implementation scaffold for :class:`IDistributable`."""
+
+    DEADLOCK_TIMEOUT = DEADLOCK_TIMEOUT
+
+    def __init__(self, **kwargs):
+        self.negotiates_on_connect = kwargs.pop("negotiates_on_connect",
+                                                False)
+        super(Distributable, self).__init__(**kwargs)
+
+    def init_unpickled(self):
+        super(Distributable, self).init_unpickled()
+        self._data_lock_ = threading.RLock()
+        self._data_event_ = threading.Event()
+        self._data_event_.set()
+
+    def _data_threadsafe(self, fn, name):
+        def wrapped(*args, **kwargs):
+            if not self._data_lock_.acquire(timeout=self.DEADLOCK_TIMEOUT):
+                self.warning(
+                    "%s: could not take the data lock within %.0f s - "
+                    "possible deadlock", name, self.DEADLOCK_TIMEOUT)
+                self._data_lock_.acquire()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                self._data_lock_.release()
+        return wrapped
+
+    def __getattribute__(self, name):
+        if name in ("generate_data_for_master", "generate_data_for_slave",
+                    "apply_data_from_master", "apply_data_from_slave"):
+            fn = super(Distributable, self).__getattribute__(name)
+            return self._data_threadsafe(fn, name)
+        return super(Distributable, self).__getattribute__(name)
+
+    @property
+    def has_data_for_slave(self):
+        return self._data_event_.is_set()
+
+    @has_data_for_slave.setter
+    def has_data_for_slave(self, value):
+        if value:
+            self._data_event_.set()
+        else:
+            self._data_event_.clear()
+
+    def wait_for_data_for_slave(self, timeout=10.0):
+        if not self._data_event_.wait(timeout):
+            raise TimeoutError(
+                "%s: no data for slave within %.0f s" %
+                (type(self).__name__, timeout))
+
+    # Default no-op contract (reference TriviallyDistributable merged in).
+    def generate_data_for_master(self):
+        return None
+
+    def generate_data_for_slave(self, slave=None):
+        return None
+
+    def apply_data_from_master(self, data):
+        pass
+
+    def apply_data_from_slave(self, data, slave=None):
+        pass
+
+    def drop_slave(self, slave=None):
+        pass
+
+
+class TriviallyDistributable(Distributable):
+    """Explicit alias matching the reference's class name."""

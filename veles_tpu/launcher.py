@@ -1,0 +1,155 @@
+"""Launcher — owns a workflow and the run session.
+
+TPU-native counterpart of reference veles/launcher.py:100.  Modes:
+
+- ``standalone`` (default): initialize + run on the local device(s).
+- ``master`` / ``slave``: job-farming control plane over TCP/JSON
+  (veles_tpu.server / veles_tpu.client) — used by genetics/ensemble task
+  parallelism and elastic loaders.  On-pod tensor exchange does NOT use
+  this path: SPMD steps compile collectives over ICI (veles_tpu.parallel).
+
+Instead of the reference's SSH/paramiko node spawning, multi-host TPU
+jobs are expected to be launched by the cluster scheduler with
+``jax.distributed.initialize`` (veles_tpu.parallel.mesh); the launcher
+keeps job-level spawn hooks for genetics/ensemble child processes.
+"""
+
+import threading
+import time
+
+from veles_tpu.cmdline import CommandLineArgumentsRegistry
+from veles_tpu.logger import Logger
+
+__all__ = ["Launcher"]
+
+
+class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
+    """Session owner: holds the workflow, device, and optional control
+    plane endpoints."""
+
+    def __init__(self, interactive=False, **kwargs):
+        super(Launcher, self).__init__(**kwargs)
+        self.master_address = kwargs.get("master_address", "")
+        self.listen_address = kwargs.get("listen_address", "")
+        self.matplotlib_backend = kwargs.get("matplotlib_backend", "")
+        self.interactive = interactive
+        self._workflow = None
+        self.device = None
+        self.stopped = False
+        self.initialized = False
+        self._agent = None  # Server or Client when distributed
+        self._finished_event = threading.Event()
+        self.start_time = None
+
+    @classmethod
+    def init_parser(cls, parser):
+        parser.add_argument(
+            "-l", "--listen-address", default="",
+            help="run as master, listening on host:port")
+        parser.add_argument(
+            "-m", "--master-address", default="",
+            help="run as slave of the given master host:port")
+        return parser
+
+    # -- workflow ownership (Unit.workflow protocol) -----------------------
+
+    def add_ref(self, workflow):
+        self._workflow = workflow
+
+    def del_ref(self, workflow):
+        if self._workflow is workflow:
+            self._workflow = None
+
+    @property
+    def workflow(self):
+        return self._workflow
+
+    @property
+    def workflow_mode(self):
+        if self.master_address:
+            return "slave"
+        if self.listen_address:
+            return "master"
+        return "standalone"
+
+    @property
+    def is_master(self):
+        return self.workflow_mode == "master"
+
+    @property
+    def is_slave(self):
+        return self.workflow_mode == "slave"
+
+    @property
+    def is_standalone(self):
+        return self.workflow_mode == "standalone"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self, device=None, **kwargs):
+        if self._workflow is None:
+            raise RuntimeError("no workflow attached to the launcher")
+        if device is None or isinstance(device, str):
+            from veles_tpu.backends import Device
+            device = Device(backend=device or "auto")
+        self.device = device
+        self.info("initializing workflow %s on %s (%s mode)",
+                  self._workflow.name, device, self.workflow_mode)
+        if not self.is_master:
+            self._workflow.initialize(device=device, **kwargs)
+        else:
+            # Master initializes too (it owns canonical state) but will
+            # not run the hot loop itself.
+            self._workflow.initialize(device=device, **kwargs)
+        if self.is_master:
+            from veles_tpu.server import Server
+            self._agent = Server(self.listen_address, self._workflow,
+                                 launcher=self)
+        elif self.is_slave:
+            from veles_tpu.client import Client
+            self._agent = Client(self.master_address, self._workflow,
+                                 launcher=self)
+        self.initialized = True
+
+    def run(self):
+        if not self.initialized:
+            self.initialize()
+        self.start_time = time.time()
+        self._finished_event.clear()
+        self.stopped = False
+        from veles_tpu.thread_pool import ThreadPool
+        ThreadPool.sigint_hook = self.stop
+        try:
+            if self._agent is not None:
+                self._agent.run()  # blocks until the session ends
+            else:
+                self._workflow.run()
+                self._finished_event.set()
+        finally:
+            ThreadPool.sigint_hook = None
+            self.stopped = True
+        elapsed = time.time() - self.start_time
+        self.info("session finished in %.1f s", elapsed)
+        self._workflow.print_stats()
+        self._workflow.write_results()
+
+    def on_workflow_finished(self):
+        self._finished_event.set()
+        if self._agent is not None:
+            self._agent.on_workflow_finished()
+
+    def stop(self):
+        self.stopped = True
+        if self._workflow is not None:
+            self._workflow.stop()
+        if self._agent is not None:
+            self._agent.stop()
+        self._finished_event.set()
+
+    def pause(self):
+        if self._agent is not None:
+            self._agent.pause()
+
+    def resume(self):
+        if self._agent is not None:
+            self._agent.resume()

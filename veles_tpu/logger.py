@@ -1,0 +1,149 @@
+"""Logging mixin and structured event tracing.
+
+TPU-native counterpart of the reference's Logger mixin
+(reference: veles/logger.py:59,187,264).  Differences by design:
+
+- Event tracing writes JSON lines to a local file (or any file-like sink)
+  instead of MongoDB; the schema (name, kind=begin|end|single, timestamp,
+  session, attrs) is preserved so downstream dashboards can consume either.
+- Colored console output is plain ANSI, no termcolor dependency.
+"""
+
+import datetime
+import json
+import logging
+import logging.handlers
+import os
+import sys
+import threading
+import time
+import uuid
+
+__all__ = ["Logger", "set_file_logging", "set_event_file"]
+
+_COLORS = {
+    logging.DEBUG: "\033[36m",     # cyan
+    logging.INFO: "\033[32m",      # green
+    logging.WARNING: "\033[33m",   # yellow
+    logging.ERROR: "\033[31m",     # red
+    logging.CRITICAL: "\033[41m",  # red background
+}
+_RESET = "\033[0m"
+
+#: Session id grouping all events of this process (reference groups runs by
+#: a Mongo ``log_id``; we use a uuid4 hex).
+session_id = uuid.uuid4().hex
+
+_event_lock = threading.Lock()
+_event_file = None
+
+
+class ColorFormatter(logging.Formatter):
+    def format(self, record):
+        msg = super(ColorFormatter, self).format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelno, "")
+            return "%s%s%s" % (color, msg, _RESET) if color else msg
+        return msg
+
+
+def setup_logging(level=logging.INFO):
+    """Install the root console handler once."""
+    logger = logging.getLogger()
+    if getattr(setup_logging, "_done", False):
+        logger.setLevel(level)
+        return
+    setup_logging._done = True
+    logger.setLevel(level)
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(ColorFormatter(
+        "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"))
+    logger.addHandler(handler)
+
+
+def set_file_logging(path, level=logging.DEBUG):
+    """Duplicate all log records into ``path`` (reference: -f flag)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    handler.setLevel(level)
+    logging.getLogger().addHandler(handler)
+    return handler
+
+
+def set_event_file(path):
+    """Route ``Logger.event`` records to a JSON-lines file."""
+    global _event_file
+    with _event_lock:
+        if _event_file is not None:
+            _event_file.close()
+        if path is None:
+            _event_file = None
+        else:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            _event_file = open(path, "a")
+
+
+class Logger(object):
+    """Mixin giving every object a named logger plus event tracing."""
+
+    def __init__(self, **kwargs):
+        logger_name = kwargs.pop("logger_name", type(self).__name__)
+        super(Logger, self).__init__()
+        self._logger_ = logging.getLogger(logger_name)
+
+    def init_unpickled(self):
+        parent = super(Logger, self)
+        if hasattr(parent, "init_unpickled"):
+            parent.init_unpickled()
+        self._logger_ = logging.getLogger(type(self).__name__)
+
+    @property
+    def logger(self):
+        return self._logger_
+
+    def change_logger_name(self, name):
+        self._logger_ = logging.getLogger(name)
+
+    def debug(self, msg, *args):
+        self._logger_.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self._logger_.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self._logger_.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self._logger_.error(msg, *args)
+
+    def exception(self, msg="Exception", *args):
+        self._logger_.exception(msg, *args)
+
+    def critical(self, msg, *args):
+        self._logger_.critical(msg, *args)
+
+    def event(self, name, kind, **attrs):
+        """Emit a structured trace record.
+
+        ``kind`` is one of ``"begin"``, ``"end"``, ``"single"``
+        (reference: veles/logger.py:264-289).
+        """
+        if kind not in ("begin", "end", "single"):
+            raise ValueError("kind must be begin|end|single, got %r" % kind)
+        if _event_file is None:
+            return
+        record = {
+            "session": session_id,
+            "instance": type(self).__name__,
+            "name": name,
+            "kind": kind,
+            "time": time.time(),
+            "iso": datetime.datetime.now().isoformat(),
+        }
+        record.update(attrs)
+        with _event_lock:
+            if _event_file is not None:
+                _event_file.write(json.dumps(record, default=repr) + "\n")
+                _event_file.flush()

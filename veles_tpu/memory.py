@@ -1,0 +1,252 @@
+"""Host/device tensor abstraction.
+
+TPU-native counterpart of reference veles/memory.py:110 (``Array`` with the
+explicit ``map_read / map_write / map_invalidate / unmap`` coherence
+protocol).  The protocol's *names and semantics* are preserved so unit code
+ports unchanged, but the mechanics map onto JAX placement:
+
+==================  =====================================================
+reference call       TPU meaning
+==================  =====================================================
+``map_read``        ensure ``mem`` (numpy) reflects device state
+                    (blocking ``numpy.asarray(devmem)`` if device-fresher)
+``map_write``       like map_read, then mark host copy dirty
+``map_invalidate``  mark host dirty WITHOUT reading device back
+``unmap``           if host dirty, ``device_put`` the numpy buffer;
+                    ``devmem`` becomes the fresh jax.Array
+==================  =====================================================
+
+jax.Arrays are immutable, so there is no zero-copy aliasing; instead the
+dirty-bit state machine minimises transfers exactly like the reference's
+OpenCL map/unmap path minimised them.  A :class:`Watcher` counts
+HBM-resident bytes (reference: memory.py:56).  ``shallow_pickle`` ships
+only shape+dtype over the wire (reference: memory.py:477-511).
+"""
+
+import threading
+
+import numpy
+
+from veles_tpu.distributable import Pickleable
+
+__all__ = ["Array", "Watcher", "roundup"]
+
+
+def roundup(num, align):
+    rem = num % align
+    return num if rem == 0 else num + (align - rem)
+
+
+class Watcher(object):
+    """Tracks bytes resident on devices across all Arrays."""
+
+    _lock = threading.Lock()
+    bytes_on_device = 0
+    arrays_on_device = 0
+
+    @classmethod
+    def add(cls, nbytes):
+        with cls._lock:
+            cls.bytes_on_device += nbytes
+            cls.arrays_on_device += 1
+
+    @classmethod
+    def remove(cls, nbytes):
+        with cls._lock:
+            cls.bytes_on_device -= nbytes
+            cls.arrays_on_device -= 1
+
+
+# coherence states
+_HOST_ONLY = 0      # no device buffer
+_IN_SYNC = 1        # host == device
+_HOST_DIRTY = 2     # host newer than device
+_DEVICE_DIRTY = 3   # device newer than host
+
+
+class Array(Pickleable):
+    """A named tensor with a host numpy buffer and an optional device
+    (jax) buffer, synchronised through the map/unmap protocol."""
+
+    def __init__(self, data=None, shallow_pickle=False):
+        super(Array, self).__init__()
+        self._mem = None
+        self.shallow_pickle = shallow_pickle
+        if data is not None:
+            self.mem = data
+
+    def init_unpickled(self):
+        super(Array, self).init_unpickled()
+        self._device_ = None
+        self._devmem_ = None
+        self._state_ = _HOST_ONLY
+        self._lock_ = threading.RLock()
+        self._watched_nbytes_ = 0  # exactly what we told Watcher.add
+
+    # -- basic container behaviour ----------------------------------------
+
+    @property
+    def mem(self):
+        return self._mem
+
+    @mem.setter
+    def mem(self, value):
+        if value is None:
+            self.reset()
+            return
+        self._mem = numpy.ascontiguousarray(value)
+        if self._device_ is not None:
+            self._state_ = _HOST_DIRTY
+
+    @property
+    def devmem(self):
+        """Current device buffer (jax.Array), pushing host changes first."""
+        self.unmap()
+        return self._devmem_
+
+    def __bool__(self):
+        return self._mem is not None and self._mem.size > 0
+
+    def __len__(self):
+        return 0 if self._mem is None else len(self._mem)
+
+    def __getitem__(self, key):
+        self.map_read()
+        return self._mem[key]
+
+    def __setitem__(self, key, value):
+        self.map_write()
+        self._mem[key] = value
+
+    @property
+    def shape(self):
+        return None if self._mem is None else self._mem.shape
+
+    @property
+    def size(self):
+        return 0 if self._mem is None else self._mem.size
+
+    @property
+    def dtype(self):
+        return None if self._mem is None else self._mem.dtype
+
+    @property
+    def nbytes(self):
+        return 0 if self._mem is None else self._mem.nbytes
+
+    @property
+    def sample_size(self):
+        """Elements per sample (all dims but the first)."""
+        if self._mem is None or self._mem.ndim == 0:
+            return 0
+        return self._mem.size // self._mem.shape[0]
+
+    def reshape(self, shape):
+        self.map_write()
+        self._mem = self._mem.reshape(shape)
+
+    def plain(self):
+        self.map_read()
+        return self._mem.ravel()
+
+    # -- device lifecycle --------------------------------------------------
+
+    @property
+    def device(self):
+        return self._device_
+
+    def initialize(self, device):
+        """Attach to ``device``; the first ``unmap`` uploads the data."""
+        with self._lock_:
+            if device is None or not device.exists:
+                self._device_ = None
+                self._state_ = _HOST_ONLY
+                return
+            if self._device_ is device and self._state_ != _HOST_ONLY:
+                return
+            self._device_ = device
+            if self._mem is not None:
+                self._state_ = _HOST_DIRTY
+
+    def reset(self):
+        with self._lock_:
+            if self._watched_nbytes_:
+                Watcher.remove(self._watched_nbytes_)
+                self._watched_nbytes_ = 0
+            self._mem = None
+            self._devmem_ = None
+            self._state_ = _HOST_ONLY
+
+    # -- coherence protocol ------------------------------------------------
+
+    def map_read(self):
+        with self._lock_:
+            if self._state_ == _DEVICE_DIRTY:
+                self._mem = numpy.asarray(self._devmem_)
+                self._state_ = _IN_SYNC
+
+    def map_write(self):
+        with self._lock_:
+            self.map_read()
+            if self._state_ != _HOST_ONLY:
+                self._state_ = _HOST_DIRTY
+
+    def map_invalidate(self):
+        with self._lock_:
+            if self._state_ != _HOST_ONLY:
+                self._state_ = _HOST_DIRTY
+
+    def unmap(self):
+        with self._lock_:
+            if self._state_ == _HOST_DIRTY or (
+                    self._state_ == _IN_SYNC and self._devmem_ is None):
+                if self._device_ is None:
+                    return
+                self._devmem_ = self._device_.put(self._mem)
+                self._track_device_bytes(self._mem.nbytes)
+                self._state_ = _IN_SYNC
+
+    def _track_device_bytes(self, nbytes):
+        """Keep Watcher in sync with exactly what this Array contributed."""
+        if nbytes != self._watched_nbytes_:
+            if self._watched_nbytes_:
+                Watcher.remove(self._watched_nbytes_)
+            if nbytes:
+                Watcher.add(nbytes)
+            self._watched_nbytes_ = nbytes
+
+    def set_device_array(self, jax_array, device=None):
+        """Adopt a fresh device-side result (the output of a jitted step)
+        without a host round-trip; host copy becomes stale."""
+        with self._lock_:
+            if device is not None:
+                self._device_ = device
+            self._devmem_ = jax_array
+            self._state_ = _DEVICE_DIRTY
+            if self._mem is None:
+                # keep shape/dtype metadata without materialising
+                self._mem = numpy.zeros(jax_array.shape, jax_array.dtype)
+            self._track_device_bytes(self._mem.nbytes)
+
+    # -- pickling ----------------------------------------------------------
+
+    def __getstate__(self):
+        self.map_read()
+        state = super(Array, self).__getstate__()
+        if self.shallow_pickle or getattr(self, "stripped_pickle", False):
+            state["_mem"] = None
+            state["_shallow_shape"] = (
+                None if self._mem is None
+                else (self._mem.shape, self._mem.dtype.str))
+        return state
+
+    def __setstate__(self, state):
+        shallow = state.pop("_shallow_shape", None)
+        super(Array, self).__setstate__(state)
+        if shallow is not None and self._mem is None:
+            shape, dtype = shallow
+            self._mem = numpy.zeros(shape, numpy.dtype(dtype))
+
+    def __repr__(self):
+        return "<Array shape=%s dtype=%s state=%d>" % (
+            self.shape, self.dtype, self._state_)

@@ -1,0 +1,189 @@
+"""Shared mutable values used for control flow between units.
+
+TPU-native counterpart of the reference's mutable module
+(reference: veles/mutable.py:44,90,101).
+
+``Bool`` is a shared, mutable boolean cell.  Units hold references to the
+same cell so that one unit flipping a flag is instantly visible to every
+gate that tests it.  Boolean operators (``|``, ``&``, ``~``, ``^``) build
+*derived* cells that recompute from their operands on read, which is how
+gate expressions like ``decision.complete | loader.train_ended`` stay live.
+
+``LinkableAttribute`` aliases an attribute of one object to an attribute of
+another (one- or two-way), which is how ``unit.link_attrs`` shares tensors
+and scalars across the graph without copying.
+"""
+
+__all__ = ["Bool", "LinkableAttribute"]
+
+
+class Bool(object):
+    """A mutable boolean cell supporting live derived expressions."""
+
+    __slots__ = ("_value", "_expr", "_args", "on_change")
+
+    def __init__(self, value=False):
+        self._expr = None
+        self._args = ()
+        self._value = bool(value)
+        self.on_change = None
+
+    # -- value access ------------------------------------------------------
+
+    def __bool__(self):
+        if self._expr is not None:
+            return self._expr(*self._args)
+        return self._value
+
+    __nonzero__ = __bool__
+
+    @property
+    def derived(self):
+        return self._expr is not None
+
+    def __ilshift__(self, value):
+        """``flag <<= True`` assigns; assignment breaks derivation."""
+        self._expr = None
+        self._args = ()
+        new = bool(value)
+        changed = new != self._value
+        self._value = new
+        if changed and self.on_change is not None:
+            self.on_change(self)
+        return self
+
+    # -- derivation --------------------------------------------------------
+
+    @staticmethod
+    def _derived(expr, *args):
+        b = Bool()
+        b._expr = expr
+        b._args = args
+        return b
+
+    def __or__(self, other):
+        other = _as_bool(other)
+        return Bool._derived(lambda a, b: bool(a) or bool(b), self, other)
+
+    __ror__ = __or__
+
+    def __and__(self, other):
+        other = _as_bool(other)
+        return Bool._derived(lambda a, b: bool(a) and bool(b), self, other)
+
+    __rand__ = __and__
+
+    def __xor__(self, other):
+        other = _as_bool(other)
+        return Bool._derived(lambda a, b: bool(a) != bool(b), self, other)
+
+    __rxor__ = __xor__
+
+    def __invert__(self):
+        return Bool._derived(lambda a: not bool(a), self)
+
+    def __repr__(self):
+        kind = "derived" if self.derived else "plain"
+        return "<Bool %s %s>" % (kind, bool(self))
+
+    # Derived cells pickle as their current snapshot value; plain cells
+    # round-trip exactly.
+    def __getstate__(self):
+        return {"value": bool(self), "derived": self.derived}
+
+    def __setstate__(self, state):
+        self._expr = None
+        self._args = ()
+        self._value = state["value"]
+        self.on_change = None
+
+
+def _as_bool(value):
+    if isinstance(value, Bool):
+        return value
+    return Bool(bool(value))
+
+
+class LinkableAttribute(object):
+    """Alias ``obj.name`` to ``source_obj.source_name``.
+
+    Installed as a class-level descriptor with per-instance targets, so
+    several instances of the same class can link to different sources.
+    Assignment through a one-way link raises unless ``assignment_guard`` is
+    disabled; two-way links propagate writes back to the source.
+    """
+
+    #: name of the per-instance link table.  Deliberately has no trailing
+    #: underscore: links between units pickle together with the workflow
+    #: graph (matching the reference, which pickles links too), so data
+    #: aliases survive snapshot/restore.
+    TABLE = "_linked_attrs"
+
+    def __init__(self, obj, name, source_obj, source_name,
+                 two_way=False, assignment_guard=True):
+        self.name = name
+        self.two_way = two_way
+        self.assignment_guard = assignment_guard
+        cls = type(obj)
+        descriptor = cls.__dict__.get(name)
+        if not isinstance(descriptor, _LinkDescriptor):
+            descriptor = _LinkDescriptor(name)
+            # Remove any plain instance attribute that would shadow us.
+            setattr(cls, name, descriptor)
+        obj.__dict__.pop(name, None)
+        table = obj.__dict__.setdefault(LinkableAttribute.TABLE, {})
+        table[name] = (source_obj, source_name, two_way, assignment_guard)
+
+    @staticmethod
+    def unlink(obj, name):
+        """Remove the alias; the attribute becomes a plain instance attr."""
+        table = obj.__dict__.get(LinkableAttribute.TABLE)
+        if table is not None:
+            table.pop(name, None)
+
+
+class _LinkDescriptor(object):
+    """Class-level descriptor reading per-instance link targets from the
+    instance's own ``_linked_attrs`` table (no global id-keyed state, so
+    no leaks, no id-reuse aliasing, and pickling just works)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def _target(self, obj):
+        table = obj.__dict__.get(LinkableAttribute.TABLE)
+        if table is None:
+            return None
+        return table.get(self.name)
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        target = self._target(obj)
+        if target is None:
+            try:
+                return obj.__dict__[self.name]
+            except KeyError:
+                raise AttributeError(self.name)
+        source_obj, source_name, _, _ = target
+        return getattr(source_obj, source_name)
+
+    def __set__(self, obj, value):
+        target = self._target(obj)
+        if target is None:
+            obj.__dict__[self.name] = value
+            return
+        source_obj, source_name, two_way, guard = target
+        if two_way or not guard:
+            setattr(source_obj, source_name, value)
+        else:
+            raise AttributeError(
+                "%s.%s is linked one-way from %s.%s; breaking the link by "
+                "assignment is forbidden" %
+                (type(obj).__name__, self.name,
+                 type(source_obj).__name__, source_name))
+
+    def __delete__(self, obj):
+        table = obj.__dict__.get(LinkableAttribute.TABLE)
+        if table is not None:
+            table.pop(self.name, None)

@@ -1,0 +1,48 @@
+"""Trivial graph-delimiting units (reference: veles/plumbing.py:17)."""
+
+from veles_tpu.units import Unit
+
+__all__ = ["StartPoint", "EndPoint", "Repeater", "FireStarter"]
+
+
+class StartPoint(Unit):
+    """The graph entry point; running it kicks off every successor."""
+
+    hide_from_registry = True
+
+    def initialize(self, **kwargs):
+        self._is_initialized_ = True
+        return True
+
+    def run(self):
+        pass
+
+
+class EndPoint(StartPoint):
+    """The graph exit; running it signals workflow completion."""
+
+    def run(self):
+        if self.workflow is not None:
+            self.workflow.on_workflow_finished()
+
+
+class Repeater(StartPoint):
+    """Loop head: ignores its gate so the training loop can cycle back
+    through it every iteration (reference behavior)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Repeater, self).__init__(workflow, **kwargs)
+        self.ignores_gate <<= True
+
+
+class FireStarter(StartPoint):
+    """Resets the ``stopped`` flag of the given units when run; used to
+    re-arm sub-loops (parity with the reference's plumbing extras)."""
+
+    def __init__(self, workflow, **kwargs):
+        self.units = kwargs.pop("units", [])
+        super(FireStarter, self).__init__(workflow, **kwargs)
+
+    def run(self):
+        for unit in self.units:
+            unit._stopped <<= False

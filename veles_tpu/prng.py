@@ -1,0 +1,161 @@
+"""Reproducible random number generation.
+
+TPU-native counterpart of the reference's PRNG registry
+(reference: veles/prng/random_generator.py:64,250-262).
+
+Design mapping (documented per SURVEY.md section 7 hard part 2):
+
+- The reference keeps *stateful* numpy RNGs keyed by name and replays exact
+  numpy global state.  Host-side work here (shuffles, weight init on CPU,
+  augmentation) uses a keyed registry of ``numpy.random.Generator`` objects
+  whose state pickles with workflow snapshots, giving the same
+  save/restore-reproducibility guarantee without monkey-patching
+  ``numpy.random``.
+- Device-side randomness maps to counter-based ``jax.random`` keys: every
+  :class:`RandomGenerator` can mint a deterministic ``jax.random`` key
+  stream via :meth:`jax_key`, derived from its seed and a fold-in counter,
+  which is the idiomatic (and jit-safe) TPU design.
+"""
+
+import os
+import threading
+
+import numpy
+
+__all__ = ["RandomGenerator", "get"]
+
+
+class RandomGenerator(object):
+    """A named, seedable, picklable RNG with a JAX key stream."""
+
+    def __init__(self, key, seed=None):
+        self.key = key
+        self._lock = threading.Lock()
+        self._seed = None
+        self._jax_counter = 0
+        self.seed(seed if seed is not None else self._default_seed())
+
+    @staticmethod
+    def _default_seed():
+        env = os.environ.get("VELES_SEED")
+        if env:
+            return int(env, 0)
+        return 1234567890  # fixed default: reproducible out of the box
+
+    @property
+    def seed_value(self):
+        return self._seed
+
+    def seed(self, seed, dtype=None, count=None):
+        """Reset state.  ``seed`` may be int, bytes, or ndarray."""
+        if isinstance(seed, (bytes, bytearray)):
+            seed = int.from_bytes(bytes(seed[:8]).ljust(8, b"\0"), "little")
+        elif isinstance(seed, numpy.ndarray):
+            seed = int(numpy.asarray(seed).ravel()[0])
+        with self._lock:
+            self._seed = int(seed) & (2 ** 64 - 1)
+            self._np = numpy.random.Generator(
+                numpy.random.Philox(self._seed))
+            self._jax_counter = 0
+
+    # -- host-side sampling (numpy) ---------------------------------------
+
+    def fill(self, arr, vmin=-1.0, vmax=1.0):
+        """Fill an ndarray in-place with uniforms in [vmin, vmax)."""
+        with self._lock:
+            arr[...] = self._np.uniform(
+                vmin, vmax, size=arr.shape).astype(arr.dtype)
+
+    def fill_normal(self, arr, mean=0.0, stddev=1.0, clip_to_sigma=None):
+        with self._lock:
+            sample = self._np.normal(mean, stddev, size=arr.shape)
+            if clip_to_sigma is not None:
+                lo = mean - clip_to_sigma * stddev
+                hi = mean + clip_to_sigma * stddev
+                sample = numpy.clip(sample, lo, hi)
+            arr[...] = sample.astype(arr.dtype)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        with self._lock:
+            return self._np.normal(loc, scale, size)
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        with self._lock:
+            return self._np.uniform(low, high, size)
+
+    def random_sample(self, size=None):
+        with self._lock:
+            return self._np.random(size)
+
+    def randint(self, low, high=None, size=None, dtype=numpy.int64):
+        with self._lock:
+            return self._np.integers(low, high, size, dtype=dtype)
+
+    def shuffle(self, arr):
+        with self._lock:
+            self._np.shuffle(arr)
+
+    def permutation(self, x):
+        with self._lock:
+            return self._np.permutation(x)
+
+    def choice(self, a, size=None, replace=True):
+        with self._lock:
+            return self._np.choice(a, size, replace)
+
+    # -- device-side key stream (jax) -------------------------------------
+
+    def jax_key(self):
+        """Return the next key in a deterministic ``jax.random`` stream.
+
+        Key ``i`` derives from the FULL 64-bit seed (low and high halves
+        folded in separately) plus the counter — stable across processes
+        for multi-host SPMD as long as seeds match.
+        """
+        import jax
+        with self._lock:
+            counter = self._jax_counter
+            self._jax_counter += 1
+            seed = self._seed
+        base = jax.random.PRNGKey(seed & (2 ** 31 - 1))
+        high = seed >> 31
+        if high:
+            base = jax.random.fold_in(base, high & (2 ** 31 - 1))
+            if high >> 31:
+                base = jax.random.fold_in(base, high >> 31)
+        return jax.random.fold_in(base, counter)
+
+    # -- snapshot support ---------------------------------------------------
+
+    def __getstate__(self):
+        return {"key": self.key, "seed": self._seed,
+                "np_state": self._np.bit_generator.state,
+                "jax_counter": self._jax_counter}
+
+    def __setstate__(self, state):
+        self.key = state["key"]
+        self._lock = threading.Lock()
+        self._seed = state["seed"]
+        self._np = numpy.random.Generator(numpy.random.Philox(self._seed))
+        self._np.bit_generator.state = state["np_state"]
+        self._jax_counter = state["jax_counter"]
+
+    def save_state(self):
+        return self.__getstate__()
+
+    def restore_state(self, state):
+        self.__setstate__(state)
+
+
+_registry = {}
+_registry_lock = threading.Lock()
+
+
+def get(key="default"):
+    """Return the process-wide :class:`RandomGenerator` named ``key``."""
+    with _registry_lock:
+        rng = _registry.get(key)
+        if rng is None:
+            rng = RandomGenerator(key)
+            _registry[key] = rng
+        return rng

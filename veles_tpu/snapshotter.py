@@ -1,0 +1,196 @@
+"""Workflow snapshots: periodic whole-workflow pickles with codecs.
+
+TPU-native counterpart of reference veles/snapshotter.py:84,360,522.
+Preserved capabilities: interval + time-interval gating with a ``skip``
+Bool, compression codecs (none/gz/bz2/xz + snappy when available), the
+``_current`` symlink, restore via :meth:`SnapshotterBase.import_file`,
+size warning with a per-unit pickle-size top-5, and destruction of
+pending state so restored runs are consistent.
+
+TPU note: device arrays snapshot through ``Array.__getstate__`` which
+performs ``map_read`` (device->host) first, so a snapshot taken mid-run
+is a complete host-side image; restore re-uploads lazily at first unmap,
+resharding onto whatever mesh the restoring process has.
+"""
+
+import bz2
+import gzip
+import lzma
+import os
+import pickle
+import time
+
+from veles_tpu.config import root
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+__all__ = ["SnapshotterBase", "Snapshotter"]
+
+CODECS = {
+    "": (lambda path: open(path, "wb"), lambda path: open(path, "rb")),
+    "gz": (lambda path: gzip.open(path, "wb", 6),
+           lambda path: gzip.open(path, "rb")),
+    "bz2": (lambda path: bz2.open(path, "wb", 6),
+            lambda path: bz2.open(path, "rb")),
+    "xz": (lambda path: lzma.open(path, "wb", preset=1),
+           lambda path: lzma.open(path, "rb")),
+}
+
+try:  # snappy framing, reference parity (snapshotter.py:249-356)
+    import snappy  # noqa: F401
+
+    class _SnappyWriter(object):
+        def __init__(self, path):
+            self._file = open(path, "wb")
+            self._compressor = snappy.StreamCompressor()
+
+        def write(self, data):
+            self._file.write(self._compressor.compress(data))
+
+        def close(self):
+            self._file.close()
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *args):
+            self.close()
+
+    class _SnappyReader(object):
+        def __init__(self, path):
+            with open(path, "rb") as fin:
+                self._data = snappy.StreamDecompressor().decompress(
+                    fin.read())
+            self._pos = 0
+
+        def read(self, size=-1):
+            if size < 0:
+                size = len(self._data) - self._pos
+            chunk = self._data[self._pos:self._pos + size]
+            self._pos += len(chunk)
+            return chunk
+
+        def readline(self):
+            idx = self._data.find(b"\n", self._pos)
+            end = len(self._data) if idx < 0 else idx + 1
+            chunk = self._data[self._pos:end]
+            self._pos = end
+            return chunk
+
+        def close(self):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *args):
+            self.close()
+
+    CODECS["snappy"] = (_SnappyWriter, _SnappyReader)
+except ImportError:
+    pass
+
+#: warn when a snapshot exceeds this many bytes (reference: 1 GB warning)
+SIZE_WARNING = 1 << 30
+
+
+class SnapshotterBase(Unit):
+    """Common logic: gating, naming, codec selection, restore."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.prefix = kwargs.pop("prefix", "wf")
+        self.directory = kwargs.pop(
+            "directory", root.common.dirs.get("snapshots", "/tmp"))
+        self.compression = kwargs.pop("compression", "gz")
+        self.interval = kwargs.pop("interval", 1)
+        self.time_interval = kwargs.pop("time_interval", 15)
+        super(SnapshotterBase, self).__init__(workflow, **kwargs)
+        self.skip = Bool(False)
+        self.suffix = None
+        self.destination = None
+        self._counter = 0
+        self._last_time = 0.0
+
+    def initialize(self, **kwargs):
+        os.makedirs(self.directory, exist_ok=True)
+        self._last_time = time.time()
+        return super(SnapshotterBase, self).initialize(**kwargs)
+
+    def run(self):
+        if root.common.disable.get("snapshotting", False):
+            return
+        if self.workflow is not None and self.workflow.workflow_mode == \
+                "slave":
+            return  # only master/standalone snapshot (reference :160)
+        self._counter += 1
+        if bool(self.skip):
+            return
+        if self._counter % self.interval:
+            return
+        if time.time() - self._last_time < self.time_interval:
+            return
+        self._last_time = time.time()
+        self.export()
+
+    def export(self):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def _destination(self):
+        suffix = self.suffix or time.strftime("%Y%m%d_%H%M%S")
+        ext = (".%s" % self.compression) if self.compression else ""
+        return os.path.join(
+            self.directory,
+            "%s_%s.%d.pickle%s" % (self.prefix, suffix,
+                                   pickle.HIGHEST_PROTOCOL, ext))
+
+    def _update_current_link(self):
+        link = os.path.join(self.directory, "%s_current" % self.prefix)
+        try:
+            if os.path.islink(link):
+                os.remove(link)
+            os.symlink(os.path.basename(self.destination), link)
+        except OSError:
+            pass
+
+    @staticmethod
+    def import_file(path):
+        """Restore a workflow object from a snapshot file."""
+        ext = os.path.splitext(path)[1].lstrip(".")
+        codec = ext if ext in CODECS else ""
+        _, opener = CODECS[codec]
+        with opener(path) as fin:
+            return pickle.load(fin)
+
+
+class Snapshotter(SnapshotterBase):
+    """Pickles the whole workflow through the selected codec."""
+
+    def export(self):
+        self.destination = self._destination()
+        writer, _ = CODECS.get(self.compression, CODECS[""])
+        start = time.time()
+        payload = pickle.dumps(self.workflow,
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        if len(payload) > SIZE_WARNING:
+            self.check_snapshot_size()
+        with writer(self.destination) as fout:
+            fout.write(payload)
+        self._update_current_link()
+        self.info("snapshot -> %s (%.1f MB, %.2f s)", self.destination,
+                  len(payload) / 1e6, time.time() - start)
+
+    def check_snapshot_size(self):
+        """Log the top-5 units by pickle size (reference :203-225)."""
+        sizes = []
+        for unit in self.workflow.units:
+            try:
+                sizes.append((len(pickle.dumps(
+                    unit, protocol=pickle.HIGHEST_PROTOCOL)), unit.name))
+            except Exception:
+                pass
+        sizes.sort(reverse=True)
+        self.warning("snapshot is large; top units by pickle size:")
+        for nbytes, name in sizes[:5]:
+            self.warning("  %8.1f MB  %s", nbytes / 1e6, name)
