@@ -3,12 +3,22 @@ sharding/collective tests run anywhere (SURVEY.md section 4 implication b)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the host pins JAX_PLATFORMS (e.g. axon): the suite
+# must run on the virtual 8-device mesh.  Set VELES_TEST_TPU=1 to opt back
+# into real-chip runs.  The env var alone is not enough on hosts whose
+# sitecustomize re-pins the platform, so also update jax.config directly.
+if not os.environ.get("VELES_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("VELES_BACKEND", "cpu")
+
+import jax  # noqa: E402
+
+if not os.environ.get("VELES_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,194 @@
+"""Pallas kernel tests (reference analogs: OCLBLAS, matrix kernels,
+random bitstream, fullbatch gather).  Run in interpreter mode on CPU;
+the same code compiles via Mosaic on TPU."""
+
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.ops import (gather_minibatch, gemm, join,
+                           matmul, mean_disp_normalize,
+                           reduce_cols, reduce_rows)
+from veles_tpu.ops import random as vrandom
+
+
+RS = numpy.random.RandomState(42)
+
+
+class TestMatmul:
+    @pytest.mark.parametrize("shape", [
+        (64, 32, 48), (128, 128, 128), (100, 77, 33), (8, 300, 120)])
+    def test_matches_numpy(self, shape):
+        m, k, n = shape
+        a = RS.rand(m, k).astype(numpy.float32)
+        b = RS.rand(k, n).astype(numpy.float32)
+        out = numpy.asarray(matmul(jnp.asarray(a), jnp.asarray(b),
+                                   blocks=(32, 128, 128)))
+        numpy.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+    @pytest.mark.parametrize("level", [0, 1, 2])
+    def test_precision_levels(self, level):
+        a = RS.rand(32, 256).astype(numpy.float32)
+        b = RS.rand(256, 32).astype(numpy.float32)
+        out = numpy.asarray(matmul(
+            jnp.asarray(a), jnp.asarray(b), precision_level=level,
+            blocks=(32, 128, 128)))
+        oracle = (a.astype(numpy.float64) @ b.astype(numpy.float64))
+        numpy.testing.assert_allclose(out, oracle, rtol=1e-5)
+
+    def test_precision_level_accuracy_ladder(self):
+        """Adversarial accumulation (large alternating terms): higher
+        precision levels must not be worse than level 0 against the f64
+        oracle — the property the reference's precise kernels buy
+        (ocl/matrix_multiplication_precise.cl:36-41)."""
+        k = 4096
+        a = numpy.where(numpy.arange(k) % 2 == 0, 1e6, 1.0).astype(
+            numpy.float32).reshape(1, k)
+        a = numpy.repeat(a, 8, axis=0)
+        b = numpy.where(numpy.arange(k) % 2 == 0, 1.0, -1e-3).astype(
+            numpy.float32).reshape(k, 1)
+        b = numpy.repeat(b, 8, axis=1)
+        oracle = a.astype(numpy.float64) @ b.astype(numpy.float64)
+        errs = []
+        for level in (0, 1, 2):
+            out = numpy.asarray(matmul(
+                jnp.asarray(a), jnp.asarray(b), precision_level=level,
+                blocks=(8, 128, 256)))
+            errs.append(numpy.abs(out - oracle).max())
+        assert errs[1] <= errs[0] * 1.001
+        assert errs[2] <= errs[1] * 1.001
+
+    def test_bfloat16_inputs(self):
+        a = RS.rand(32, 64).astype(numpy.float32)
+        b = RS.rand(64, 32).astype(numpy.float32)
+        out = numpy.asarray(matmul(
+            jnp.asarray(a, jnp.bfloat16), jnp.asarray(b, jnp.bfloat16),
+            blocks=(32, 128, 128), out_dtype=jnp.float32))
+        numpy.testing.assert_allclose(out, a @ b, rtol=2e-2)
+
+    def test_shape_mismatch(self):
+        with pytest.raises(ValueError):
+            matmul(jnp.zeros((4, 5)), jnp.zeros((6, 4)))
+
+
+class TestGemm:
+    def test_alpha_beta(self):
+        a = RS.rand(16, 24).astype(numpy.float32)
+        b = RS.rand(24, 8).astype(numpy.float32)
+        c = RS.rand(16, 8).astype(numpy.float32)
+        out = numpy.asarray(gemm(jnp.asarray(a), jnp.asarray(b),
+                                 jnp.asarray(c), alpha=2.0, beta=0.5))
+        numpy.testing.assert_allclose(out, 2.0 * (a @ b) + 0.5 * c,
+                                      rtol=1e-5)
+
+    def test_transposes(self):
+        a = RS.rand(24, 16).astype(numpy.float32)
+        b = RS.rand(8, 24).astype(numpy.float32)
+        out = numpy.asarray(gemm(jnp.asarray(a), jnp.asarray(b),
+                                 trans_a=True, trans_b=True))
+        numpy.testing.assert_allclose(out, a.T @ b.T, rtol=1e-5)
+
+
+class TestReduce:
+    def test_cols(self):
+        x = RS.rand(300, 70).astype(numpy.float32)
+        out = numpy.asarray(reduce_cols(jnp.asarray(x), block=64))
+        numpy.testing.assert_allclose(out, x.sum(0, keepdims=True),
+                                      rtol=1e-4)
+
+    def test_rows(self):
+        x = RS.rand(100, 500).astype(numpy.float32)
+        out = numpy.asarray(reduce_rows(jnp.asarray(x), block=128))
+        numpy.testing.assert_allclose(out, x.sum(1, keepdims=True),
+                                      rtol=1e-4)
+
+
+class TestGather:
+    def test_gather_with_cast(self):
+        data = (RS.rand(50, 12) * 255).astype(numpy.uint8)
+        idx = RS.permutation(50)[:16].astype(numpy.int32)
+        out = numpy.asarray(gather_minibatch(
+            jnp.asarray(data), jnp.asarray(idx), out_dtype=jnp.float32))
+        numpy.testing.assert_array_equal(out, data[idx].astype(
+            numpy.float32))
+
+    def test_gather_multidim(self):
+        data = RS.rand(20, 4, 6).astype(numpy.float32)
+        idx = numpy.array([3, 1, 19], numpy.int32)
+        out = numpy.asarray(gather_minibatch(jnp.asarray(data),
+                                             jnp.asarray(idx)))
+        numpy.testing.assert_array_equal(out, data[idx])
+
+
+class TestNormalize:
+    def test_mean_disp(self):
+        x = (RS.rand(30, 50) * 255).astype(numpy.uint8)
+        mean = x.mean(0).astype(numpy.float32)
+        disp = numpy.ptp(x.astype(numpy.float32), axis=0) + 1.0
+        rdisp = (1.0 / disp).astype(numpy.float32)
+        out = numpy.asarray(mean_disp_normalize(
+            jnp.asarray(x), jnp.asarray(mean), jnp.asarray(rdisp),
+            block=32))
+        oracle = (x.astype(numpy.float32) - mean) * rdisp
+        numpy.testing.assert_allclose(out, oracle, rtol=1e-5, atol=1e-6)
+
+
+class TestJoin:
+    def test_two(self):
+        a = RS.rand(10, 3).astype(numpy.float32)
+        b = RS.rand(10, 5).astype(numpy.float32)
+        out = numpy.asarray(join(jnp.asarray(a), jnp.asarray(b)))
+        numpy.testing.assert_array_equal(
+            out, numpy.concatenate([a, b], axis=1))
+
+    def test_three_multidim(self):
+        a = RS.rand(4, 2, 3).astype(numpy.float32)
+        b = RS.rand(4, 7).astype(numpy.float32)
+        c = RS.rand(4, 1).astype(numpy.float32)
+        out = numpy.asarray(join(jnp.asarray(a), jnp.asarray(b),
+                                 jnp.asarray(c)))
+        oracle = numpy.concatenate(
+            [a.reshape(4, -1), b, c], axis=1)
+        numpy.testing.assert_array_equal(out, oracle)
+
+
+class TestXorshift:
+    def test_128plus_bit_exact(self):
+        """JAX u32-pair emulation matches the u64 numpy oracle."""
+        streams = 4
+        hi = RS.randint(0, 2 ** 31, (2, streams)).astype(numpy.uint32)
+        lo = RS.randint(0, 2 ** 31, (2, streams)).astype(numpy.uint32)
+        state = numpy.stack([hi, lo], axis=1)  # (2, 2, S)
+        jstate, jbits = vrandom.xorshift128plus(jnp.asarray(state), 16)
+        _, oracle = vrandom.numpy_xorshift128plus(state, 16)
+        jax_u64 = (numpy.asarray(jbits[:, 0]).astype(numpy.uint64) <<
+                   numpy.uint64(32)) | numpy.asarray(
+                       jbits[:, 1]).astype(numpy.uint64)
+        numpy.testing.assert_array_equal(jax_u64, oracle)
+
+    def test_1024star_bit_exact(self):
+        streams = 3
+        state64 = RS.randint(1, 2 ** 62, (16, streams)).astype(
+            numpy.uint64)
+        hi = (state64 >> numpy.uint64(32)).astype(numpy.uint32)
+        lo = (state64 & numpy.uint64(0xffffffff)).astype(numpy.uint32)
+        _, _, _, jbits = vrandom.xorshift1024star(
+            jnp.asarray(hi), jnp.asarray(lo), jnp.int32(0), 12)
+        _, _, oracle = vrandom.numpy_xorshift1024star(state64, 0, 12)
+        jax_u64 = (numpy.asarray(jbits[:, 0]).astype(numpy.uint64) <<
+                   numpy.uint64(32)) | numpy.asarray(
+                       jbits[:, 1]).astype(numpy.uint64)
+        numpy.testing.assert_array_equal(jax_u64, oracle)
+
+    def test_uniform_from_bits_range(self):
+        bits = jnp.asarray(RS.randint(0, 2 ** 31, (1000,)),
+                           jnp.uint32)
+        u = numpy.asarray(vrandom.uniform_from_bits(bits, -2.0, 3.0))
+        assert (u >= -2.0).all() and (u < 3.0).all()
+
+    def test_hardware_uniform_cpu_fallback(self):
+        u = numpy.asarray(vrandom.hardware_uniform(7, (64, 128)))
+        assert u.shape == (64, 128)
+        assert (u >= 0).all() and (u < 1).all()
+        u2 = numpy.asarray(vrandom.hardware_uniform(7, (64, 128)))
+        numpy.testing.assert_array_equal(u, u2)  # deterministic per seed

@@ -1,0 +1,27 @@
+"""Accelerated ops: Pallas TPU kernels for the reference's kernel set.
+
+Reference kernel inventory (SURVEY.md section 2.4, ocl/ + cuda/ sources)
+and its TPU-native disposition:
+
+===========================  ===========================================
+reference kernel              here
+===========================  ===========================================
+matrix_multiplication (.cl)   ops.matmul — tiled Pallas matmul, MXU,
+                              precision levels 0/1/2
+gemm.cl                       ops.blas.gemm — alpha*A*B + beta*C facade
+matrix_reduce.cl              ops.reduce — row/col tree reductions
+fullbatch_loader.cl           ops.gather — minibatch index gather
+random.cl (xorshift)          ops.random — xorshift128+/1024* bit-exact,
+                              plus idiomatic hardware PRNG path
+mean_disp_normalizer.cl       ops.normalize
+join.jcl                      ops.join
+benchmark.cl                  ops.benchmark (autotune + power rating)
+===========================  ===========================================
+"""
+
+from veles_tpu.ops.matmul import matmul  # noqa: F401
+from veles_tpu.ops.blas import gemm  # noqa: F401
+from veles_tpu.ops.reduce import reduce_rows, reduce_cols  # noqa: F401
+from veles_tpu.ops.gather import gather_minibatch  # noqa: F401
+from veles_tpu.ops.normalize import mean_disp_normalize  # noqa: F401
+from veles_tpu.ops.join import join  # noqa: F401

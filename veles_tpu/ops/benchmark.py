@@ -1,0 +1,18 @@
+"""Device benchmark: matmul self-multiply timing.
+
+TPU-native counterpart of reference ocl/benchmark.cl:1-11 and the
+DeviceBenchmark unit (reference: accelerated_units.py:706,768-778) used
+for (a) kernel autotuning and (b) the "computing power" rating that load-
+balances job farming across heterogeneous workers.
+"""
+
+from veles_tpu.ops.matmul import autotune_matmul, matmul_benchmark
+
+__all__ = ["estimate_computing_power", "matmul_benchmark",
+           "autotune_matmul"]
+
+
+def estimate_computing_power(size=1024, repeats=3):
+    """1000 / avg-matmul-seconds, the reference's arbitrary power unit."""
+    elapsed = matmul_benchmark(size=size, repeats=repeats)
+    return 1000.0 / max(elapsed, 1e-9)

@@ -1,0 +1,38 @@
+"""GEMM facade over the tiled Pallas matmul.
+
+TPU-native counterpart of reference ocl/gemm.cl:1-14 and the OCLBLAS
+CUBLAS-compatible wrapper (reference: veles/ocl_blas.py:77,187-236):
+``C = alpha * op(A) @ op(B) + beta * C`` with transpose flags.
+Kernel compilation caching per (transA, transB, shapes, dtype) is XLA's
+jit cache — no hand-rolled binary cache is needed on TPU.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.ops.matmul import matmul
+
+__all__ = ["gemm", "veles_gemm"]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("trans_a", "trans_b", "precision_level"))
+def gemm(a, b, c=None, alpha=1.0, beta=0.0, trans_a=False, trans_b=False,
+         precision_level=0):
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    out = matmul(a, b, precision_level=precision_level,
+                 out_dtype=jnp.float32)
+    out = alpha * out
+    if c is not None:
+        out = out + beta * c.astype(jnp.float32)
+    return out.astype(a.dtype)
+
+
+#: reference naming alias (veles/ocl_blas.py:187 veles_gemm)
+veles_gemm = gemm

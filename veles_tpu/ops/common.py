@@ -1,0 +1,52 @@
+"""Shared helpers for the Pallas kernel set."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["interpret_mode", "pad_to", "unpad", "kernel_cast",
+           "ceil_mult"]
+
+
+def kernel_cast(x, dtype):
+    """dtype cast safe inside Mosaic kernels: narrow ints widen to int32
+    first (Mosaic has no direct narrow-int -> float lowering)."""
+    if (jnp.issubdtype(x.dtype, jnp.integer) and
+            jnp.issubdtype(dtype, jnp.floating) and
+            x.dtype.itemsize < 4):
+        x = x.astype(jnp.int32)
+    return x.astype(dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def interpret_mode():
+    """True when running on a backend without Mosaic (CPU tests): Pallas
+    kernels then execute in interpreter mode, same numerics."""
+    return jax.default_backend() == "cpu"
+
+
+def ceil_mult(value, mult):
+    """Round ``value`` up to the next multiple of ``mult``."""
+    rem = value % mult
+    return value if rem == 0 else value + mult - rem
+
+
+def pad_to(x, multiples):
+    """Zero-pad trailing dims of ``x`` up to the given multiples."""
+    pads = []
+    for dim, mult in zip(x.shape, multiples):
+        if mult is None:
+            pads.append((0, 0))
+        else:
+            rem = dim % mult
+            pads.append((0, 0 if rem == 0 else mult - rem))
+    if any(p[1] for p in pads):
+        x = jnp.pad(x, pads)
+    return x
+
+
+def unpad(x, shape):
+    if x.shape == tuple(shape):
+        return x
+    return x[tuple(slice(0, s) for s in shape)]

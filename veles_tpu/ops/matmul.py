@@ -1,0 +1,177 @@
+"""Tiled Pallas matmul with precision levels.
+
+TPU-native counterpart of the reference's flagship kernel family
+(reference: ocl/matrix_multiplication.cl:1, matrix_multiplication_precise
+.cl:47-185, cuda equivalents).  The reference tiles into shared memory
+with BLOCK_SIZE x BLOCK_SIZE tiles and offers PRECISION_LEVEL
+0 (plain) / 1 (Kahan) / 2 (multi-partial) accumulation.
+
+Design mapping (SURVEY.md section 7, hard part 7):
+
+- Tiling targets the MXU through ``jnp.dot(..., preferred_element_type=
+  float32)`` over VMEM-resident blocks; the grid walks (M/bm, N/bn) with
+  the K loop inside the kernel accumulating in an f32 VMEM scratch.
+- PRECISION_LEVEL 0 already accumulates every MXU partial product in
+  float32 — on bf16 inputs this alone meets or beats the reference's
+  level-1 accuracy claim (verified in tests/test_ops.py against a
+  float64 oracle with the 250k-common-side construction described in
+  matrix_multiplication_precise.cl:38-41).
+- Level 1 adds Kahan compensation across K-tile partial sums.
+- Level 2 uses Neumaier (improved Kahan) compensation, the analog of the
+  reference's multi-partial summation.
+
+Tile sizes come from the per-chip autotune table
+(veles_tpu.backends.DeviceInfo), the analog of devices/device_infos.json.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from veles_tpu.ops.common import (ceil_mult, interpret_mode,
+                                   pad_to, unpad)
+
+__all__ = ["matmul", "matmul_benchmark", "autotune_matmul"]
+
+_DEFAULT_BLOCKS = (512, 512, 512)
+
+
+def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, comp_ref,
+                   *, n_k, precision_level):
+    """One (i, j, k) grid step: acc += A[i,k] @ B[k,j].
+
+    ``acc_ref`` is the f32 accumulator scratch; ``comp_ref`` carries the
+    Kahan/Neumaier compensation for precision levels 1/2.
+    """
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        if precision_level > 0:
+            comp_ref[:] = jnp.zeros_like(comp_ref)
+
+    # HIGHEST keeps true f32 multiply accuracy for f32 inputs (the MXU
+    # otherwise decomposes f32 into a single bf16 pass); bf16 inputs take
+    # the native fast path either way.
+    partial = jnp.dot(a_ref[:], b_ref[:],
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+    if precision_level == 0:
+        acc_ref[:] += partial
+    elif precision_level == 1:
+        # Kahan: y = partial - c; t = acc + y; c = (t - acc) - y
+        y = partial - comp_ref[:]
+        t = acc_ref[:] + y
+        comp_ref[:] = (t - acc_ref[:]) - y
+        acc_ref[:] = t
+    else:
+        # Neumaier: compensation works for |partial| > |acc| too
+        acc = acc_ref[:]
+        t = acc + partial
+        big = jnp.abs(acc) >= jnp.abs(partial)
+        comp_ref[:] += jnp.where(big, (acc - t) + partial,
+                                 (partial - t) + acc)
+        acc_ref[:] = t
+
+    @pl.when(k == n_k - 1)
+    def _store():
+        total = acc_ref[:]
+        if precision_level == 2:
+            total = total + comp_ref[:]
+        out_ref[:] = total.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("precision_level", "blocks", "out_dtype"))
+def matmul(a, b, precision_level=0, blocks=None, out_dtype=None):
+    """``a @ b`` through the Pallas tiled kernel.
+
+    a: (M, K), b: (K, N).  Inputs may be float32 or bfloat16; the MXU
+    accumulates in float32 regardless.
+    """
+    if a.ndim != 2 or b.ndim != 2:
+        raise ValueError("matmul expects 2-D operands")
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError("shape mismatch: %s @ %s" % (a.shape, b.shape))
+    out_dtype = out_dtype or a.dtype
+    if m == 0 or n == 0 or k == 0:
+        return jnp.zeros((m, n), out_dtype)
+    bm, bn, bk = blocks or _DEFAULT_BLOCKS
+    bm, bn, bk = (min(bm, ceil_mult(m, 8)), min(bn, ceil_mult(n, 128)),
+                  min(bk, ceil_mult(k, 128)))
+    a = pad_to(a, (bm, bk))
+    b = pad_to(b, (bk, bn))
+    mp, kp = a.shape
+    _, np_ = b.shape
+    n_k = kp // bk
+    grid = (mp // bm, np_ // bn, n_k)
+
+    out = pl.pallas_call(
+        functools.partial(_matmul_kernel, n_k=n_k,
+                          precision_level=precision_level),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bm, bn), jnp.float32),
+            pltpu.VMEM((bm, bn), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret_mode(),
+    )(a, b)
+    return unpad(out, (m, n))
+
+
+def matmul_benchmark(size=3001, dtype=jnp.float32, precision_level=0,
+                     repeats=5, blocks=None):
+    """Time the kernel on an NxN self-multiply — the same measurement the
+    reference's autotuner and DeviceBenchmark unit make
+    (reference: ocl/benchmark.cl:1-11, accelerated_units.py:706)."""
+    import time
+    import numpy
+    a = jnp.asarray(
+        numpy.random.RandomState(13).rand(size, size), dtype=dtype)
+    fn = lambda: matmul(a, a, precision_level=precision_level,  # noqa: E731
+                        blocks=blocks)
+    fn().block_until_ready()  # compile
+    start = time.time()
+    for _ in range(repeats):
+        result = fn()
+    result.block_until_ready()
+    return (time.time() - start) / repeats
+
+
+def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
+                    precision_level=0):
+    """Pick the best block config for this chip and persist it
+    (analog of reference backends.py:672-731 _find_optimal_bs_vo)."""
+    key = "matmul:%s:pl%d" % (jnp.dtype(dtype).name, precision_level)
+    cached = device_info.get(key)
+    if cached is not None:
+        return tuple(cached)
+    candidates = [(256, 256, 256), (512, 512, 512), (512, 1024, 512),
+                  (1024, 512, 512), (256, 512, 1024)]
+    best, best_time = None, float("inf")
+    for blocks in candidates:
+        try:
+            elapsed = matmul_benchmark(
+                size=size, dtype=dtype, precision_level=precision_level,
+                repeats=2, blocks=blocks)
+        except Exception:
+            continue
+        if elapsed < best_time:
+            best, best_time = blocks, elapsed
+    best = best or _DEFAULT_BLOCKS
+    device_info.put(key, list(best))
+    return best

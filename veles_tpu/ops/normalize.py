@@ -1,0 +1,51 @@
+"""Mean/dispersion normalization kernel.
+
+TPU-native counterpart of reference ocl/mean_disp_normalizer.cl:12-20 /
+cuda equivalent: ``out = (x - mean) * rdisp`` broadcast over samples,
+with an on-the-fly cast from the storage dtype (the reference normalises
+uint8 image data straight out of the dataset).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from veles_tpu.ops.common import interpret_mode, kernel_cast, pad_to
+
+__all__ = ["mean_disp_normalize"]
+
+
+def _normalize_kernel(x_ref, mean_ref, rdisp_ref, out_ref):
+    x = kernel_cast(x_ref[:], out_ref.dtype)
+    out_ref[:] = (x - mean_ref[:]) * rdisp_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "block"))
+def mean_disp_normalize(x, mean, rdisp, out_dtype=jnp.float32, block=256):
+    """(B, F) storage-dtype x, (F,) mean, (F,) reciprocal dispersion."""
+    batch = x.shape[0]
+    sample_shape = x.shape[1:]
+    flat = x.reshape(batch, -1)
+    width = flat.shape[1]
+    mean = mean.reshape(1, width).astype(out_dtype)
+    rdisp = rdisp.reshape(1, width).astype(out_dtype)
+    bm = min(block, batch if batch % 8 == 0 else batch + 8 - batch % 8)
+    flat = pad_to(flat, (bm, 128))
+    mean = pad_to(mean, (None, 128))
+    rdisp = pad_to(rdisp, (None, 128))
+    mp, wp = flat.shape
+    out = pl.pallas_call(
+        _normalize_kernel,
+        grid=(mp // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, wp), lambda i: (i, 0)),
+            pl.BlockSpec((1, wp), lambda i: (0, 0)),
+            pl.BlockSpec((1, wp), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, wp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((mp, wp), out_dtype),
+        interpret=interpret_mode(),
+    )(flat, mean, rdisp)
+    return out[:batch, :width].reshape((batch,) + sample_shape)
