@@ -1,0 +1,498 @@
+"""Loader — the minibatch server contract.
+
+TPU-native counterpart of reference veles/loader/base.py:100,120.
+Preserved semantics:
+
+- the TEST(0) / VALIDATION(1) / TRAIN(2) class triple with
+  ``class_lengths`` / ``class_end_offsets`` and per-epoch iteration
+  test → validation → train;
+- per-epoch TRAIN shuffling bounded by ``shuffle_limit``, driven by the
+  keyed reproducible PRNG;
+- ``Bool`` flags ``last_minibatch`` / ``epoch_ended`` / ``train_ended`` /
+  ``test_ended`` that downstream decision units gate on;
+- label → int mapping built during dataset analysis;
+- normalizer hookup through ``normalization_type`` /
+  ``normalization_parameters``;
+- the distributed contract (reference loader/base.py:631-687): the master
+  serves ``(indices, class, size, offset, epoch)`` per job, the slave
+  patches its ``shuffled_indices`` window and fills data locally; pending
+  minibatches are tracked per slave and requeued into
+  ``failed_minibatches`` on ``drop_slave``; pickling moves pending →
+  failed so snapshots stay consistent.
+
+Subclasses implement ``load_data`` / ``create_minibatch_data`` /
+``fill_minibatch`` exactly as in the reference's ILoader.
+"""
+
+import time
+from collections import defaultdict
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.memory import Array
+from veles_tpu.mutable import Bool
+from veles_tpu.normalization import NormalizerRegistry, StatelessNormalizer
+from veles_tpu.units import Unit
+
+__all__ = ["Loader", "LoaderMSEMixin", "LoaderError",
+           "TEST", "VALID", "TRAIN", "CLASS_NAME"]
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAME = ["test", "validation", "train"]
+
+
+class LoaderError(Exception):
+    pass
+
+
+class Loader(Unit):
+    """Serves minibatches; see module docstring for the contract."""
+
+    LABEL_DTYPE = numpy.int32
+    INDEX_DTYPE = numpy.int32
+
+    def __init__(self, workflow, **kwargs):
+        super(Loader, self).__init__(workflow, **kwargs)
+        self.last_minibatch = Bool(False)
+        self.epoch_ended = Bool(False)
+        self.train_ended = Bool(False)
+        self.test_ended = Bool(False)
+        self.testing = kwargs.get("testing", False)
+        self.shuffle_limit = kwargs.get(
+            "shuffle_limit", numpy.iinfo(numpy.uint32).max)
+        if self.testing:
+            self.shuffle_limit = 0
+        self._max_minibatch_size = int(kwargs.get("minibatch_size", 100))
+        if self._max_minibatch_size < 1:
+            raise ValueError("minibatch_size must be positive")
+        self.class_lengths = [0, 0, 0]
+        self.class_end_offsets = [0, 0, 0]
+        self.train_ratio = kwargs.get("train_ratio", 1.0)
+        self.epoch_number = 0
+        self.samples_served = 0
+        self.global_offset = 0
+        self.minibatch_class = 0
+        self.minibatch_data = Array(shallow_pickle=True)
+        self.minibatch_indices = Array(shallow_pickle=True)
+        self.minibatch_labels = Array(shallow_pickle=True)
+        self.raw_minibatch_labels = []
+        self.shuffled_indices = Array()
+        self.labels_mapping = {}
+        self.failed_minibatches = []
+        self._total_failed = 0
+        self.has_data_for_slave = True
+        self._normalization_type = kwargs.get("normalization_type", "none")
+        self._normalization_parameters = kwargs.get(
+            "normalization_parameters", {})
+        self._normalizer = None
+        self.prng = kwargs.get("prng", prng.get())
+
+    def init_unpickled(self):
+        super(Loader, self).init_unpickled()
+        self._minibatch_offset_ = 0
+        self._minibatch_size_ = 0
+        self.pending_minibatches_ = defaultdict(list)
+        self._serve_log_time_ = time.time()
+
+    # -- pickling: pending -> failed (reference loader/base.py:216-232) ----
+
+    def __getstate__(self):
+        state = super(Loader, self).__getstate__()
+        if not self.stopped:
+            failed = list(state.get("failed_minibatches", []))
+            for pmb in self.pending_minibatches_.values():
+                failed.extend(pmb)
+            state["failed_minibatches"] = failed
+        return state
+
+    # -- the ILoader contract ---------------------------------------------
+
+    def load_data(self):
+        """Populate class_lengths (and any backing storage)."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        """Allocate minibatch_data for max_minibatch_size samples."""
+        raise NotImplementedError
+
+    def fill_minibatch(self):
+        """Fill minibatch_data[:minibatch_size] (and raw labels) according
+        to minibatch_indices."""
+        raise NotImplementedError
+
+    # -- derived quantities -------------------------------------------------
+
+    @property
+    def has_labels(self):
+        return len(self.labels_mapping) > 0
+
+    @property
+    def reversed_labels_mapping(self):
+        return {v: k for k, v in self.labels_mapping.items()}
+
+    @property
+    def unique_labels_count(self):
+        return len(self.labels_mapping)
+
+    @property
+    def total_samples(self):
+        return sum(self.class_lengths)
+
+    @property
+    def effective_total_samples(self):
+        return self.total_samples - int(
+            (1.0 - self.train_ratio) * self.class_lengths[TRAIN])
+
+    @property
+    def effective_class_end_offsets(self):
+        offsets = list(self.class_end_offsets)
+        offsets[TRAIN] -= int(
+            (1.0 - self.train_ratio) * self.class_lengths[TRAIN])
+        return offsets
+
+    @property
+    def max_minibatch_size(self):
+        return self._max_minibatch_size
+
+    @property
+    def minibatch_offset(self):
+        return self._minibatch_offset_
+
+    @minibatch_offset.setter
+    def minibatch_offset(self, value):
+        self._minibatch_offset_ = value
+        self._update_flags()
+
+    @property
+    def minibatch_size(self):
+        return self._minibatch_size_
+
+    @minibatch_size.setter
+    def minibatch_size(self, value):
+        self._minibatch_size_ = value
+
+    @property
+    def pending_minibatches_count(self):
+        return sum(len(v) for v in self.pending_minibatches_.values())
+
+    @property
+    def total_failed(self):
+        return self._total_failed
+
+    @property
+    def shape(self):
+        return self.minibatch_data.shape[1:]
+
+    @property
+    def normalizer(self):
+        if self._normalizer is None:
+            self._normalizer = NormalizerRegistry.get(
+                self._normalization_type, **self._normalization_parameters)
+        return self._normalizer
+
+    @property
+    def normalization_type(self):
+        return self._normalization_type
+
+    @normalization_type.setter
+    def normalization_type(self, value):
+        self._normalization_type = value
+        self._normalizer = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def initialize(self, **kwargs):
+        super(Loader, self).initialize(**kwargs)
+        if self.testing:
+            self.global_offset = 0
+            del self.failed_minibatches[:]
+        self.load_data()
+        self._calc_class_end_offsets()
+        self._max_minibatch_size = min(
+            self._max_minibatch_size, max(self.class_lengths))
+        self.info(
+            "Samples: test %d, validation %d, train %d; minibatch %d",
+            self.class_lengths[TEST], self.class_lengths[VALID],
+            self.class_lengths[TRAIN], self.max_minibatch_size)
+        self.minibatch_indices.mem = numpy.zeros(
+            self.max_minibatch_size, self.INDEX_DTYPE)
+        self.minibatch_labels.reset()
+        self.raw_minibatch_labels = [None] * self.max_minibatch_size
+        self.create_minibatch_data()
+        if not self.minibatch_data:
+            raise LoaderError(
+                "create_minibatch_data() must set minibatch_data")
+        self.analyze_dataset()
+        if self.has_labels:
+            self.minibatch_labels.mem = numpy.zeros(
+                self.max_minibatch_size, self.LABEL_DTYPE)
+        if self.testing:
+            self.shuffled_indices.reset()
+        if not getattr(self, "restored_from_snapshot", False) or self.testing:
+            self.shuffle()
+        return True
+
+    def run(self):
+        self.pending_minibatches_.pop(None, None)
+        self.serve_next_minibatch(None)
+        self._on_successful_serve()
+
+    # -- distributed contract (reference loader/base.py:631-687) ------------
+
+    def generate_data_for_master(self):
+        return True
+
+    def generate_data_for_slave(self, slave):
+        self.serve_next_minibatch(slave.id)
+        data = {
+            "indices": numpy.array(
+                self.minibatch_indices.mem[:self.minibatch_size]),
+            "minibatch_class": self.minibatch_class,
+            "minibatch_size": self.minibatch_size,
+            "minibatch_offset": self.minibatch_offset,
+            "epoch_number": self.epoch_number,
+        }
+        self.has_data_for_slave = (
+            not self._class_ended() or len(self.failed_minibatches) > 0)
+        return data
+
+    def apply_data_from_master(self, data):
+        for attr in ("minibatch_class", "minibatch_size",
+                     "minibatch_offset", "epoch_number"):
+            setattr(self, attr, data[attr])
+        self.last_minibatch <<= False
+        self.epoch_ended <<= False
+        self.train_ended <<= False
+        indices = data["indices"]
+        if indices.size != self.minibatch_size:
+            raise LoaderError("minibatch size mismatch from master")
+        start = self.minibatch_offset - self.minibatch_size
+        if start < 0 or self.minibatch_offset > len(self.shuffled_indices):
+            raise LoaderError("minibatch offset out of range from master")
+        if not self.shuffled_indices:
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=self.INDEX_DTYPE)
+        self.shuffled_indices.map_write()
+        self.shuffled_indices.mem[start:self.minibatch_offset] = indices
+
+    def apply_data_from_slave(self, data, slave):
+        if slave is None:
+            return
+        try:
+            self.minibatch_offset, self.minibatch_size = \
+                self.pending_minibatches_[slave.id].pop()
+        except (KeyError, IndexError):
+            raise LoaderError(
+                "no pending minibatch for slave %s" % slave.id)
+        self._on_successful_serve()
+        if not self.has_data_for_slave:
+            self.has_data_for_slave = bool(self.last_minibatch)
+
+    def drop_slave(self, slave):
+        if slave.id in self.pending_minibatches_:
+            self._total_failed += 1
+            self.failed_minibatches.extend(
+                self.pending_minibatches_.pop(slave.id))
+            self.has_data_for_slave = True
+            self.info("Jobs failed: %d, pending: %d",
+                      len(self.failed_minibatches),
+                      self.pending_minibatches_count)
+
+    # -- serving ------------------------------------------------------------
+
+    def shuffle(self):
+        """Shuffle the TRAIN window of shuffled_indices
+        (reference loader/base.py:711)."""
+        if not self.shuffled_indices:
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=self.INDEX_DTYPE)
+        if self.shuffle_limit <= 0 or self.class_lengths[TRAIN] == 0:
+            return
+        self.shuffle_limit -= 1
+        self.shuffled_indices.map_write()
+        self.prng.shuffle(
+            self.shuffled_indices.mem[self.class_end_offsets[VALID]:])
+
+    def serve_next_minibatch(self, slave_id):
+        try:
+            minibatch_def = self.failed_minibatches.pop()
+        except IndexError:
+            minibatch_def = self._advance_global_offset()
+        offset, size = minibatch_def
+        self.pending_minibatches_[slave_id].append(minibatch_def)
+        self.minibatch_offset, self.minibatch_size = minibatch_def
+
+        if self.fill_indices(offset - size, size):
+            return  # device path filled everything already
+        if self.is_master:
+            return
+        self.fill_minibatch()
+        self.normalize_minibatch()
+        self.map_minibatch_labels()
+        if size < self.max_minibatch_size:
+            self.minibatch_data[size:] = 0.0
+            if self.has_labels:
+                self.minibatch_labels[size:] = -1
+            self.minibatch_indices[size:] = -1
+
+    def fill_indices(self, start_offset, count):
+        """Default host path: copy the indices window.  Returns True when
+        a device path already produced the whole minibatch."""
+        for arr in (self.minibatch_data, self.minibatch_labels,
+                    self.minibatch_indices):
+            arr.map_invalidate()
+        self.shuffled_indices.map_read()
+        self.minibatch_indices.mem[:count] = \
+            self.shuffled_indices.mem[start_offset:start_offset + count]
+        return False
+
+    def normalize_minibatch(self):
+        self.normalizer.normalize(
+            self.minibatch_data.mem[:self.minibatch_size])
+
+    def map_minibatch_labels(self):
+        if not self.has_labels:
+            return
+        self.minibatch_labels.map_write()
+        for i, raw in enumerate(
+                self.raw_minibatch_labels[:self.minibatch_size]):
+            self.minibatch_labels[i] = self.labels_mapping[raw]
+
+    def analyze_dataset(self):
+        """One pass over TRAIN building normalizer stats + labels mapping
+        (reference loader/base.py:755)."""
+        if self.class_lengths[TRAIN] == 0:
+            if not self.normalizer.initialized:
+                raise LoaderError(
+                    "no train samples and the normalizer is uninitialized")
+            return
+        if isinstance(self.normalizer, StatelessNormalizer):
+            self.normalizer.analyze(self.minibatch_data.mem)
+            self._build_labels_mapping_if_needed()
+            return
+        raw_labels = set()
+
+        def callback():
+            self.normalizer.analyze(
+                self.minibatch_data.mem[:self.minibatch_size])
+            raw_labels.update(
+                l for l in self.raw_minibatch_labels[:self.minibatch_size]
+                if l is not None)
+
+        self._iterate_class(TRAIN, callback)
+        if raw_labels and not self.labels_mapping:
+            for i, lbl in enumerate(sorted(raw_labels)):
+                self.labels_mapping[lbl] = i
+
+    def _build_labels_mapping_if_needed(self):
+        """Hook for subclasses that can derive labels without iteration."""
+
+    def _iterate_class(self, class_index, callback):
+        """Serve every minibatch of one class through fill_minibatch."""
+        size = self.class_lengths[class_index]
+        start = self.class_end_offsets[class_index] - size
+        if not self.shuffled_indices:
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=self.INDEX_DTYPE)
+        for offset in range(start, start + size, self.max_minibatch_size):
+            count = min(self.max_minibatch_size, start + size - offset)
+            self.minibatch_size = count
+            self.minibatch_indices.mem[:count] = \
+                self.shuffled_indices.mem[offset:offset + count]
+            self.fill_minibatch()
+            callback()
+
+    def _class_ended(self):
+        for offset in self.effective_class_end_offsets:
+            if self.global_offset == offset:
+                return True
+            if self.global_offset < offset:
+                return False
+        raise LoaderError("global_offset out of bounds")
+
+    def class_index_by_sample_index(self, index):
+        for class_index, class_offset in enumerate(
+                self.effective_class_end_offsets):
+            if index < class_offset:
+                return class_index, class_offset - index
+        raise LoaderError("sample index %d out of bounds" % index)
+
+    def _calc_class_end_offsets(self):
+        total = 0
+        for i, n in enumerate(self.class_lengths):
+            total += int(n)
+            self.class_end_offsets[i] = total
+        if total == 0:
+            raise LoaderError("there is no data to serve")
+
+    def _update_flags(self):
+        if self.is_slave:
+            return  # set explicitly by apply_data_from_master
+        last_mb = (self._class_ended() and
+                   (not self.pending_minibatches_count or
+                    not self.is_master) and
+                   not self.failed_minibatches)
+        self.last_minibatch <<= last_mb
+        self.epoch_ended <<= last_mb and (
+            self.minibatch_class == VALID or
+            (self.minibatch_class == TEST and
+             self.class_lengths[TRAIN] == self.class_lengths[VALID] == 0) or
+            (self.minibatch_class == TEST and self.testing) or
+            (self.minibatch_class == TRAIN and
+             self.class_lengths[VALID] == 0))
+
+    def _advance_global_offset(self):
+        if self.is_slave:
+            return self.minibatch_offset, self.minibatch_size
+        if self.global_offset >= self.effective_total_samples:
+            self.global_offset = 0
+            self.shuffle()
+        self.minibatch_class, remainder = self.class_index_by_sample_index(
+            self.global_offset)
+        size = min(remainder, self.max_minibatch_size)
+        self.global_offset += size
+        self.train_ended <<= (
+            self.global_offset >= self.effective_total_samples)
+        self.test_ended <<= (
+            self.global_offset >= self.class_end_offsets[TEST])
+        return self.global_offset, size
+
+    def _on_successful_serve(self):
+        self.samples_served += self.minibatch_size
+        if not self.is_slave and self.samples_served > 0:
+            num, den = divmod(self.samples_served,
+                              self.effective_total_samples)
+            self.epoch_number = num
+            now = time.time()
+            if now - self._serve_log_time_ >= 10:
+                self._serve_log_time_ = now
+                self.info(
+                    "Served %d samples (%d epochs, %.1f%%); failed %d, "
+                    "pending %d", self.samples_served, num,
+                    100.0 * den / self.effective_total_samples,
+                    len(self.failed_minibatches),
+                    self.pending_minibatches_count)
+
+
+class LoaderMSEMixin(object):
+    """Adds regression targets to the contract
+    (reference: veles/loader/base.py LoaderMSEMixin)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(LoaderMSEMixin, self).__init__(workflow, **kwargs)
+        self.minibatch_targets = Array(shallow_pickle=True)
+        self.targets_shape = None
+        self.target_normalization_type = kwargs.get(
+            "target_normalization_type", "none")
+        self.target_normalization_parameters = kwargs.get(
+            "target_normalization_parameters", {})
+        self._target_normalizer = None
+
+    @property
+    def target_normalizer(self):
+        if self._target_normalizer is None:
+            self._target_normalizer = NormalizerRegistry.get(
+                self.target_normalization_type,
+                **self.target_normalization_parameters)
+        return self._target_normalizer

@@ -22,6 +22,6 @@ benchmark.cl                  ops.benchmark (autotune + power rating)
 from veles_tpu.ops.matmul import matmul  # noqa: F401
 from veles_tpu.ops.blas import gemm  # noqa: F401
 from veles_tpu.ops.reduce import reduce_rows, reduce_cols  # noqa: F401
-from veles_tpu.ops.gather import gather_minibatch  # noqa: F401
+from veles_tpu.ops.gather import gather_minibatch, gather_labels  # noqa: F401
 from veles_tpu.ops.normalize import mean_disp_normalize  # noqa: F401
 from veles_tpu.ops.join import join  # noqa: F401
