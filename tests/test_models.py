@@ -1,0 +1,217 @@
+"""Model layer tests: forward/backward math vs jax.grad oracle, and the
+standard workflow end-to-end on synthetic classification data
+(the MNIST-784 shape in miniature; SURVEY.md section 7 minimum slice)."""
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader import FullBatchLoader
+from veles_tpu.models.all2all import (
+    All2All, All2AllTanh, All2AllSoftmax)
+from veles_tpu.models.evaluator import EvaluatorSoftmax
+from veles_tpu.models.gd import GradientDescent, GDTanh
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+
+
+# ----------------------------------------------------------- math vs autodiff
+
+def test_gd_matches_jax_autodiff():
+    """One GD step must equal -lr * dL/dW from jax.grad for a quadratic
+    surrogate loss L = sum(y * err_output_const)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = numpy.random.RandomState(0)
+    x = rng.randn(8, 5).astype(numpy.float32)
+    W = rng.randn(5, 3).astype(numpy.float32)
+    b = rng.randn(3).astype(numpy.float32)
+    err_const = rng.randn(8, 3).astype(numpy.float32)
+
+    def loss(params):
+        y = All2AllTanh.apply(params, x)
+        return jnp.sum(y * err_const)
+
+    grads = jax.grad(loss)({"weights": W, "bias": b})
+
+    y = numpy.asarray(All2AllTanh.apply({"weights": W, "bias": b}, x))
+    state = {"weights": W, "bias": b,
+             "accum_weights": numpy.zeros_like(W),
+             "accum_bias": numpy.zeros_like(b),
+             "accum2_weights": None, "accum2_bias": None}
+    hyper = {"learning_rate": 0.1, "learning_rate_bias": 0.1,
+             "weights_decay": 0.0, "weights_decay_bias": 0.0,
+             "l1_vs_l2": 0.0, "gradient_moment": 0.0,
+             "gradient_moment_bias": 0.0, "adadelta_rho": 0.95,
+             "solver_epsilon": 1e-6}
+    err_input, new_state = GDTanh.backward(
+        state, hyper, x, y, err_const, solver="momentum",
+        include_bias=True, need_err_input=True)
+
+    numpy.testing.assert_allclose(
+        numpy.asarray(new_state["weights"]),
+        W - 0.1 * numpy.asarray(grads["weights"]), rtol=1e-4, atol=1e-5)
+    numpy.testing.assert_allclose(
+        numpy.asarray(new_state["bias"]),
+        b - 0.1 * numpy.asarray(grads["bias"]), rtol=1e-4, atol=1e-5)
+
+    # err_input = dL/dx
+    def loss_x(xv):
+        y2 = All2AllTanh.apply({"weights": W, "bias": b}, xv)
+        return jnp.sum(y2 * err_const)
+    gx = numpy.asarray(jax.grad(loss_x)(x))
+    numpy.testing.assert_allclose(
+        numpy.asarray(err_input), gx, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_ce_gradient_matches_autodiff():
+    """evaluator err_output chained through GDSoftmax equals the autodiff
+    gradient of mean cross-entropy wrt the pre-softmax logits."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = numpy.random.RandomState(1)
+    x = rng.randn(6, 4).astype(numpy.float32)
+    W = rng.randn(4, 3).astype(numpy.float32)
+    b = numpy.zeros(3, numpy.float32)
+    labels = rng.randint(0, 3, 6).astype(numpy.int32)
+
+    def ce(params):
+        z = x @ params["weights"] + params["bias"]
+        logp = jax.nn.log_softmax(z)
+        return -jnp.mean(logp[jnp.arange(6), labels])
+
+    grads = jax.grad(ce)({"weights": W, "bias": b})
+
+    probs = numpy.asarray(
+        All2AllSoftmax.apply({"weights": W, "bias": b}, x))
+    err, n_err, conf = EvaluatorSoftmax.compute(
+        probs, labels, numpy.float32(6), 3)
+    state = {"weights": W, "bias": b,
+             "accum_weights": numpy.zeros_like(W),
+             "accum_bias": numpy.zeros_like(b),
+             "accum2_weights": None, "accum2_bias": None}
+    hyper = {"learning_rate": 1.0, "learning_rate_bias": 1.0,
+             "weights_decay": 0.0, "weights_decay_bias": 0.0,
+             "l1_vs_l2": 0.0, "gradient_moment": 0.0,
+             "gradient_moment_bias": 0.0, "adadelta_rho": 0.95,
+             "solver_epsilon": 1e-6}
+    from veles_tpu.models.gd import GDSoftmax
+    _, new_state = GDSoftmax.backward(
+        state, hyper, x, probs, numpy.asarray(err), solver="momentum",
+        include_bias=True, need_err_input=False)
+    dW = W - numpy.asarray(new_state["weights"])
+    numpy.testing.assert_allclose(
+        dW, numpy.asarray(grads["weights"]), rtol=1e-4, atol=1e-5)
+
+
+def test_solver_updates():
+    import jax.numpy as jnp
+    from veles_tpu.models.nn_units import GradientDescentBase as G
+    p = jnp.ones(4)
+    g = jnp.full(4, 2.0)
+    acc = jnp.zeros(4)
+    # momentum: v = 0.9*0 + 0.1*2 = 0.2
+    new_p, v, _ = G.solver_update("momentum", p, g, acc, None, 0.1, 0.9,
+                                  0.95, 1e-6)
+    numpy.testing.assert_allclose(numpy.asarray(new_p), 0.8, rtol=1e-6)
+    # adagrad: a = 4; p - 0.1*2/sqrt(4) = 1 - 0.1 = 0.9
+    new_p, a, _ = G.solver_update("adagrad", p, g, acc, None, 0.1, 0.0,
+                                  0.95, 1e-6)
+    numpy.testing.assert_allclose(numpy.asarray(new_p), 0.9, rtol=1e-4)
+    # adadelta smoke: moves in -grad direction
+    new_p, a, a2 = G.solver_update("adadelta", p, g, acc, acc, 1.0, 0.0,
+                                   0.95, 1e-6)
+    assert (numpy.asarray(new_p) < 1.0).all()
+
+
+# ------------------------------------------------------------- end-to-end
+
+class BlobsLoader(FullBatchLoader):
+    """Deterministic 4-class Gaussian blobs, learnable to ~0 error."""
+
+    def load_data(self):
+        self.class_lengths[:] = [0, 64, 256]
+        self._calc_class_end_offsets()
+        self.create_originals((16,))
+        rng = numpy.random.RandomState(99)
+        centers = rng.randn(4, 16) * 2.0
+        for i in range(self.total_samples):
+            label = i % 4
+            self.original_data.mem[i] = (
+                centers[label] + rng.randn(16) * 0.3)
+            self.original_labels[i] = label
+
+
+def build_mnist_like(device, layers=None, **decision):
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,  # the DummyLauncher
+        layers=layers or [
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("blobs", seed=7)),
+        decision_config=dict(max_epochs=10, **decision),
+    )
+    sw.initialize(device=device)
+    return sw
+
+
+def test_standard_workflow_builds_and_links(cpu_device):
+    sw = build_mnist_like(cpu_device)
+    assert len(sw.forwards) == 2
+    assert len(sw.gds) == 2
+    assert sw.forwards[0].weights.shape == (16, 32)
+    assert sw.forwards[1].weights.shape == (32, 4)
+    # gd shares the very same Array objects with its forward
+    assert sw.gds[0].weights is sw.forwards[0].weights
+    assert sw.gds[1].weights is sw.forwards[1].weights
+
+
+def test_mnist_like_trains_to_low_error(cpu_device):
+    sw = build_mnist_like(cpu_device)
+    sw.run()
+    assert bool(sw.decision.complete)
+    # validation error after 10 epochs on blobs must be tiny
+    assert sw.decision.epoch_metrics[1] is not None
+    assert sw.decision.epoch_metrics[1] < 5.0, \
+        "validation error %.2f%%" % sw.decision.epoch_metrics[1]
+    assert sw.decision.epoch_metrics[2] < 5.0
+
+
+def test_numpy_backend_parity(numpy_device, cpu_device):
+    """Same seeds -> numpy pseudo-device and XLA path converge alike."""
+    sw_np = build_mnist_like(numpy_device)
+    sw_np.run()
+    sw_dev = build_mnist_like(cpu_device)
+    sw_dev.run()
+    assert abs(sw_np.decision.epoch_metrics[1] -
+               sw_dev.decision.epoch_metrics[1]) < 3.0
+
+
+def test_adagrad_and_adadelta_train(cpu_device):
+    for solver, lr in (("adagrad", 0.05), ("adadelta", 1.0)):
+        wf = DummyWorkflow()
+        sw = StandardWorkflow(
+            wf.workflow,
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 32,
+                 "learning_rate": lr, "solver": solver},
+                {"type": "softmax", "output_sample_shape": 4,
+                 "learning_rate": lr, "solver": solver},
+            ],
+            loader_factory=lambda w: BlobsLoader(
+                w, minibatch_size=64,
+                prng=RandomGenerator("blobs2", seed=11)),
+            decision_config=dict(max_epochs=6),
+        )
+        sw.initialize(device=cpu_device)
+        sw.run()
+        assert sw.decision.epoch_metrics[1] < 25.0, (
+            solver, sw.decision.epoch_metrics[1])

@@ -1,0 +1,28 @@
+"""Model layer — the Znicz-equivalent neural-network units.
+
+The reference's NN engine (Znicz) is an empty submodule in the checkout;
+its capability list comes from docs/source/manualrst_veles_algorithms.rst
+(SURVEY.md section 2.8): fully-connected, convolutional (+pooling,
+deconv/depool), autoencoders, dropout, activation functions, L1/L2
+regularization, SGD+momentum / AdaGrad / AdaDelta solvers, softmax & MSE
+losses, per-layer hyperparameters, weight-init schemes, Kohonen, RBM,
+RNN/LSTM, reference models AlexNet & VGG.
+
+TPU-first design: every unit exposes a PURE function (``apply`` /
+``backward``) over a params pytree; the unit graph is orchestration.  In
+per-unit mode each run() is one jitted XLA call whose inputs/outputs stay
+on device (no host sync between layers); the workflow compiler
+(veles_tpu.compiler) can fuse the whole forward+backward+update pass of a
+standard workflow into a single jitted train-step — the idiomatic
+replacement for the reference's per-unit kernel-launch chain.
+"""
+
+from veles_tpu.models.nn_units import ForwardBase, GradientDescentBase  # noqa
+from veles_tpu.models.all2all import (  # noqa: F401
+    All2All, All2AllTanh, All2AllRELU, All2AllStrictRELU, All2AllSigmoid,
+    All2AllSoftmax)
+from veles_tpu.models.evaluator import (  # noqa: F401
+    EvaluatorSoftmax, EvaluatorMSE)
+from veles_tpu.models.gd import (  # noqa: F401
+    GradientDescent, GDTanh, GDRELU, GDStrictRELU, GDSigmoid, GDSoftmax)
+from veles_tpu.models.decision import DecisionGD  # noqa: F401

@@ -1,0 +1,158 @@
+"""Fully-connected ("all-to-all") forward units.
+
+Znicz-equivalent all2all family (docs/source/manualrst_veles_algorithms
+.rst:18-40): linear, scaled-tanh, RELU (softplus form), StrictRELU,
+sigmoid, and softmax output layers.
+
+Weights are stored (fan_in, fan_out) so ``x @ W`` feeds the MXU directly
+(the reference stored the transpose and paid a transposed gemm;
+weights_transposed is therefore gone).  The matmul accumulates in f32 via
+``preferred_element_type`` regardless of input dtype — on TPU this is the
+precision-level guarantee the reference bought with Kahan summation
+(SURVEY.md section 7 hard part 7).
+"""
+
+import numpy
+
+from veles_tpu.memory import Array
+from veles_tpu.models.nn_units import ForwardBase
+
+__all__ = ["All2All", "All2AllTanh", "All2AllRELU", "All2AllStrictRELU",
+           "All2AllSigmoid", "All2AllSoftmax"]
+
+
+class All2All(ForwardBase):
+    """y = activation(x @ W + b); base class is linear."""
+
+    MAPPING = "all2all"
+
+    def __init__(self, workflow, **kwargs):
+        super(All2All, self).__init__(workflow, **kwargs)
+        shape = kwargs.get("output_sample_shape", kwargs.get("output_shape"))
+        if shape is None:
+            raise ValueError("output_sample_shape is required")
+        self.output_sample_shape = (
+            (int(shape),) if isinstance(shape, (int, numpy.integer))
+            else tuple(shape))
+
+    @property
+    def output_size(self):
+        return int(numpy.prod(self.output_sample_shape))
+
+    def create_params(self):
+        if not self.input or self.input.sample_size == 0:
+            # input shape not known yet -> workflow re-queues us
+            raise AttributeError(
+                "%s: input shape unknown at initialize" % self.name)
+        fan_in = self.input.sample_size
+        if not self.output:
+            self.output.mem = numpy.zeros(
+                (self.input.shape[0], self.output_size), numpy.float32)
+        if self.weights:
+            return  # restored from snapshot
+        weights = numpy.zeros((fan_in, self.output_size), numpy.float32)
+        self.fill_array(weights, self.weights_filling, self.weights_stddev,
+                        fan_in)
+        self.weights.mem = weights
+        if self.include_bias:
+            bias = numpy.zeros((self.output_size,), numpy.float32)
+            self.fill_array(bias, self.bias_filling, self.bias_stddev,
+                            fan_in)
+            self.bias.mem = bias
+
+    # -- pure math ----------------------------------------------------------
+
+    @staticmethod
+    def _activate(z):
+        return z
+
+    @classmethod
+    def apply(cls, params, x):
+        import jax.numpy as jnp
+        x2 = x.reshape(x.shape[0], -1)
+        z = jnp.dot(x2, params["weights"],
+                    preferred_element_type=jnp.float32)
+        if params.get("bias") is not None:
+            z = z + params["bias"]
+        return cls._activate(z).astype(x2.dtype)
+
+
+class All2AllTanh(All2All):
+    """Scaled tanh y = 1.7159*tanh(2/3 x) (LeCun-efficient-backprop form
+    used by Znicz)."""
+
+    MAPPING = "all2all_tanh"
+    A = 1.7159
+    B = 0.6666
+
+    @staticmethod
+    def _activate(z):
+        import jax.numpy as jnp
+        return All2AllTanh.A * jnp.tanh(All2AllTanh.B * z)
+
+
+class All2AllRELU(All2All):
+    """Znicz 'RELU': y = log(1 + exp(x)) (softplus), numerically stable."""
+
+    MAPPING = "all2all_relu"
+
+    @staticmethod
+    def _activate(z):
+        import jax.numpy as jnp
+        return jnp.where(z > 15, z, jnp.log1p(jnp.exp(jnp.minimum(z, 15))))
+
+
+class All2AllStrictRELU(All2All):
+    """y = max(x, 0)."""
+
+    MAPPING = "all2all_str"
+
+    @staticmethod
+    def _activate(z):
+        import jax.numpy as jnp
+        return jnp.maximum(z, 0)
+
+
+class All2AllSigmoid(All2All):
+    """y = 1/(1+exp(-x))."""
+
+    MAPPING = "all2all_sigmoid"
+
+    @staticmethod
+    def _activate(z):
+        import jax
+        return jax.nn.sigmoid(z)
+
+
+class All2AllSoftmax(All2All):
+    """Softmax output layer; also exposes ``max_idx`` (argmax per sample),
+    which Znicz computed in-kernel for the evaluator."""
+
+    MAPPING = "softmax"
+
+    def __init__(self, workflow, **kwargs):
+        super(All2AllSoftmax, self).__init__(workflow, **kwargs)
+        self.max_idx = Array()
+
+    @staticmethod
+    def _activate(z):
+        import jax
+        return jax.nn.softmax(z, axis=-1)
+
+    def _device_run(self):
+        import jax
+        if self._jit_fn_ is None:
+            def fwd(params, x):
+                y = All2AllSoftmax.apply(params, x)
+                import jax.numpy as jnp
+                return y, jnp.argmax(y, axis=-1).astype(jnp.int32)
+            self._jit_fn_ = jax.jit(fwd)
+        out, max_idx = self._jit_fn_(self.params_dict(), self.input.devmem)
+        self.output.set_device_array(out, self.device)
+        self.max_idx.set_device_array(max_idx, self.device)
+
+    def _numpy_run(self):
+        super(All2AllSoftmax, self)._numpy_run()
+        self.max_idx.map_invalidate()
+        self.max_idx.mem = numpy.argmax(
+            self.output.mem, axis=-1).astype(numpy.int32)

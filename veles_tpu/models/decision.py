@@ -1,0 +1,145 @@
+"""Decision unit — epoch bookkeeping and the stop criterion.
+
+Znicz-equivalent decision.DecisionGD: accumulates the evaluator's
+per-minibatch metrics into per-class epoch totals, tracks the best
+validation error, raises ``improved`` when a new best is reached, skips
+gradient descent on non-TRAIN minibatches via the shared ``gd_skip``
+Bool, and sets ``complete`` when ``fail_iterations`` epochs pass without
+improvement or ``max_epochs`` is reached.
+"""
+
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+__all__ = ["DecisionBase", "DecisionGD", "DecisionMSE"]
+
+
+class DecisionBase(Unit):
+    """Epoch metric aggregation + stop control."""
+
+    def __init__(self, workflow, **kwargs):
+        super(DecisionBase, self).__init__(workflow, **kwargs)
+        self.max_epochs = kwargs.get("max_epochs", None)
+        self.fail_iterations = kwargs.get("fail_iterations", 100)
+        self.complete = Bool(False)
+        self.improved = Bool(False)
+        self.train_improved = Bool(False)
+        self.gd_skip = Bool(False)
+        # linked from loader:
+        self.minibatch_class = None
+        self.last_minibatch = None
+        self.epoch_ended = None
+        self.epoch_number = None
+        self.class_lengths = None
+        self.demand("minibatch_class", "last_minibatch", "class_lengths",
+                    "epoch_ended", "epoch_number")
+        self.epoch_metrics = [None, None, None]
+        self.best_metric = None
+        self.best_epoch = 0
+        self.best_train_metric = None
+
+    def initialize(self, **kwargs):
+        super(DecisionBase, self).initialize(**kwargs)
+        self._reset_epoch_accumulators()
+        return True
+
+    def _reset_epoch_accumulators(self):
+        raise NotImplementedError
+
+    def _accumulate_minibatch(self):
+        raise NotImplementedError
+
+    def _epoch_class_metric(self, class_index):
+        """Finished class -> scalar metric (lower is better)."""
+        raise NotImplementedError
+
+    def run(self):
+        self.gd_skip <<= (self.minibatch_class != TRAIN)
+        self._accumulate_minibatch()
+        if bool(self.last_minibatch):
+            cls = self.minibatch_class
+            self.epoch_metrics[cls] = self._epoch_class_metric(cls)
+            self._on_class_ended(cls)
+        if bool(self.epoch_ended):
+            self._on_epoch_ended()
+
+    def _on_class_ended(self, cls):
+        # improvement is judged on VALID when present, else on TRAIN
+        judge = VALID if self.class_lengths[VALID] > 0 else TRAIN
+        if cls == judge:
+            metric = self.epoch_metrics[cls]
+            if self.best_metric is None or metric < self.best_metric:
+                self.best_metric = metric
+                self.best_epoch = self.epoch_number
+                self.improved <<= True
+            else:
+                self.improved <<= False
+        if cls == TRAIN:
+            metric = self.epoch_metrics[TRAIN]
+            better = (self.best_train_metric is None or
+                      metric < self.best_train_metric)
+            if better:
+                self.best_train_metric = metric
+            self.train_improved <<= better
+
+    def _on_epoch_ended(self):
+        self.info("Epoch %d metrics: test %s, validation %s, train %s",
+                  self.epoch_number,
+                  self.epoch_metrics[0], self.epoch_metrics[1],
+                  self.epoch_metrics[2])
+        stop = False
+        if self.max_epochs is not None and \
+                self.epoch_number >= self.max_epochs:
+            stop = True
+        if self.best_metric is not None and \
+                self.epoch_number - self.best_epoch > self.fail_iterations:
+            stop = True
+        if stop:
+            self.complete <<= True
+        self._reset_epoch_accumulators()
+
+
+class DecisionGD(DecisionBase):
+    """Classification: metric = error percentage from evaluator.n_err."""
+
+    def __init__(self, workflow, **kwargs):
+        super(DecisionGD, self).__init__(workflow, **kwargs)
+        self.evaluator = None  # linked: needs .n_err per minibatch
+        self.demand("evaluator")
+        self.epoch_n_err = [0, 0, 0]
+
+    def _reset_epoch_accumulators(self):
+        self.epoch_n_err = [0, 0, 0]
+
+    def _accumulate_minibatch(self):
+        self.epoch_n_err[self.minibatch_class] += self.evaluator.n_err
+
+    def _epoch_class_metric(self, class_index):
+        length = self.class_lengths[class_index]
+        if length == 0:
+            return None
+        return 100.0 * self.epoch_n_err[class_index] / length
+
+
+class DecisionMSE(DecisionBase):
+    """Regression: metric = epoch RMSE from evaluator.mse_sum."""
+
+    def __init__(self, workflow, **kwargs):
+        super(DecisionMSE, self).__init__(workflow, **kwargs)
+        self.evaluator = None  # linked: needs .mse_sum / .n_samples
+        self.demand("evaluator")
+        self.epoch_sse = [0.0, 0.0, 0.0]
+
+    def _reset_epoch_accumulators(self):
+        self.epoch_sse = [0.0, 0.0, 0.0]
+
+    def _accumulate_minibatch(self):
+        self.epoch_sse[self.minibatch_class] += self.evaluator.mse_sum
+
+    def _epoch_class_metric(self, class_index):
+        import math
+        length = self.class_lengths[class_index]
+        if length == 0:
+            return None
+        return math.sqrt(self.epoch_sse[class_index] / length)

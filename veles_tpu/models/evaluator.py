@@ -1,0 +1,155 @@
+"""Evaluator units: loss gradients + per-minibatch metrics.
+
+Znicz-equivalent evaluator_softmax / evaluator_mse
+(manualrst_veles_algorithms.rst: softmax & MSE losses).
+
+Design notes:
+- ``err_output`` is the MEAN-loss gradient (divided by the current
+  minibatch size), so learning rates are batch-size invariant — a
+  deliberate departure from the reference's summed gradient, documented
+  here for anyone porting configs.
+- short (padded) minibatches are masked by ``labels >= 0`` /
+  an explicit sample mask, matching the loader's padding convention;
+- metrics (n_err, confusion, loss sums) are computed in the same jitted
+  call and fetched as scalars; epoch aggregation happens in the decision
+  unit on host.
+"""
+
+import numpy
+
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+__all__ = ["EvaluatorBase", "EvaluatorSoftmax", "EvaluatorMSE"]
+
+
+class EvaluatorBase(Unit):
+    """Common plumbing: demands output + batch_size, owns err_output."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorBase, self).__init__(workflow, **kwargs)
+        self.output = None          # linked from the last forward unit
+        self.batch_size = None      # linked from loader.minibatch_size
+        self.err_output = Array()
+        self.device = None
+        self._jit_fn_ = None
+        self.demand("output", "batch_size")
+
+    def init_unpickled(self):
+        super(EvaluatorBase, self).init_unpickled()
+        self._jit_fn_ = None
+
+    def on_device(self):
+        return (self.device is not None and self.device.exists and
+                not isinstance(self.device, NumpyDevice))
+
+    def initialize(self, device=None, **kwargs):
+        self.device = device
+        return super(EvaluatorBase, self).initialize(**kwargs)
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Cross-entropy on softmax probabilities.
+
+    err_output = (probs - onehot(label)) / batch_size, zero for padded
+    samples; metrics: n_err (misclassifications), confusion_matrix row =
+    truth, column = prediction.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorSoftmax, self).__init__(workflow, **kwargs)
+        self.labels = None          # linked from loader.minibatch_labels
+        self.n_err = 0              # per-minibatch, read by decision
+        self.confusion_matrix = Array()
+        self.compute_confusion = kwargs.get("compute_confusion", True)
+        self.demand("labels")
+
+    @staticmethod
+    def compute(probs, labels, batch_size, n_classes):
+        import jax.numpy as jnp
+        valid = labels >= 0
+        safe = jnp.where(valid, labels, 0)
+        onehot = jnp.zeros_like(probs).at[
+            jnp.arange(probs.shape[0]), safe].set(1.0)
+        err = (probs - onehot) * valid[:, None] / batch_size
+        pred = jnp.argmax(probs, axis=-1)
+        n_err = jnp.sum((pred != safe) & valid)
+        confusion = jnp.zeros((n_classes, n_classes), jnp.int32).at[
+            safe, pred].add(valid.astype(jnp.int32))
+        return err.astype(probs.dtype), n_err, confusion
+
+    def run(self):
+        n_classes = self.output.shape[-1]
+        if self.on_device():
+            import functools
+            import jax
+            if self._jit_fn_ is None:
+                self._jit_fn_ = jax.jit(functools.partial(
+                    EvaluatorSoftmax.compute, n_classes=n_classes))
+            err, n_err, confusion = self._jit_fn_(
+                self.output.devmem, self.labels.devmem,
+                numpy.float32(self.batch_size))
+            self.err_output.set_device_array(err, self.device)
+            self.n_err = int(n_err)
+            conf = numpy.asarray(confusion)
+        else:
+            self.output.map_read()
+            self.labels.map_read()
+            err, n_err, confusion = EvaluatorSoftmax.compute(
+                self.output.mem, self.labels.mem,
+                numpy.float32(self.batch_size), n_classes)
+            self.err_output.map_invalidate()
+            self.err_output.mem = numpy.asarray(err)
+            self.n_err = int(n_err)
+            conf = numpy.asarray(confusion)
+        if self.compute_confusion:
+            if not self.confusion_matrix:
+                self.confusion_matrix.mem = numpy.zeros_like(conf)
+            self.confusion_matrix.map_write()
+            self.confusion_matrix.mem += conf
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared-error: err_output = 2*(y - target)/batch (masked),
+    metric: summed squared error for RMSE aggregation."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorMSE, self).__init__(workflow, **kwargs)
+        self.target = None          # linked from loader.minibatch_targets
+        self.mse_sum = 0.0          # per-minibatch sum of sample MSEs
+        self.n_samples = 0
+        self.demand("target")
+
+    @staticmethod
+    def compute(y, target, batch_size, max_batch):
+        import jax.numpy as jnp
+        y2 = y.reshape(y.shape[0], -1)
+        t2 = target.reshape(target.shape[0], -1)
+        mask = (jnp.arange(y2.shape[0]) < batch_size).astype(y2.dtype)
+        diff = (y2 - t2) * mask[:, None]
+        err = (2.0 * diff / batch_size).astype(y.dtype).reshape(y.shape)
+        mse_sum = jnp.sum(jnp.mean(diff * diff, axis=1))
+        return err, mse_sum
+
+    def run(self):
+        if self.on_device():
+            import jax
+            if self._jit_fn_ is None:
+                self._jit_fn_ = jax.jit(EvaluatorMSE.compute)
+            err, mse_sum = self._jit_fn_(
+                self.output.devmem, self.target.devmem,
+                numpy.float32(self.batch_size),
+                self.output.shape[0])
+            self.err_output.set_device_array(err, self.device)
+            self.mse_sum = float(mse_sum)
+        else:
+            self.output.map_read()
+            self.target.map_read()
+            err, mse_sum = EvaluatorMSE.compute(
+                self.output.mem, self.target.mem,
+                numpy.float32(self.batch_size), self.output.shape[0])
+            self.err_output.map_invalidate()
+            self.err_output.mem = numpy.asarray(err)
+            self.mse_sum = float(mse_sum)
+        self.n_samples = int(self.batch_size)

@@ -1,0 +1,117 @@
+"""Gradient-descent units for the fully-connected family.
+
+Znicz-equivalent gd / gd_tanh / gd_relu / gd_sigmoid / gd_sm.  The whole
+backward pass of a layer — activation derivative, err_input propagation,
+weight/bias gradients with L1/L2 regularization, and the solver update —
+is ONE jitted XLA call (the reference ran 3-4 separate kernels:
+err_y_update, weights_update, bias_update, err_h_update).
+
+Activation derivatives are expressed in terms of the forward OUTPUT y
+(not the pre-activation), exactly as the reference kernels did, so no
+extra activation state is stored.
+"""
+
+from veles_tpu.models.nn_units import GradientDescentBase
+
+__all__ = ["GradientDescent", "GDTanh", "GDRELU", "GDStrictRELU",
+           "GDSigmoid", "GDSoftmax"]
+
+
+class GradientDescent(GradientDescentBase):
+    """Backward for linear All2All."""
+
+    MAPPING = "all2all"
+
+    @staticmethod
+    def _activation_grad(y, err):
+        return err
+
+    @classmethod
+    def backward(cls, state, hyper, x, y, err_output, *, solver,
+                 include_bias, need_err_input):
+        import jax.numpy as jnp
+        W = state["weights"]
+        x2 = x.reshape(x.shape[0], -1)
+        err = cls._activation_grad(y, err_output)
+        err = err.astype(jnp.float32)
+
+        err_input = None
+        if need_err_input:
+            err_input = jnp.dot(
+                err, W.T, preferred_element_type=jnp.float32
+            ).astype(x.dtype).reshape(x.shape)
+
+        grad_w = jnp.dot(x2.T.astype(jnp.float32), err,
+                         preferred_element_type=jnp.float32)
+        grad_w = GradientDescentBase.regularized(
+            grad_w, W, hyper["weights_decay"], hyper["l1_vs_l2"])
+        new_w, acc_w, acc2_w = GradientDescentBase.solver_update(
+            solver, W, grad_w.astype(W.dtype), state["accum_weights"],
+            state["accum2_weights"], hyper["learning_rate"],
+            hyper["gradient_moment"], hyper["adadelta_rho"],
+            hyper["solver_epsilon"])
+        new_state = {"weights": new_w, "accum_weights": acc_w,
+                     "accum2_weights": acc2_w}
+
+        if include_bias:
+            b = state["bias"]
+            grad_b = err.sum(axis=0)
+            grad_b = GradientDescentBase.regularized(
+                grad_b, b, hyper["weights_decay_bias"], hyper["l1_vs_l2"])
+            new_b, acc_b, acc2_b = GradientDescentBase.solver_update(
+                solver, b, grad_b.astype(b.dtype), state["accum_bias"],
+                state["accum2_bias"], hyper["learning_rate_bias"],
+                hyper["gradient_moment_bias"], hyper["adadelta_rho"],
+                hyper["solver_epsilon"])
+            new_state.update({"bias": new_b, "accum_bias": acc_b,
+                              "accum2_bias": acc2_b})
+        return err_input, new_state
+
+
+class GDSoftmax(GradientDescent):
+    """The evaluator already produced d(CE+softmax)/dz; pass through."""
+
+    MAPPING = "softmax"
+
+
+class GDTanh(GradientDescent):
+    """y = 1.7159*tanh(2/3 x)  =>  dy/dx = (B/A)*(A^2 - y^2)."""
+
+    MAPPING = "all2all_tanh"
+
+    @staticmethod
+    def _activation_grad(y, err):
+        from veles_tpu.models.all2all import All2AllTanh
+        a, b = All2AllTanh.A, All2AllTanh.B
+        return err * ((b / a) * (a * a - y * y))
+
+
+class GDRELU(GradientDescent):
+    """y = log(1+exp(x))  =>  dy/dx = 1 - exp(-y)."""
+
+    MAPPING = "all2all_relu"
+
+    @staticmethod
+    def _activation_grad(y, err):
+        import jax.numpy as jnp
+        return err * (1.0 - jnp.exp(-y))
+
+
+class GDStrictRELU(GradientDescent):
+    """y = max(x, 0)  =>  dy/dx = [y > 0]."""
+
+    MAPPING = "all2all_str"
+
+    @staticmethod
+    def _activation_grad(y, err):
+        return err * (y > 0)
+
+
+class GDSigmoid(GradientDescent):
+    """y = sigmoid(x)  =>  dy/dx = y*(1-y)."""
+
+    MAPPING = "all2all_sigmoid"
+
+    @staticmethod
+    def _activation_grad(y, err):
+        return err * (y * (1.0 - y))

@@ -1,0 +1,358 @@
+"""Base classes for forward and gradient-descent units.
+
+Counterpart of Znicz's nn_units.Forward / nn_units.GradientDescentBase
+(empty submodule; capabilities per docs/source/manualrst_veles_algorithms
+.rst:150-165 — weight-init schemes, per-layer hyperparameters, L1/L2
+regularization, solvers).
+
+Design: parameters (weights/bias + solver state) are veles_tpu Arrays
+shared BY OBJECT between the forward unit and its GD unit, so a device-side
+update by one is immediately visible to the other with no host traffic.
+Forward math lives in pure static methods over (params, x) so the same
+code serves three paths: per-unit jit (here), the fused whole-step
+compiler, and the numpy fallback backend.
+"""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+__all__ = ["ForwardBase", "GradientDescentBase"]
+
+
+def _is_jax_device(device):
+    return device is not None and device.exists and \
+        not isinstance(device, NumpyDevice)
+
+
+class ForwardBase(Unit):
+    """Forward propagation unit: input -> output with trainable params.
+
+    kwargs (per-layer hyperparameters):
+      weights_filling: "uniform" | "gaussian" | "constant"
+      weights_stddev: spread; default 1/sqrt(fan_in) for uniform
+      bias_filling / bias_stddev: likewise for bias
+      include_bias: bool (default True)
+      weights_transposed: kept for reference-parity introspection; this
+        build always stores (fan_in, fan_out) which is the natural MXU
+        layout (the reference stored (fan_out, fan_in)).
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(ForwardBase, self).__init__(workflow, **kwargs)
+        self.input = None  # linked from loader/previous unit (Array)
+        self.output = Array()
+        self.weights = Array()
+        self.bias = Array()
+        self.include_bias = kwargs.get("include_bias", True)
+        self.weights_filling = kwargs.get("weights_filling", "uniform")
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+        self.bias_filling = kwargs.get("bias_filling", "uniform")
+        self.bias_stddev = kwargs.get("bias_stddev", None)
+        self.prng = kwargs.get("prng", prng.get())
+        self.device = None
+        self._jit_fn_ = None
+        self.demand("input")
+
+    def init_unpickled(self):
+        super(ForwardBase, self).init_unpickled()
+        self._jit_fn_ = None
+
+    # -- parameter creation -------------------------------------------------
+
+    def fill_array(self, arr, filling, stddev, fan_in):
+        """Weight-init schemes (manualrst_veles_algorithms.rst:150-165)."""
+        if stddev is None:
+            stddev = 1.0 / numpy.sqrt(fan_in) if fan_in else 0.01
+        if filling == "uniform":
+            self.prng.fill(arr, -stddev, stddev)
+        elif filling == "gaussian":
+            self.prng.fill_normal(arr, 0.0, stddev)
+        elif filling == "constant":
+            arr[:] = stddev
+        else:
+            raise ValueError("unknown filling %r" % filling)
+
+    # -- device plumbing ----------------------------------------------------
+
+    def on_device(self):
+        return _is_jax_device(self.device)
+
+    def initialize(self, device=None, **kwargs):
+        self.device = device
+        super(ForwardBase, self).initialize(**kwargs)
+        self.create_params()
+        for arr in self.param_arrays():
+            if arr:
+                arr.initialize(self.device)
+        return True
+
+    def create_params(self):
+        """Allocate weights/bias from the input shape; idempotent on
+        snapshot restore."""
+        raise NotImplementedError
+
+    def param_arrays(self):
+        return [self.weights, self.bias]
+
+    # -- the pure functions -------------------------------------------------
+
+    @staticmethod
+    def apply(params, x):
+        """params dict, x device array -> output device array."""
+        raise NotImplementedError
+
+    def params_dict(self):
+        return {"weights": self.weights.devmem,
+                "bias": self.bias.devmem if self.include_bias else None}
+
+    def params_numpy(self):
+        self.weights.map_read()
+        if self.include_bias:
+            self.bias.map_read()
+        return {"weights": self.weights.mem,
+                "bias": self.bias.mem if self.include_bias else None}
+
+    # -- execution ----------------------------------------------------------
+
+    def run(self):
+        if self.on_device():
+            self._device_run()
+        else:
+            self._numpy_run()
+
+    def _device_run(self):
+        import jax
+        if self._jit_fn_ is None:
+            self._jit_fn_ = jax.jit(type(self).apply)
+        out = self._jit_fn_(self.params_dict(), self.input.devmem)
+        self.output.set_device_array(out, self.device)
+
+    def _numpy_run(self):
+        params = self.params_numpy()
+        self.input.map_read()
+        out = numpy.asarray(type(self).apply(params, self.input.mem))
+        self.output.map_invalidate()
+        self.output.mem = out
+
+
+class GradientDescentBase(Unit):
+    """Backward + parameter update for one forward unit.
+
+    kwargs: learning_rate, learning_rate_bias, weights_decay (L2/L1 per
+    l1_vs_l2 blend), gradient_moment (momentum), solver
+    ("momentum" | "adagrad" | "adadelta"), adadelta_rho, solver_epsilon.
+
+    Reference-parity semantics: err_output is dL/d(output) arriving from
+    the NEXT unit (or the evaluator); run() produces err_input =
+    dL/d(input) for the PREVIOUS unit and applies the update in the same
+    fused jitted call.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(GradientDescentBase, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.output = None
+        self.err_output = None   # linked: next gd's err_input / evaluator
+        self.err_input = Array()
+        self.weights = None      # linked BY OBJECT from the forward unit
+        self.bias = None
+        self.include_bias = kwargs.get("include_bias", True)
+        self.learning_rate = kwargs.get("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.get(
+            "learning_rate_bias", kwargs.get("learning_rate", 0.01))
+        self.weights_decay = kwargs.get("weights_decay", 0.0)
+        self.weights_decay_bias = kwargs.get("weights_decay_bias", 0.0)
+        self.l1_vs_l2 = kwargs.get("l1_vs_l2", 0.0)
+        self.gradient_moment = kwargs.get("gradient_moment", 0.0)
+        self.gradient_moment_bias = kwargs.get(
+            "gradient_moment_bias", kwargs.get("gradient_moment", 0.0))
+        self.solver = kwargs.get("solver", "momentum")
+        self.adadelta_rho = kwargs.get("adadelta_rho", 0.95)
+        self.solver_epsilon = kwargs.get("solver_epsilon", 1e-6)
+        self.need_err_input = kwargs.get("need_err_input", True)
+        self.device = None
+        self._jit_fn_ = None
+        # solver state (velocity / grad accumulators), created lazily
+        self.accum_weights = Array()
+        self.accum_bias = Array()
+        self.accum2_weights = Array()
+        self.accum2_bias = Array()
+        self.demand("input", "output", "err_output", "weights")
+
+    def init_unpickled(self):
+        super(GradientDescentBase, self).init_unpickled()
+        self._jit_fn_ = None
+
+    def on_device(self):
+        return _is_jax_device(self.device)
+
+    def initialize(self, device=None, **kwargs):
+        self.device = device
+        super(GradientDescentBase, self).initialize(**kwargs)
+        self._init_solver_state()
+        return True
+
+    def _init_solver_state(self):
+        need_second = self.solver == "adadelta"
+        for accum, param in ((self.accum_weights, self.weights),
+                             (self.accum_bias,
+                              self.bias if self.include_bias else None)):
+            if param and not accum:
+                accum.mem = numpy.zeros(param.shape, param.dtype)
+                accum.initialize(self.device)
+        if need_second:
+            for accum, param in ((self.accum2_weights, self.weights),
+                                 (self.accum2_bias,
+                                  self.bias if self.include_bias else None)):
+                if param and not accum:
+                    accum.mem = numpy.zeros(param.shape, param.dtype)
+                    accum.initialize(self.device)
+
+    # -- hyperparameters bundled for the pure function ----------------------
+
+    def hyper_dict(self):
+        return {
+            "learning_rate": self.learning_rate,
+            "learning_rate_bias": self.learning_rate_bias,
+            "weights_decay": self.weights_decay,
+            "weights_decay_bias": self.weights_decay_bias,
+            "l1_vs_l2": self.l1_vs_l2,
+            "gradient_moment": self.gradient_moment,
+            "gradient_moment_bias": self.gradient_moment_bias,
+            "adadelta_rho": self.adadelta_rho,
+            "solver_epsilon": self.solver_epsilon,
+        }
+
+    @staticmethod
+    def regularized(grad, param, decay, l1_vs_l2):
+        """L1/L2-blended weight decay gradient term."""
+        import jax.numpy as jnp
+        return grad + decay * ((1.0 - l1_vs_l2) * param +
+                               l1_vs_l2 * jnp.sign(param))
+
+    @staticmethod
+    def solver_update(solver, param, grad, accum, accum2, lr, moment,
+                      rho, eps):
+        """One solver step; returns (new_param, new_accum, new_accum2).
+
+        momentum:  v = moment*v + lr*g;            p -= v
+        adagrad:   a += g*g;                       p -= lr*g/sqrt(a+eps)
+        adadelta:  a  = rho*a + (1-rho)*g*g
+                   d  = g*sqrt(a2+eps)/sqrt(a+eps); p -= lr*d
+                   a2 = rho*a2 + (1-rho)*d*d
+        (manualrst_veles_algorithms.rst solver list: SGD+momentum /
+        AdaGrad / AdaDelta.)
+        """
+        import jax.numpy as jnp
+        if solver == "momentum":
+            v = moment * accum + lr * grad
+            return param - v, v, accum2
+        if solver == "adagrad":
+            a = accum + grad * grad
+            return param - lr * grad / jnp.sqrt(a + eps), a, accum2
+        if solver == "adadelta":
+            a = rho * accum + (1.0 - rho) * grad * grad
+            d = grad * jnp.sqrt(accum2 + eps) / jnp.sqrt(a + eps)
+            a2 = rho * accum2 + (1.0 - rho) * d * d
+            return param - lr * d, a, a2
+        raise ValueError("unknown solver %r" % solver)
+
+    # -- the pure backward --------------------------------------------------
+
+    @staticmethod
+    def backward(state, hyper, x, y, err_output, *, solver, include_bias,
+                 need_err_input):
+        """state dict (weights/bias/accums) -> (err_input, new_state)."""
+        raise NotImplementedError
+
+    def state_dict(self):
+        d = {"weights": self.weights.devmem,
+             "accum_weights": self.accum_weights.devmem,
+             "accum2_weights": (self.accum2_weights.devmem
+                                if self.accum2_weights else None)}
+        if self.include_bias and self.bias:
+            d["bias"] = self.bias.devmem
+            d["accum_bias"] = self.accum_bias.devmem
+            d["accum2_bias"] = (self.accum2_bias.devmem
+                                if self.accum2_bias else None)
+        else:
+            d["bias"] = d["accum_bias"] = d["accum2_bias"] = None
+        return d
+
+    def state_numpy(self):
+        arrays = [self.weights, self.accum_weights, self.accum2_weights,
+                  self.bias, self.accum_bias, self.accum2_bias]
+        for arr in arrays:
+            if arr:
+                arr.map_read()
+        return {
+            "weights": self.weights.mem,
+            "accum_weights": self.accum_weights.mem,
+            "accum2_weights": (self.accum2_weights.mem
+                               if self.accum2_weights else None),
+            "bias": self.bias.mem if self.include_bias and self.bias
+            else None,
+            "accum_bias": (self.accum_bias.mem
+                           if self.include_bias and self.accum_bias
+                           else None),
+            "accum2_bias": (self.accum2_bias.mem
+                            if self.accum2_bias else None),
+        }
+
+    def _adopt_state(self, new_state, device_side):
+        pairs = (("weights", self.weights),
+                 ("accum_weights", self.accum_weights),
+                 ("accum2_weights", self.accum2_weights),
+                 ("bias", self.bias),
+                 ("accum_bias", self.accum_bias),
+                 ("accum2_bias", self.accum2_bias))
+        for key, arr in pairs:
+            value = new_state.get(key)
+            if value is None or arr is None or not arr:
+                continue
+            if device_side:
+                arr.set_device_array(value, self.device)
+            else:
+                arr.map_invalidate()
+                arr.mem = numpy.asarray(value)
+
+    # -- execution ----------------------------------------------------------
+
+    def run(self):
+        if self.on_device():
+            self._device_run()
+        else:
+            self._numpy_run()
+
+    def _device_run(self):
+        import functools
+        import jax
+        if self._jit_fn_ is None:
+            self._jit_fn_ = jax.jit(functools.partial(
+                type(self).backward, solver=self.solver,
+                include_bias=self.include_bias and bool(self.bias),
+                need_err_input=self.need_err_input))
+        err_input, new_state = self._jit_fn_(
+            self.state_dict(), self.hyper_dict(),
+            self.input.devmem, self.output.devmem, self.err_output.devmem)
+        if self.need_err_input and err_input is not None:
+            self.err_input.set_device_array(err_input, self.device)
+        self._adopt_state(new_state, device_side=True)
+
+    def _numpy_run(self):
+        for arr in (self.input, self.output, self.err_output):
+            arr.map_read()
+        err_input, new_state = type(self).backward(
+            self.state_numpy(), self.hyper_dict(),
+            self.input.mem, self.output.mem, self.err_output.mem,
+            solver=self.solver,
+            include_bias=self.include_bias and bool(self.bias),
+            need_err_input=self.need_err_input)
+        if self.need_err_input and err_input is not None:
+            self.err_input.map_invalidate()
+            self.err_input.mem = numpy.asarray(err_input)
+        self._adopt_state(new_state, device_side=False)

@@ -1,0 +1,149 @@
+"""Standard NN training workflow wiring.
+
+Znicz-equivalent standard_workflow.StandardWorkflow: builds the classic
+loop  repeater -> loader -> forwards -> evaluator -> decision -> gds ->
+repeater  from a declarative ``layers`` list, with the stop path
+decision.complete -> end_point.
+
+A layer spec is a dict: {"type": "all2all_tanh",
+"output_sample_shape": 100, ...hyperparameters...}; forward and GD
+classes are looked up by their shared MAPPING name, mirroring the
+reference's MappedUnitRegistry factories.
+"""
+
+from veles_tpu.models import all2all, gd as gd_module
+from veles_tpu.models.decision import DecisionGD, DecisionMSE
+from veles_tpu.models.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from veles_tpu.plumbing import Repeater
+from veles_tpu.workflow import Workflow
+
+__all__ = ["StandardWorkflow", "forward_mapping", "gd_mapping"]
+
+
+def _build_mapping(module, base):
+    mapping = {}
+    for name in dir(module):
+        cls = getattr(module, name)
+        if isinstance(cls, type) and issubclass(cls, base) and \
+                getattr(cls, "MAPPING", None):
+            mapping[cls.MAPPING] = cls
+    return mapping
+
+
+def forward_mapping():
+    from veles_tpu.models.nn_units import ForwardBase
+    mapping = _build_mapping(all2all, ForwardBase)
+    try:  # conv family registers once implemented
+        from veles_tpu.models import conv, pooling
+        from veles_tpu.models.nn_units import ForwardBase as FB
+        mapping.update(_build_mapping(conv, FB))
+        mapping.update(_build_mapping(pooling, FB))
+    except ImportError:
+        pass
+    return mapping
+
+
+def gd_mapping():
+    from veles_tpu.models.nn_units import GradientDescentBase
+    mapping = _build_mapping(gd_module, GradientDescentBase)
+    try:
+        from veles_tpu.models import gd_conv, gd_pooling
+        from veles_tpu.models.nn_units import GradientDescentBase as GB
+        mapping.update(_build_mapping(gd_conv, GB))
+        mapping.update(_build_mapping(gd_pooling, GB))
+    except ImportError:
+        pass
+    return mapping
+
+
+class StandardWorkflow(Workflow):
+    """loader_factory(workflow) -> Loader; layers: list of layer specs.
+
+    kwargs: loss ("softmax" | "mse"), decision_config, loader_config
+    passed through to the respective units.
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, layers, loader_factory, **kwargs):
+        super(StandardWorkflow, self).__init__(workflow, **kwargs)
+        self.layers_config = layers
+        self.loss = kwargs.get("loss", "softmax")
+        decision_config = kwargs.get("decision_config", {})
+
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+
+        self.loader = loader_factory(self)
+        self.loader.link_from(self.repeater)
+
+        # forwards
+        fmap = forward_mapping()
+        self.forwards = []
+        src_unit, src_attr = self.loader, "minibatch_data"
+        for spec in layers:
+            spec = dict(spec)
+            ltype = spec.pop("type")
+            unit = fmap[ltype](self, **spec)
+            unit.link_from(self.forwards[-1] if self.forwards
+                           else self.loader)
+            unit.link_attrs(src_unit, ("input", src_attr))
+            self.forwards.append(unit)
+            src_unit, src_attr = unit, "output"
+
+        # evaluator
+        if self.loss == "softmax":
+            self.evaluator = EvaluatorSoftmax(self)
+            self.evaluator.link_attrs(self.loader,
+                                      ("labels", "minibatch_labels"))
+        elif self.loss == "mse":
+            self.evaluator = EvaluatorMSE(self)
+            self.evaluator.link_attrs(self.loader,
+                                      ("target", "minibatch_targets"))
+        else:
+            raise ValueError("unknown loss %r" % self.loss)
+        self.evaluator.link_from(self.forwards[-1])
+        self.evaluator.link_attrs(self.forwards[-1], "output")
+        self.evaluator.link_attrs(self.loader,
+                                  ("batch_size", "minibatch_size"))
+
+        # decision
+        decision_cls = DecisionGD if self.loss == "softmax" else DecisionMSE
+        self.decision = decision_cls(self, **decision_config)
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(
+            self.loader, "minibatch_class", "last_minibatch", "epoch_ended",
+            "epoch_number", "class_lengths")
+        self.decision.evaluator = self.evaluator
+
+        # gradient descent chain, last layer first
+        gmap = gd_mapping()
+        self.gds = [None] * len(layers)
+        prev_gd = None
+        for i in reversed(range(len(layers))):
+            spec = dict(layers[i])
+            ltype = spec.pop("type")
+            spec.pop("output_sample_shape", None)
+            spec.pop("output_shape", None)
+            unit = gmap[ltype](self, need_err_input=(i > 0), **spec)
+            fwd = self.forwards[i]
+            unit.link_attrs(fwd, "input", "output", "weights", "bias")
+            if prev_gd is None:
+                unit.link_from(self.decision)
+                unit.link_attrs(self.evaluator, "err_output")
+                unit.gate_block = self.decision.complete
+            else:
+                unit.link_from(prev_gd)
+                unit.link_attrs(prev_gd, ("err_output", "err_input"))
+            unit.gate_skip = self.decision.gd_skip
+            self.gds[i] = unit
+            prev_gd = unit
+
+        # close the loop and the exit path
+        self.repeater.link_from(self.gds[0])
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+    def initialize(self, device=None, **kwargs):
+        return super(StandardWorkflow, self).initialize(
+            device=device, **kwargs)
