@@ -1,0 +1,73 @@
+"""Headline benchmark vs the reference's only published kernel number.
+
+Reference: autotuned OpenCL tiled matmul, 3001x3001 float32,
+PRECISION_LEVEL 0, avg 0.1642 s on a GTX TITAN
+(devices/device_infos.json — the sole quantitative entry in the repo;
+see BASELINE.md).  Same shape, same dtype, our Pallas TPU matmul.
+
+Timing method: the execution environment may put the device behind a
+high-latency tunnel, where a blocking fetch costs ~0.1 s regardless of
+compute.  We therefore time two DEPENDENT chains of n1 and n2 matmuls,
+each ended by a scalar fetch, and report the slope
+(t2 - t1) / (n2 - n1) — pure device time per matmul, latency cancelled.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+vs_baseline > 1 means faster than the reference.
+"""
+
+import json
+import os
+import time
+
+import numpy
+
+BASELINE_S = 0.1642  # GTX TITAN, devices/device_infos.json
+N = 3001
+
+
+def _chain_time(matmul_fn, a, b, n):
+    start = time.perf_counter()
+    acc = a
+    for _ in range(n):
+        acc = matmul_fn(acc, b)
+    float(acc[0, 0])  # forces completion + round trip
+    return time.perf_counter() - start
+
+
+def main():
+    from veles_tpu.ops import matmul
+
+    import jax
+
+    small = bool(os.environ.get("VELES_BENCH_SMALL"))
+    n = 512 if small else N
+    n1, n2 = (1, 6) if small else (1, 41)
+
+    rng = numpy.random.RandomState(0)
+    scale = 0.01  # keep chained products bounded
+    a = jax.device_put(
+        ((rng.rand(n, n) - 0.5) * scale).astype(numpy.float32))
+    b = jax.device_put(
+        ((rng.rand(n, n) - 0.5) * scale).astype(numpy.float32))
+
+    def mm(x, y):
+        return matmul(x, y, precision_level=0)
+
+    float(mm(a, b)[0, 0])  # compile + warmup
+
+    per_matmul = min(
+        (_chain_time(mm, a, b, n2) - _chain_time(mm, a, b, n1)) / (n2 - n1)
+        for _ in range(3))
+
+    print(json.dumps({
+        "metric": "matmul_%dx%d_f32_avg_time" % (n, n),
+        "value": round(per_matmul, 6),
+        "unit": "s",
+        "vs_baseline": (round(BASELINE_S / per_matmul, 2)
+                        if n == N else None),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""Fused train step + sharding tests on the virtual 8-device CPU mesh
+(SURVEY.md section 4 implication b)."""
+
+import numpy
+import pytest
+
+import jax
+
+from veles_tpu.compiler import (
+    LayerPlan, adopt_state, build_forward, build_train_step, extract_state,
+    workflow_plan)
+from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+from veles_tpu.parallel import (
+    auto_mesh, batch_sharding, make_mesh, mlp_state_shardings, replicate,
+    shard_batch)
+
+
+def _state(rng, dims):
+    out = []
+    for fi, fo in zip(dims[:-1], dims[1:]):
+        out.append({
+            "weights": rng.randn(fi, fo).astype(numpy.float32) * 0.1,
+            "bias": numpy.zeros(fo, numpy.float32),
+            "accum_weights": numpy.zeros((fi, fo), numpy.float32),
+            "accum_bias": numpy.zeros(fo, numpy.float32),
+            "accum2_weights": None, "accum2_bias": None})
+    return out
+
+
+def _plans(lr=0.1):
+    hyper = {"learning_rate": lr, "gradient_moment": 0.9}
+    return [LayerPlan(All2AllTanh, hyper=hyper),
+            LayerPlan(All2AllSoftmax, hyper=hyper)]
+
+
+def _batch(rng, n=32, fan_in=16, classes=4):
+    labels = (numpy.arange(n) % classes).astype(numpy.int32)
+    centers = rng.randn(classes, fan_in).astype(numpy.float32) * 2
+    x = (centers[labels] +
+         rng.randn(n, fan_in).astype(numpy.float32) * 0.2)
+    return x, labels
+
+
+def test_fused_step_decreases_loss():
+    rng = numpy.random.RandomState(0)
+    state = _state(rng, (16, 32, 4))
+    step = build_train_step(_plans())
+    x, labels = _batch(rng)
+    losses = []
+    for _ in range(20):
+        state, metrics = step(state, x, labels, numpy.float32(32))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_fused_step_matches_unit_graph():
+    """The compiler path and the per-unit GD path must produce the same
+    parameters after a step (same math, fused)."""
+    from tests.test_models import build_mnist_like
+    from veles_tpu.backends import Device
+    dev = Device(backend="cpu")
+
+    sw = build_mnist_like(dev)
+    plans = workflow_plan(sw)
+    state0 = jax.tree.map(lambda v: None if v is None else numpy.array(v),
+                          extract_state(sw), is_leaf=lambda v: v is None)
+
+    # one minibatch through the unit graph (TRAIN class comes 3rd; run
+    # loader until a train minibatch is served)
+    loader = sw.loader
+    while True:
+        loader.run()
+        if loader.minibatch_class == 2:
+            break
+    for fwd in sw.forwards:
+        fwd.run()
+    sw.evaluator.run()
+    for gd in reversed(sw.gds):
+        gd.run()
+    unit_state = extract_state(sw)
+
+    step = build_train_step(plans, donate=False)
+    x = numpy.asarray(loader.minibatch_data.devmem)
+    labels = numpy.asarray(loader.minibatch_labels.devmem)
+    fused_state, _ = step(state0, x, labels,
+                          numpy.float32(loader.minibatch_size))
+
+    for us, fs in zip(unit_state, fused_state):
+        for key in ("weights", "bias"):
+            numpy.testing.assert_allclose(
+                numpy.asarray(us[key]), numpy.asarray(fs[key]),
+                rtol=1e-4, atol=1e-6)
+
+
+def test_dp_sharded_step_matches_single_device():
+    rng = numpy.random.RandomState(3)
+    state = _state(rng, (16, 32, 4))
+    x, labels = _batch(rng, n=64)
+
+    ref_step = build_train_step(_plans(), donate=False)
+    ref_state, ref_metrics = ref_step(
+        jax.tree.map(lambda v: None if v is None else numpy.array(v),
+                     state, is_leaf=lambda v: v is None),
+        x, labels, numpy.float32(64))
+
+    mesh = auto_mesh()
+    shardings = mlp_state_shardings(mesh, state)
+    bsh = batch_sharding(mesh)
+    step = build_train_step(_plans(), mesh=mesh, state_shardings=shardings,
+                            batch_sharding=bsh, donate=False)
+    dstate = jax.tree.map(lambda l, s: None if l is None else jax.device_put(l, s),
+                          state, shardings, is_leaf=lambda v: v is None)
+    dx = jax.device_put(x, bsh)
+    dlabels = jax.device_put(labels, bsh)
+    new_state, metrics = step(dstate, dx, dlabels, numpy.float32(64))
+
+    assert abs(float(metrics["loss"]) - float(ref_metrics["loss"])) < 1e-5
+    for rs, ns in zip(ref_state, new_state):
+        numpy.testing.assert_allclose(
+            numpy.asarray(rs["weights"]), numpy.asarray(ns["weights"]),
+            rtol=1e-4, atol=1e-6)
+
+
+def test_tp_dp_mesh_step_matches_single_device():
+    rng = numpy.random.RandomState(4)
+    state = _state(rng, (16, 32, 4))
+    x, labels = _batch(rng, n=64)
+
+    ref_step = build_train_step(_plans(), donate=False)
+    ref_state, _ = ref_step(
+        jax.tree.map(lambda v: None if v is None else numpy.array(v),
+                     state, is_leaf=lambda v: v is None),
+        x, labels, numpy.float32(64))
+
+    mesh = make_mesh({"data": 4, "model": 2})
+    shardings = mlp_state_shardings(mesh, state, model_axis="model")
+    bsh = batch_sharding(mesh)
+    step = build_train_step(_plans(), mesh=mesh, state_shardings=shardings,
+                            batch_sharding=bsh, donate=False)
+    dstate = jax.tree.map(lambda l, s: None if l is None else jax.device_put(l, s),
+                          state, shardings, is_leaf=lambda v: v is None)
+    new_state, _ = step(dstate, jax.device_put(x, bsh),
+                        jax.device_put(labels, bsh), numpy.float32(64))
+    for rs, ns in zip(ref_state, new_state):
+        numpy.testing.assert_allclose(
+            numpy.asarray(rs["weights"]), numpy.asarray(ns["weights"]),
+            rtol=1e-3, atol=1e-5)
+
+
+def test_graft_entry_single_chip():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", "/root/repo/__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (128, 10)
+    assert numpy.allclose(numpy.asarray(out).sum(axis=1), 1.0, atol=1e-3)
+
+
+def test_graft_entry_dryrun_multichip():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", "/root/repo/__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)
+    mod.dryrun_multichip(3)

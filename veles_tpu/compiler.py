@@ -1,0 +1,204 @@
+"""Workflow compiler — fuse the training loop body into ONE jitted step.
+
+The reference executed each iteration as a chain of per-unit kernel
+launches with host scheduling in between (SURVEY.md section 3.2).  The
+TPU-idiomatic replacement: trace the forward units' pure ``apply``
+functions, differentiate the loss with ``jax.grad``, and apply the
+per-layer solver updates — all inside a single XLA computation, so one
+training iteration is one device dispatch with zero host round-trips.
+
+The unit graph stays as orchestration (loader/decision/services); a
+:class:`veles_tpu.models.fused.FusedTrainer` unit swaps itself in for the
+forward+evaluator+GD chain.  Parity between the fused step and the
+per-unit path is covered by tests/test_compiler.py.
+
+Sharding: pass ``mesh`` + ``state_shardings``/``batch_sharding`` and the
+step is jitted with those shardings; XLA inserts the ICI collectives
+(psum for the data-parallel gradient merge) automatically — the
+scaling-book recipe replacing the reference's ZMQ parameter-server data
+plane.
+"""
+
+import functools
+
+import numpy
+
+from veles_tpu.models.nn_units import GradientDescentBase
+
+__all__ = ["LayerPlan", "build_train_step", "build_forward",
+           "workflow_plan", "extract_state", "adopt_state"]
+
+
+class LayerPlan(object):
+    """Static per-layer compile info: forward class, solver, hyper."""
+
+    def __init__(self, forward_cls, solver="momentum", hyper=None,
+                 include_bias=True):
+        self.forward_cls = forward_cls
+        self.solver = solver
+        self.hyper = hyper or {}
+        self.include_bias = include_bias
+
+    def hyper_full(self):
+        base = {
+            "learning_rate": 0.01, "learning_rate_bias": None,
+            "weights_decay": 0.0, "weights_decay_bias": 0.0,
+            "l1_vs_l2": 0.0, "gradient_moment": 0.0,
+            "gradient_moment_bias": None, "adadelta_rho": 0.95,
+            "solver_epsilon": 1e-6,
+        }
+        base.update(self.hyper)
+        if base["learning_rate_bias"] is None:
+            base["learning_rate_bias"] = base["learning_rate"]
+        if base["gradient_moment_bias"] is None:
+            base["gradient_moment_bias"] = base["gradient_moment"]
+        return base
+
+
+def workflow_plan(sw):
+    """Extract LayerPlans from a StandardWorkflow."""
+    plans = []
+    for fwd, gd in zip(sw.forwards, sw.gds):
+        plans.append(LayerPlan(
+            type(fwd), solver=gd.solver, hyper=gd.hyper_dict(),
+            include_bias=fwd.include_bias))
+    return plans
+
+
+def extract_state(sw):
+    """Pull per-layer param+solver-state pytree out of workflow Arrays."""
+    state = []
+    for fwd, gd in zip(sw.forwards, sw.gds):
+        entry = {}
+        for key, arr in (("weights", fwd.weights), ("bias", fwd.bias),
+                         ("accum_weights", gd.accum_weights),
+                         ("accum_bias", gd.accum_bias),
+                         ("accum2_weights", gd.accum2_weights),
+                         ("accum2_bias", gd.accum2_bias)):
+            entry[key] = arr.devmem if arr else None
+        state.append(entry)
+    return state
+
+
+def adopt_state(sw, new_state, device=None):
+    """Write a fused-step result back into the workflow's Arrays."""
+    for (fwd, gd), entry in zip(zip(sw.forwards, sw.gds), new_state):
+        for key, arr in (("weights", fwd.weights), ("bias", fwd.bias),
+                         ("accum_weights", gd.accum_weights),
+                         ("accum_bias", gd.accum_bias),
+                         ("accum2_weights", gd.accum2_weights),
+                         ("accum2_bias", gd.accum2_bias)):
+            if entry.get(key) is not None and arr:
+                arr.set_device_array(entry[key], device or fwd.device)
+
+
+def _forward_for_loss(plans, params, x):
+    """Forward pass; returns (pre-softmax logits | final output)."""
+    from veles_tpu.models.all2all import All2All, All2AllSoftmax
+    h = x
+    for plan, p in zip(plans, params):
+        if plan.forward_cls is All2AllSoftmax:
+            # keep logits for a numerically-stable CE
+            h = All2All.apply(p, h)
+        else:
+            h = plan.forward_cls.apply(p, h)
+    return h
+
+
+def build_forward(plans):
+    """Pure inference fn(params_list, x) -> output (probs for softmax)."""
+    def forward(params, x):
+        import jax
+        from veles_tpu.models.all2all import All2AllSoftmax
+        h = _forward_for_loss(plans, params, x)
+        if plans and plans[-1].forward_cls is All2AllSoftmax:
+            h = jax.nn.softmax(h, axis=-1)
+        return h
+    return forward
+
+
+def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
+                     state_shardings=None, batch_sharding=None,
+                     donate=True):
+    """Compile fn(state, x, labels_or_targets, batch_size) ->
+    (new_state, metrics).
+
+    state: list of dicts (weights/bias/accum*); metrics: {"loss", "n_err"}
+    (classification) or {"loss"} (mse).  batch_size is a traced scalar so
+    short minibatches don't retrigger compilation.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    hypers = [p.hyper_full() for p in plans]
+
+    def loss_fn(params, x, target, batch_size):
+        out = _forward_for_loss(plans, params, x)
+        if loss == "softmax":
+            labels = target
+            valid = labels >= 0
+            safe = jnp.where(valid, labels, 0)
+            logp = jax.nn.log_softmax(out)
+            picked = logp[jnp.arange(out.shape[0]), safe]
+            total = -jnp.sum(picked * valid)
+            pred = jnp.argmax(out, axis=-1)
+            n_err = jnp.sum((pred != safe) & valid)
+            return total / batch_size, n_err
+        # mse
+        out2 = out.reshape(out.shape[0], -1)
+        t2 = target.reshape(target.shape[0], -1)
+        mask = (jnp.arange(out2.shape[0]) < batch_size
+                ).astype(out2.dtype)[:, None]
+        diff = (out2 - t2) * mask
+        return jnp.sum(diff * diff) / batch_size, jnp.zeros((), jnp.int32)
+
+    def step(state, x, target, batch_size):
+        params = [{"weights": s["weights"], "bias": s["bias"]}
+                  for s in state]
+        (loss_value, n_err), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, x, target, batch_size)
+        new_state = []
+        for plan, hyper, s, g in zip(plans, hypers, state, grads):
+            W = s["weights"]
+            gw = GradientDescentBase.regularized(
+                g["weights"].astype(W.dtype), W,
+                hyper["weights_decay"], hyper["l1_vs_l2"])
+            new_w, acc_w, acc2_w = GradientDescentBase.solver_update(
+                plan.solver, W, gw, s["accum_weights"],
+                s["accum2_weights"], hyper["learning_rate"],
+                hyper["gradient_moment"], hyper["adadelta_rho"],
+                hyper["solver_epsilon"])
+            entry = {"weights": new_w, "accum_weights": acc_w,
+                     "accum2_weights": acc2_w,
+                     "bias": s["bias"], "accum_bias": s["accum_bias"],
+                     "accum2_bias": s["accum2_bias"]}
+            if plan.include_bias and s["bias"] is not None:
+                b = s["bias"]
+                gb = GradientDescentBase.regularized(
+                    g["bias"].astype(b.dtype), b,
+                    hyper["weights_decay_bias"], hyper["l1_vs_l2"])
+                new_b, acc_b, acc2_b = GradientDescentBase.solver_update(
+                    plan.solver, b, gb, s["accum_bias"], s["accum2_bias"],
+                    hyper["learning_rate_bias"],
+                    hyper["gradient_moment_bias"], hyper["adadelta_rho"],
+                    hyper["solver_epsilon"])
+                entry.update({"bias": new_b, "accum_bias": acc_b,
+                              "accum2_bias": acc2_b})
+            new_state.append(entry)
+        metrics = {"loss": loss_value, "n_err": n_err}
+        return new_state, metrics
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    if mesh is not None and state_shardings is not None:
+        jit_kwargs["in_shardings"] = (
+            state_shardings, batch_sharding, batch_sharding and
+            _labels_sharding(mesh, data_axis, loss), None)
+        jit_kwargs["out_shardings"] = (state_shardings, None)
+    return jax.jit(step, **jit_kwargs)
+
+
+def _labels_sharding(mesh, data_axis, loss):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(data_axis))

@@ -1,0 +1,17 @@
+"""Distributed execution over device meshes (ICI/DCN).
+
+This package replaces the reference's entire L5 data plane (ZeroMQ
+pickled tensors between master and slaves, SURVEY.md section 2.6) with
+the TPU-native model: a ``jax.sharding.Mesh`` over the pod, sharding
+annotations on the fused train step, and XLA-inserted collectives riding
+ICI.  The master-slave *control* semantics (job bookkeeping, elastic
+requeue) stay in veles_tpu.server/client as a host-side concern.
+
+- mesh.py   — mesh discovery/construction (devices -> named axes)
+- api.py    — shard/replicate placement helpers + DP/TP sharding rules
+              for the fused train step
+"""
+
+from veles_tpu.parallel.mesh import make_mesh, auto_mesh  # noqa: F401
+from veles_tpu.parallel.api import (  # noqa: F401
+    replicate, shard_batch, mlp_state_shardings, batch_sharding)

@@ -1,0 +1,47 @@
+"""Device-mesh construction.
+
+Replaces the reference Launcher's socket handshake + SSH node discovery
+(launcher.py:808-906) with JAX topology discovery: ``jax.devices()``
+enumerates the slice; multi-host processes call
+``jax.distributed.initialize`` (veles_tpu.launcher does this when
+VELES_COORDINATOR is set) and get the same global view.
+"""
+
+import numpy
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "auto_mesh"]
+
+
+def make_mesh(axes, devices=None):
+    """axes: dict name -> size, e.g. {"data": 4, "model": 2}.
+
+    Sizes must multiply to the device count; -1 once means "the rest".
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = int(numpy.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if len(devices) % known:
+            raise ValueError(
+                "cannot infer -1 axis: %d devices over %d" %
+                (len(devices), known))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(numpy.prod(sizes))
+    if total != len(devices):
+        raise ValueError("mesh %s needs %d devices, have %d" %
+                         (axes, total, len(devices)))
+    grid = numpy.array(devices, dtype=object).reshape(sizes)
+    return Mesh(grid, names)
+
+
+def auto_mesh(data_axis="data", devices=None):
+    """All devices on one data-parallel axis — the reference's only
+    tensor-level strategy (parameter-server DP, SURVEY.md section 2.6)."""
+    devices = list(devices if devices is not None else jax.devices())
+    return make_mesh({data_axis: len(devices)}, devices)
