@@ -1,0 +1,198 @@
+"""Conv/pooling/dropout tests: backward-vs-autodiff oracles and a
+LeNet-style conv workflow end-to-end on synthetic images."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader import FullBatchLoader
+from veles_tpu.models.conv import Conv, ConvTanh
+from veles_tpu.models.gd_conv import GDConvTanh
+from veles_tpu.models.pooling import AvgPooling, MaxPooling
+from veles_tpu.models.gd_pooling import GDAvgPooling, GDMaxPooling
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+
+
+def test_conv_forward_shape_and_value():
+    rng = numpy.random.RandomState(0)
+    x = rng.randn(2, 8, 8, 3).astype(numpy.float32)
+    W = rng.randn(3, 3, 3, 5).astype(numpy.float32)
+    b = rng.randn(5).astype(numpy.float32)
+    y = numpy.asarray(Conv.apply(
+        {"weights": W, "bias": b}, x, padding=(1, 1, 1, 1),
+        sliding=(1, 1)))
+    assert y.shape == (2, 8, 8, 5)
+    # spot-check one output against a manual dot product
+    patch = numpy.zeros((3, 3, 3), numpy.float32)
+    patch[:, :, :] = x[0, 0:3, 0:3, :]
+    manual = (patch[..., None] * W).sum((0, 1, 2)) + b
+    numpy.testing.assert_allclose(y[0, 1, 1], manual, rtol=1e-4)
+
+
+def test_gd_conv_matches_autodiff():
+    rng = numpy.random.RandomState(1)
+    x = rng.randn(4, 6, 6, 2).astype(numpy.float32)
+    W = (rng.randn(3, 3, 2, 4) * 0.5).astype(numpy.float32)
+    b = numpy.zeros(4, numpy.float32)
+    y = numpy.asarray(ConvTanh.apply(
+        {"weights": W, "bias": b}, x, padding=(0, 0, 0, 0),
+        sliding=(1, 1)))
+    err_const = rng.randn(*y.shape).astype(numpy.float32)
+
+    def loss(params, xv):
+        out = ConvTanh.apply(params, xv, padding=(0, 0, 0, 0),
+                             sliding=(1, 1))
+        return jnp.sum(out * err_const)
+
+    grads = jax.grad(loss, argnums=(0, 1))({"weights": W, "bias": b}, x)
+
+    state = {"weights": W, "bias": b,
+             "accum_weights": numpy.zeros_like(W),
+             "accum_bias": numpy.zeros_like(b),
+             "accum2_weights": None, "accum2_bias": None}
+    hyper = {"learning_rate": 0.1, "learning_rate_bias": 0.1,
+             "weights_decay": 0.0, "weights_decay_bias": 0.0,
+             "l1_vs_l2": 0.0, "gradient_moment": 0.0,
+             "gradient_moment_bias": 0.0, "adadelta_rho": 0.95,
+             "solver_epsilon": 1e-6}
+    err_input, new_state = GDConvTanh.backward(
+        state, hyper, x, y, err_const, solver="momentum",
+        include_bias=True, need_err_input=True,
+        padding=(0, 0, 0, 0), sliding=(1, 1))
+
+    numpy.testing.assert_allclose(
+        numpy.asarray(new_state["weights"]),
+        W - 0.1 * numpy.asarray(grads[0]["weights"]), rtol=1e-3,
+        atol=1e-4)
+    numpy.testing.assert_allclose(
+        numpy.asarray(err_input), numpy.asarray(grads[1]), rtol=1e-3,
+        atol=1e-4)
+
+
+@pytest.mark.parametrize("pool_cls,gd_cls", [
+    (MaxPooling, GDMaxPooling), (AvgPooling, GDAvgPooling)])
+def test_gd_pooling_matches_autodiff(pool_cls, gd_cls):
+    rng = numpy.random.RandomState(2)
+    x = rng.randn(3, 6, 6, 2).astype(numpy.float32)
+    y = numpy.asarray(pool_cls.apply({}, x, window=(2, 2), sliding=(2, 2)))
+    assert y.shape == (3, 3, 3, 2)
+    err_const = rng.randn(*y.shape).astype(numpy.float32)
+
+    def loss(xv):
+        return jnp.sum(pool_cls.apply({}, xv, window=(2, 2),
+                                      sliding=(2, 2)) * err_const)
+
+    gx = numpy.asarray(jax.grad(loss)(x))
+    err_input, _ = gd_cls.backward(
+        {"weights": None}, {}, x, y, err_const, solver="momentum",
+        include_bias=False, need_err_input=True, window=(2, 2),
+        sliding=(2, 2))
+    numpy.testing.assert_allclose(numpy.asarray(err_input), gx, rtol=1e-4,
+                                  atol=1e-5)
+
+
+def test_pooling_ceil_mode_covers_input():
+    x = numpy.arange(25, dtype=numpy.float32).reshape(1, 5, 5, 1)
+    y = numpy.asarray(MaxPooling.apply({}, x, window=(2, 2),
+                                       sliding=(2, 2)))
+    assert y.shape == (1, 3, 3, 1)
+    assert y[0, 2, 2, 0] == 24  # bottom-right partial window
+
+
+# ------------------------------------------------------------- end-to-end
+
+class TinyImageLoader(FullBatchLoader):
+    """8x8 synthetic 3-class images: class = which quadrant is bright."""
+
+    def load_data(self):
+        self.class_lengths[:] = [0, 48, 192]
+        self._calc_class_end_offsets()
+        self.create_originals((8, 8, 1))
+        rng = numpy.random.RandomState(5)
+        for i in range(self.total_samples):
+            label = i % 3
+            img = rng.rand(8, 8, 1).astype(numpy.float32) * 0.3
+            r, c = divmod(label, 2)
+            img[r * 4:(r + 1) * 4, c * 4:(c + 1) * 4, 0] += 1.0
+            self.original_data.mem[i] = img
+            self.original_labels[i] = label
+
+
+def test_lenet_style_workflow_trains(cpu_device):
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "conv_tanh", "n_kernels": 8, "kx": 3, "ky": 3,
+             "padding": 1, "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "all2all_tanh", "output_sample_shape": 24,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 3,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: TinyImageLoader(
+            w, minibatch_size=48, prng=RandomGenerator("img", seed=3)),
+        decision_config=dict(max_epochs=8),
+    )
+    sw.initialize(device=cpu_device)
+    assert sw.forwards[0].weights.shape == (3, 3, 1, 8)
+    assert sw.forwards[1].output.shape == (48, 4, 4, 8)
+    sw.run()
+    assert sw.decision.epoch_metrics[1] is not None
+    assert sw.decision.epoch_metrics[1] < 10.0, \
+        "validation error %.2f%%" % sw.decision.epoch_metrics[1]
+
+
+def test_dropout_workflow_trains(cpu_device):
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "dropout", "dropout_ratio": 0.2},
+            {"type": "softmax", "output_sample_shape": 3,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: TinyImageLoader(
+            w, minibatch_size=48, prng=RandomGenerator("img2", seed=4)),
+        decision_config=dict(max_epochs=8),
+    )
+    sw.initialize(device=cpu_device)
+    sw.run()
+    assert sw.decision.epoch_metrics[1] < 15.0
+
+
+def test_fused_conv_workflow_matches(cpu_device):
+    """compiler fuses conv+pooling plans too."""
+    from veles_tpu.compiler import build_train_step, workflow_plan
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "conv_tanh", "n_kernels": 4, "kx": 3, "ky": 3,
+             "learning_rate": 0.1},
+            {"type": "avg_pooling", "kx": 2, "ky": 2},
+            {"type": "softmax", "output_sample_shape": 3,
+             "learning_rate": 0.1},
+        ],
+        loader_factory=lambda w: TinyImageLoader(
+            w, minibatch_size=48, prng=RandomGenerator("img3", seed=5)),
+        decision_config=dict(max_epochs=2),
+    )
+    sw.initialize(device=cpu_device)
+    plans = workflow_plan(sw)
+    step = build_train_step(plans, donate=False)
+    from veles_tpu.compiler import extract_state
+    state = extract_state(sw)
+    rng = numpy.random.RandomState(0)
+    x = rng.rand(48, 8, 8, 1).astype(numpy.float32)
+    labels = rng.randint(0, 3, 48).astype(numpy.int32)
+    new_state, metrics = step(state, x, labels, numpy.float32(48))
+    assert numpy.isfinite(float(metrics["loss"]))
+    assert new_state[0]["weights"].shape == (3, 3, 1, 4)

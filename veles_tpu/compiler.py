@@ -33,11 +33,12 @@ class LayerPlan(object):
     """Static per-layer compile info: forward class, solver, hyper."""
 
     def __init__(self, forward_cls, solver="momentum", hyper=None,
-                 include_bias=True):
+                 include_bias=True, static=None):
         self.forward_cls = forward_cls
         self.solver = solver
         self.hyper = hyper or {}
         self.include_bias = include_bias
+        self.static = static or {}
 
     def hyper_full(self):
         base = {
@@ -61,7 +62,7 @@ def workflow_plan(sw):
     for fwd, gd in zip(sw.forwards, sw.gds):
         plans.append(LayerPlan(
             type(fwd), solver=gd.solver, hyper=gd.hyper_dict(),
-            include_bias=fwd.include_bias))
+            include_bias=fwd.include_bias, static=fwd.static_config()))
     return plans
 
 
@@ -101,7 +102,7 @@ def _forward_for_loss(plans, params, x):
             # keep logits for a numerically-stable CE
             h = All2All.apply(p, h)
         else:
-            h = plan.forward_cls.apply(p, h)
+            h = plan.forward_cls.apply(p, h, **plan.static)
     return h
 
 
@@ -159,6 +160,9 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
             loss_fn, has_aux=True)(params, x, target, batch_size)
         new_state = []
         for plan, hyper, s, g in zip(plans, hypers, state, grads):
+            if s["weights"] is None:  # param-less layer (pooling, ...)
+                new_state.append(dict(s))
+                continue
             W = s["weights"]
             gw = GradientDescentBase.regularized(
                 g["weights"].astype(W.dtype), W,

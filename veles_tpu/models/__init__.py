@@ -25,4 +25,14 @@ from veles_tpu.models.evaluator import (  # noqa: F401
     EvaluatorSoftmax, EvaluatorMSE)
 from veles_tpu.models.gd import (  # noqa: F401
     GradientDescent, GDTanh, GDRELU, GDStrictRELU, GDSigmoid, GDSoftmax)
-from veles_tpu.models.decision import DecisionGD  # noqa: F401
+from veles_tpu.models.decision import DecisionGD, DecisionMSE  # noqa: F401
+from veles_tpu.models.conv import (  # noqa: F401
+    Conv, ConvTanh, ConvRELU, ConvStrictRELU, ConvSigmoid)
+from veles_tpu.models.pooling import (  # noqa: F401
+    MaxPooling, AvgPooling, MaxAbsPooling)
+from veles_tpu.models.gd_conv import (  # noqa: F401
+    GDConv, GDConvTanh, GDConvRELU, GDConvStrictRELU, GDConvSigmoid)
+from veles_tpu.models.gd_pooling import (  # noqa: F401
+    GDMaxPooling, GDAvgPooling, GDMaxAbsPooling)
+from veles_tpu.models.dropout import (  # noqa: F401
+    DropoutForward, DropoutBackward)

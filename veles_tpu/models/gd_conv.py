@@ -1,0 +1,91 @@
+"""Gradient-descent units for conv layers.
+
+Znicz-equivalent gd_conv family.  The backward runs as ONE jitted call:
+activation derivative (in terms of y), then ``jax.vjp`` of the pure
+linear conv — XLA emits the transposed-conv kernels for dW and dx the
+same way the hand-written CUDA backward kernels did, but fused and
+MXU-tiled.
+"""
+
+from veles_tpu.models.conv import Conv, _norm_padding
+from veles_tpu.models.gd import (
+    GDRELU, GDSigmoid, GDStrictRELU, GDTanh, GradientDescent)
+from veles_tpu.models.nn_units import GradientDescentBase
+
+__all__ = ["GDConv", "GDConvTanh", "GDConvRELU", "GDConvStrictRELU",
+           "GDConvSigmoid"]
+
+
+class GDConv(GradientDescent):
+    MAPPING = "conv"
+
+    def __init__(self, workflow, **kwargs):
+        super(GDConv, self).__init__(workflow, **kwargs)
+        self.sliding = tuple(kwargs.get("sliding", (1, 1)))
+        self.padding = _norm_padding(kwargs.get("padding", 0))
+
+    def backward_static(self):
+        return {"padding": self.padding, "sliding": self.sliding}
+
+    @classmethod
+    def backward(cls, state, hyper, x, y, err_output, *, solver,
+                 include_bias, need_err_input,
+                 padding=(0, 0, 0, 0), sliding=(1, 1)):
+        import jax
+        import jax.numpy as jnp
+        W = state["weights"]
+        err = cls._activation_grad(y, err_output).astype(x.dtype)
+
+        def lin(W_, x_):
+            return Conv.apply({"weights": W_, "bias": None}, x_,
+                              padding=padding, sliding=sliding)
+
+        _, vjp = jax.vjp(lin, W, x)
+        grad_w, err_input = vjp(err)
+        if not need_err_input:
+            err_input = None
+
+        grad_w = GradientDescentBase.regularized(
+            grad_w.astype(jnp.float32), W, hyper["weights_decay"],
+            hyper["l1_vs_l2"])
+        new_w, acc_w, acc2_w = GradientDescentBase.solver_update(
+            solver, W, grad_w.astype(W.dtype), state["accum_weights"],
+            state["accum2_weights"], hyper["learning_rate"],
+            hyper["gradient_moment"], hyper["adadelta_rho"],
+            hyper["solver_epsilon"])
+        new_state = {"weights": new_w, "accum_weights": acc_w,
+                     "accum2_weights": acc2_w}
+
+        if include_bias:
+            b = state["bias"]
+            grad_b = err.astype(jnp.float32).sum(axis=(0, 1, 2))
+            grad_b = GradientDescentBase.regularized(
+                grad_b, b, hyper["weights_decay_bias"], hyper["l1_vs_l2"])
+            new_b, acc_b, acc2_b = GradientDescentBase.solver_update(
+                solver, b, grad_b.astype(b.dtype), state["accum_bias"],
+                state["accum2_bias"], hyper["learning_rate_bias"],
+                hyper["gradient_moment_bias"], hyper["adadelta_rho"],
+                hyper["solver_epsilon"])
+            new_state.update({"bias": new_b, "accum_bias": acc_b,
+                              "accum2_bias": acc2_b})
+        return err_input, new_state
+
+
+class GDConvTanh(GDConv):
+    MAPPING = "conv_tanh"
+    _activation_grad = staticmethod(GDTanh._activation_grad)
+
+
+class GDConvRELU(GDConv):
+    MAPPING = "conv_relu"
+    _activation_grad = staticmethod(GDRELU._activation_grad)
+
+
+class GDConvStrictRELU(GDConv):
+    MAPPING = "conv_str"
+    _activation_grad = staticmethod(GDStrictRELU._activation_grad)
+
+
+class GDConvSigmoid(GDConv):
+    MAPPING = "conv_sigmoid"
+    _activation_grad = staticmethod(GDSigmoid._activation_grad)

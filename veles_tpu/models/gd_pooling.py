@@ -1,0 +1,58 @@
+"""Backward units for pooling layers (Znicz-equivalent gd_pooling).
+
+No trainable state; err_input comes from ``jax.vjp`` of the pooling
+forward — XLA emits select-and-scatter for max pooling (replacing the
+reference's stored-offset scatter kernel) and a uniform spread for avg.
+"""
+
+from veles_tpu.models.nn_units import GradientDescentBase
+from veles_tpu.models.pooling import AvgPooling, MaxAbsPooling, MaxPooling
+
+__all__ = ["GDMaxPooling", "GDAvgPooling", "GDMaxAbsPooling"]
+
+
+class GDPoolingBase(GradientDescentBase):
+    FORWARD_CLS = None
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("include_bias", False)
+        super(GDPoolingBase, self).__init__(workflow, **kwargs)
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        self.sliding = tuple(kwargs.get("sliding", (self.kx, self.ky)))
+        # pooling has no params; drop the weights demand
+        self._demanded.discard("weights")
+
+    def backward_static(self):
+        return {"window": (self.ky, self.kx), "sliding": self.sliding}
+
+    def _init_solver_state(self):
+        pass
+
+    @classmethod
+    def backward(cls, state, hyper, x, y, err_output, *, solver,
+                 include_bias, need_err_input, window=None, sliding=None):
+        import jax
+        fwd = cls.FORWARD_CLS
+
+        def pool(x_):
+            return fwd.apply({}, x_, window=window, sliding=sliding)
+
+        _, vjp = jax.vjp(pool, x)
+        (err_input,) = vjp(err_output.astype(x.dtype))
+        return err_input, {}
+
+
+class GDMaxPooling(GDPoolingBase):
+    MAPPING = "max_pooling"
+    FORWARD_CLS = MaxPooling
+
+
+class GDMaxAbsPooling(GDPoolingBase):
+    MAPPING = "maxabs_pooling"
+    FORWARD_CLS = MaxAbsPooling
+
+
+class GDAvgPooling(GDPoolingBase):
+    MAPPING = "avg_pooling"
+    FORWARD_CLS = AvgPooling

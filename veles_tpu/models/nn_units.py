@@ -101,9 +101,14 @@ class ForwardBase(Unit):
     # -- the pure functions -------------------------------------------------
 
     @staticmethod
-    def apply(params, x):
-        """params dict, x device array -> output device array."""
+    def apply(params, x, **static):
+        """params dict, x device array -> output device array.  ``static``
+        holds compile-time layer config (strides, padding, ...)."""
         raise NotImplementedError
+
+    def static_config(self):
+        """Compile-time kwargs baked into the jitted apply."""
+        return {}
 
     def params_dict(self):
         return {"weights": self.weights.devmem,
@@ -125,16 +130,19 @@ class ForwardBase(Unit):
             self._numpy_run()
 
     def _device_run(self):
+        import functools
         import jax
         if self._jit_fn_ is None:
-            self._jit_fn_ = jax.jit(type(self).apply)
+            self._jit_fn_ = jax.jit(functools.partial(
+                type(self).apply, **self.static_config()))
         out = self._jit_fn_(self.params_dict(), self.input.devmem)
         self.output.set_device_array(out, self.device)
 
     def _numpy_run(self):
         params = self.params_numpy()
         self.input.map_read()
-        out = numpy.asarray(type(self).apply(params, self.input.mem))
+        out = numpy.asarray(type(self).apply(
+            params, self.input.mem, **self.static_config()))
         self.output.map_invalidate()
         self.output.mem = out
 
@@ -265,9 +273,13 @@ class GradientDescentBase(Unit):
 
     @staticmethod
     def backward(state, hyper, x, y, err_output, *, solver, include_bias,
-                 need_err_input):
+                 need_err_input, **static):
         """state dict (weights/bias/accums) -> (err_input, new_state)."""
         raise NotImplementedError
+
+    def backward_static(self):
+        """Compile-time kwargs baked into the jitted backward."""
+        return {}
 
     def state_dict(self):
         d = {"weights": self.weights.devmem,
@@ -335,7 +347,8 @@ class GradientDescentBase(Unit):
             self._jit_fn_ = jax.jit(functools.partial(
                 type(self).backward, solver=self.solver,
                 include_bias=self.include_bias and bool(self.bias),
-                need_err_input=self.need_err_input))
+                need_err_input=self.need_err_input,
+                **self.backward_static()))
         err_input, new_state = self._jit_fn_(
             self.state_dict(), self.hyper_dict(),
             self.input.devmem, self.output.devmem, self.err_output.devmem)
@@ -351,7 +364,8 @@ class GradientDescentBase(Unit):
             self.input.mem, self.output.mem, self.err_output.mem,
             solver=self.solver,
             include_bias=self.include_bias and bool(self.bias),
-            need_err_input=self.need_err_input)
+            need_err_input=self.need_err_input,
+            **self.backward_static())
         if self.need_err_input and err_input is not None:
             self.err_input.map_invalidate()
             self.err_input.mem = numpy.asarray(err_input)

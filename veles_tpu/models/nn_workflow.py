@@ -31,28 +31,20 @@ def _build_mapping(module, base):
 
 
 def forward_mapping():
+    from veles_tpu.models import activation, conv, dropout, pooling
     from veles_tpu.models.nn_units import ForwardBase
-    mapping = _build_mapping(all2all, ForwardBase)
-    try:  # conv family registers once implemented
-        from veles_tpu.models import conv, pooling
-        from veles_tpu.models.nn_units import ForwardBase as FB
-        mapping.update(_build_mapping(conv, FB))
-        mapping.update(_build_mapping(pooling, FB))
-    except ImportError:
-        pass
+    mapping = {}
+    for module in (all2all, conv, pooling, dropout, activation):
+        mapping.update(_build_mapping(module, ForwardBase))
     return mapping
 
 
 def gd_mapping():
+    from veles_tpu.models import activation, dropout, gd_conv, gd_pooling
     from veles_tpu.models.nn_units import GradientDescentBase
-    mapping = _build_mapping(gd_module, GradientDescentBase)
-    try:
-        from veles_tpu.models import gd_conv, gd_pooling
-        from veles_tpu.models.nn_units import GradientDescentBase as GB
-        mapping.update(_build_mapping(gd_conv, GB))
-        mapping.update(_build_mapping(gd_pooling, GB))
-    except ImportError:
-        pass
+    mapping = {}
+    for module in (gd_module, gd_conv, gd_pooling, dropout, activation):
+        mapping.update(_build_mapping(module, GradientDescentBase))
     return mapping
 
 
@@ -88,6 +80,8 @@ class StandardWorkflow(Workflow):
             unit.link_from(self.forwards[-1] if self.forwards
                            else self.loader)
             unit.link_attrs(src_unit, ("input", src_attr))
+            if "minibatch_class" in unit._demanded:  # dropout et al.
+                unit.link_attrs(self.loader, "minibatch_class")
             self.forwards.append(unit)
             src_unit, src_attr = unit, "output"
 
@@ -128,6 +122,8 @@ class StandardWorkflow(Workflow):
             unit = gmap[ltype](self, need_err_input=(i > 0), **spec)
             fwd = self.forwards[i]
             unit.link_attrs(fwd, "input", "output", "weights", "bias")
+            if "mask" in unit._demanded:  # dropout backward
+                unit.link_attrs(fwd, "mask")
             if prev_gd is None:
                 unit.link_from(self.decision)
                 unit.link_attrs(self.evaluator, "err_output")

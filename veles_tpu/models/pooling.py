@@ -1,0 +1,118 @@
+"""Pooling forward units (Znicz-equivalent pooling / max_pooling /
+avg_pooling with stride "sliding"; depooling lives with the autoencoder
+family).  ``lax.reduce_window`` lowers straight to the TPU vector unit.
+
+Znicz's MaxPooling recorded arg-offsets into ``input_offset`` for the
+backward pass; here the backward (gd_pooling) recomputes the routing via
+``jax.vjp`` of this same pure function, which XLA turns into the
+select-and-scatter op — no stored indices, no HBM traffic for them.
+"""
+
+import numpy
+
+from veles_tpu.models.nn_units import ForwardBase
+
+__all__ = ["MaxPooling", "AvgPooling", "MaxAbsPooling"]
+
+
+class PoolingBase(ForwardBase):
+    """kwargs: kx, ky (window), sliding=(sx, sy) default = window."""
+
+    def __init__(self, workflow, **kwargs):
+        super(PoolingBase, self).__init__(workflow, **kwargs)
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        self.sliding = tuple(kwargs.get("sliding", (self.kx, self.ky)))
+        self.include_bias = False
+
+    def static_config(self):
+        return {"window": (self.ky, self.kx), "sliding": self.sliding}
+
+    def param_arrays(self):
+        return []
+
+    def params_dict(self):
+        return {}
+
+    def params_numpy(self):
+        return {}
+
+    def output_spatial(self, in_h, in_w):
+        return (_out_len(in_h, self.ky, self.sliding[1]),
+                _out_len(in_w, self.kx, self.sliding[0]))
+
+    def create_params(self):
+        if not self.input or self.input.sample_size == 0:
+            raise AttributeError(
+                "%s: input shape unknown at initialize" % self.name)
+        shape = self.input.shape
+        if len(shape) == 3:
+            batch, in_h, in_w, ch = shape + (1,)
+        else:
+            batch, in_h, in_w, ch = shape
+        if not self.output:
+            out_h, out_w = self.output_spatial(in_h, in_w)
+            self.output.mem = numpy.zeros(
+                (batch, out_h, out_w, ch), numpy.float32)
+
+
+def _out_len(in_len, k, stride):
+    """ceil-mode output length: partial windows at the edge count
+    (Znicz covered the whole input)."""
+    if in_len <= k:
+        return 1
+    return -(-(in_len - k) // stride) + 1
+
+
+def _pool(x, window, sliding, init, op):
+    from jax import lax
+    ky, kx = window
+    sx, sy = sliding
+    pad_h = max(0, (_out_len(x.shape[1], ky, sy) - 1) * sy + ky -
+                x.shape[1])
+    pad_w = max(0, (_out_len(x.shape[2], kx, sx) - 1) * sx + kx -
+                x.shape[2])
+    return lax.reduce_window(
+        x, init, op,
+        window_dimensions=(1, ky, kx, 1),
+        window_strides=(1, sy, sx, 1),
+        padding=((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+
+
+class MaxPooling(PoolingBase):
+    MAPPING = "max_pooling"
+
+    @classmethod
+    def apply(cls, params, x, *, window, sliding):
+        from jax import lax
+        if x.ndim == 3:
+            x = x[..., None]
+        return _pool(x, window, sliding, -numpy.inf, lax.max)
+
+
+class MaxAbsPooling(PoolingBase):
+    """Znicz max_abs: the element with the largest |value| (sign kept)."""
+
+    MAPPING = "maxabs_pooling"
+
+    @classmethod
+    def apply(cls, params, x, *, window, sliding):
+        import jax.numpy as jnp
+        from jax import lax
+        if x.ndim == 3:
+            x = x[..., None]
+        pos = _pool(x, window, sliding, -numpy.inf, lax.max)
+        neg = _pool(-x, window, sliding, -numpy.inf, lax.max)
+        return jnp.where(pos >= neg, pos, -neg)
+
+
+class AvgPooling(PoolingBase):
+    MAPPING = "avg_pooling"
+
+    @classmethod
+    def apply(cls, params, x, *, window, sliding):
+        from jax import lax
+        if x.ndim == 3:
+            x = x[..., None]
+        summed = _pool(x, window, sliding, 0.0, lax.add)
+        return summed / (window[0] * window[1])
