@@ -1,0 +1,48 @@
+"""LR policies + rollback unit tests."""
+
+import numpy
+
+from veles_tpu.dummy import DummyWorkflow, DummyUnit
+from veles_tpu.memory import Array
+from veles_tpu.models.lr_adjust import (
+    LearningRateAdjust, Rollback, exp_policy, fixed_policy, inv_policy,
+    step_exp_policy)
+from veles_tpu.mutable import Bool
+
+
+def test_policies():
+    assert fixed_policy(0.1)(100) == 0.1
+    assert abs(step_exp_policy(0.1, 0.5, 10)(25) - 0.025) < 1e-12
+    assert abs(exp_policy(1.0, 0.9)(2) - 0.81) < 1e-12
+    assert abs(inv_policy(1.0, 1.0, 1.0)(1) - 0.5) < 1e-12
+
+
+def test_lr_adjust_applies_to_gds():
+    wf = DummyWorkflow()
+    gd = DummyUnit(wf, learning_rate=1.0, learning_rate_bias=1.0)
+    adj = LearningRateAdjust(wf, lr_policy=exp_policy(1.0, 0.5))
+    adj.add_gd_unit(gd)
+    adj._is_initialized_ = True
+    adj.run()
+    assert gd.learning_rate == 0.5
+    adj.run()
+    assert gd.learning_rate == 0.25
+
+
+def test_rollback_restores_best():
+    wf = DummyWorkflow()
+    w = Array(numpy.ones(4, numpy.float32))
+    gd = DummyUnit(wf, weights=w, learning_rate=1.0,
+                   learning_rate_bias=1.0)
+    improved = Bool(True)
+    rb = Rollback(wf, lr_cut=0.5)
+    rb.improved = improved
+    rb.add_gd_unit(gd)
+    rb.initialize()
+    rb.run()  # snapshot of ones
+    w.map_write()
+    w.mem[:] = 99.0
+    improved <<= False
+    rb.run()  # slip -> restore
+    numpy.testing.assert_array_equal(w.mem, numpy.ones(4))
+    assert gd.learning_rate == 0.5

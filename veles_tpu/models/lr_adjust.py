@@ -1,0 +1,110 @@
+"""Learning-rate adjusting policies + weights rollback.
+
+Znicz-equivalent lr_adjust / rollback (manualrst_veles_algorithms.rst:
+"learning-rate adjusting & rollback").
+
+Policies mirror Caffe-era Znicz: fixed, step_exp (gamma^floor(it/step)),
+exp (gamma^it), inv (1/(1+gamma*it)^power), arbitrary (user fn).
+The per-unit GD path passes hyperparameters as *traced* scalars, so
+adjusting the learning rate costs NO recompilation; the fused compiler
+path bakes hypers statically and recompiles once per change (adjust per
+epoch, not per minibatch, when using the fused trainer).
+"""
+
+import numpy
+
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+__all__ = ["LearningRateAdjust", "Rollback",
+           "fixed_policy", "step_exp_policy", "exp_policy", "inv_policy"]
+
+
+def fixed_policy(base):
+    return lambda it: base
+
+
+def step_exp_policy(base, gamma, step):
+    return lambda it: base * gamma ** (it // step)
+
+
+def exp_policy(base, gamma):
+    return lambda it: base * gamma ** it
+
+
+def inv_policy(base, gamma, power=1.0):
+    return lambda it: base * (1.0 + gamma * it) ** (-power)
+
+
+class LearningRateAdjust(Unit):
+    """Applies (lr_policy, bias_lr_policy) to the linked GD units each
+    run; ``it`` counts minibatches (Znicz semantics)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(LearningRateAdjust, self).__init__(workflow, **kwargs)
+        self.lr_policy = kwargs.get("lr_policy")
+        self.bias_lr_policy = kwargs.get("bias_lr_policy", self.lr_policy)
+        self.gd_units = []
+        self._iteration = 0
+
+    def add_gd_unit(self, *units):
+        self.gd_units.extend(units)
+        return self
+
+    def run(self):
+        self._iteration += 1
+        for gd in self.gd_units:
+            if self.lr_policy is not None:
+                gd.learning_rate = float(self.lr_policy(self._iteration))
+            if self.bias_lr_policy is not None:
+                gd.learning_rate_bias = float(
+                    self.bias_lr_policy(self._iteration))
+
+
+class Rollback(Unit):
+    """Keeps the best parameter snapshot; on ``slip`` (no improvement)
+    restores it and rescales the learning rate by ``lr_cut`` until
+    ``lr_limit``; improvement refreshes the snapshot.
+
+    Link: ``improved`` from decision, gd units via add_gd_unit.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(Rollback, self).__init__(workflow, **kwargs)
+        self.lr_cut = kwargs.get("lr_cut", 0.5)
+        self.lr_limit = kwargs.get("lr_limit", 1e-8)
+        self.improved = None  # linked Bool from decision
+        self.gd_units = []
+        self._best = {}
+        self.demand("improved")
+
+    def add_gd_unit(self, *units):
+        self.gd_units.extend(units)
+        return self
+
+    def _param_arrays(self, gd):
+        out = []
+        for name in ("weights", "bias", "accum_weights", "accum_bias",
+                     "accum2_weights", "accum2_bias"):
+            arr = getattr(gd, name, None)
+            if isinstance(arr, Array) and arr:
+                out.append((name, arr))
+        return out
+
+    def run(self):
+        if bool(self.improved) or not self._best:
+            for i, gd in enumerate(self.gd_units):
+                for name, arr in self._param_arrays(gd):
+                    arr.map_read()
+                    self._best[(i, name)] = numpy.array(arr.mem)
+            return
+        # slip: restore best params, cut the learning rate
+        for i, gd in enumerate(self.gd_units):
+            for name, arr in self._param_arrays(gd):
+                saved = self._best.get((i, name))
+                if saved is not None:
+                    arr.map_invalidate()
+                    arr.mem = numpy.array(saved)
+            if gd.learning_rate * self.lr_cut >= self.lr_limit:
+                gd.learning_rate *= self.lr_cut
+                gd.learning_rate_bias *= self.lr_cut
