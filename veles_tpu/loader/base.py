@@ -94,6 +94,10 @@ class Loader(Unit):
         self._minibatch_size_ = 0
         self.pending_minibatches_ = defaultdict(list)
         self._serve_log_time_ = time.time()
+        # When applying a slave's update, flags must be computed against
+        # the global offset AS OF that job's serve (the loader may have
+        # served ahead under async pipelining); None -> live offset.
+        self._flags_global_offset_ = None
 
     # -- pickling: pending -> failed (reference loader/base.py:216-232) ----
 
@@ -280,12 +284,18 @@ class Loader(Unit):
         if slave is None:
             return
         try:
-            self.minibatch_offset, self.minibatch_size = \
-                self.pending_minibatches_[slave.id].pop()
+            job = self.pending_minibatches_[slave.id].pop()
         except (KeyError, IndexError):
             raise LoaderError(
                 "no pending minibatch for slave %s" % slave.id)
-        self._on_successful_serve()
+        offset, size, mb_class, global_snapshot = job
+        self.minibatch_class = mb_class
+        self._flags_global_offset_ = global_snapshot
+        try:
+            self.minibatch_offset, self.minibatch_size = offset, size
+            self._on_successful_serve()
+        finally:
+            self._flags_global_offset_ = None
         if not self.has_data_for_slave:
             self.has_data_for_slave = bool(self.last_minibatch)
 
@@ -317,11 +327,14 @@ class Loader(Unit):
     def serve_next_minibatch(self, slave_id):
         try:
             minibatch_def = self.failed_minibatches.pop()
+            offset, size = minibatch_def[0], minibatch_def[1]
+            self.minibatch_class = minibatch_def[2]
         except IndexError:
-            minibatch_def = self._advance_global_offset()
-        offset, size = minibatch_def
+            offset, size = self._advance_global_offset()
+            minibatch_def = (offset, size, self.minibatch_class,
+                             self.global_offset)
         self.pending_minibatches_[slave_id].append(minibatch_def)
-        self.minibatch_offset, self.minibatch_size = minibatch_def
+        self.minibatch_offset, self.minibatch_size = offset, size
 
         if self.fill_indices(offset - size, size):
             return  # device path filled everything already
@@ -404,10 +417,13 @@ class Loader(Unit):
             callback()
 
     def _class_ended(self):
+        current = (self._flags_global_offset_
+                   if self._flags_global_offset_ is not None
+                   else self.global_offset)
         for offset in self.effective_class_end_offsets:
-            if self.global_offset == offset:
+            if current == offset:
                 return True
-            if self.global_offset < offset:
+            if current < offset:
                 return False
         raise LoaderError("global_offset out of bounds")
 
@@ -429,10 +445,15 @@ class Loader(Unit):
     def _update_flags(self):
         if self.is_slave:
             return  # set explicitly by apply_data_from_master
-        last_mb = (self._class_ended() and
-                   (not self.pending_minibatches_count or
-                    not self.is_master) and
-                   not self.failed_minibatches)
+        if self._flags_global_offset_ is not None:
+            # apply time: the job's own serve-time snapshot decides
+            # whether it closed its class (exact under async pipelining)
+            last_mb = self._class_ended() and not self.failed_minibatches
+        else:
+            last_mb = (self._class_ended() and
+                       (not self.pending_minibatches_count or
+                        not self.is_master) and
+                       not self.failed_minibatches)
         self.last_minibatch <<= last_mb
         self.epoch_ended <<= last_mb and (
             self.minibatch_class == VALID or

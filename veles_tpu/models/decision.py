@@ -121,6 +121,36 @@ class DecisionGD(DecisionBase):
             return None
         return 100.0 * self.epoch_n_err[class_index] / length
 
+    # -- master-slave contract: slaves ship per-job error counts; the
+    # master merges them and performs the class/epoch-end bookkeeping
+    # using its loader's flags (exact in sync mode, VELES-style
+    # approximation under async pipelining).
+
+    def generate_data_for_slave(self, slave=None):
+        return {"complete": bool(self.complete)}
+
+    def apply_data_from_master(self, data):
+        self.complete <<= data.get("complete", False)
+
+    def generate_data_for_master(self):
+        delta = list(self.epoch_n_err)
+        self._reset_epoch_accumulators()
+        return {"n_err": delta}
+
+    def apply_data_from_slave(self, data, slave=None):
+        if not data:
+            return
+        for i, n in enumerate(data.get("n_err", ())):
+            self.epoch_n_err[i] += n
+        if bool(self.last_minibatch):
+            cls = self.minibatch_class
+            self.epoch_metrics[cls] = self._epoch_class_metric(cls)
+            self._on_class_ended(cls)
+        if bool(self.epoch_ended):
+            self._on_epoch_ended()
+        if bool(self.complete) and self.workflow is not None:
+            self.workflow.on_workflow_finished()
+
 
 class DecisionMSE(DecisionBase):
     """Regression: metric = epoch RMSE from evaluator.mse_sum."""

@@ -146,6 +146,52 @@ class ForwardBase(Unit):
         self.output.map_invalidate()
         self.output.mem = out
 
+    # -- master-slave contract (job-farming DP, SURVEY.md section 2.6) -----
+    #
+    # Master ships canonical params with each job; the slave trains on its
+    # minibatch and returns the param DELTA; the master merges deltas
+    # additively (Downpour-style async SGD).  On-pod DP does NOT use this
+    # path — it rides ICI psum via veles_tpu.parallel.
+
+    def generate_data_for_slave(self, slave=None):
+        payload = {}
+        for name, arr in (("weights", self.weights), ("bias", self.bias)):
+            if arr:
+                arr.map_read()
+                payload[name] = numpy.array(arr.mem)
+        return payload or None
+
+    def apply_data_from_master(self, data):
+        if not data:
+            return
+        self._job_start_params_ = {}
+        for name, arr in (("weights", self.weights), ("bias", self.bias)):
+            value = data.get(name)
+            if value is not None and arr:
+                arr.map_invalidate()
+                arr.mem = numpy.array(value)
+                self._job_start_params_[name] = numpy.array(value)
+
+    def generate_data_for_master(self):
+        start = getattr(self, "_job_start_params_", None)
+        if not start:
+            return None
+        delta = {}
+        for name, arr in (("weights", self.weights), ("bias", self.bias)):
+            if name in start and arr:
+                arr.map_read()
+                delta[name] = arr.mem - start[name]
+        return delta or None
+
+    def apply_data_from_slave(self, data, slave=None):
+        if not data:
+            return
+        for name, arr in (("weights", self.weights), ("bias", self.bias)):
+            value = data.get(name)
+            if value is not None and arr:
+                arr.map_write()
+                arr.mem += value
+
 
 class GradientDescentBase(Unit):
     """Backward + parameter update for one forward unit.

@@ -141,5 +141,9 @@ class StandardWorkflow(Workflow):
         self.end_point.gate_block = ~self.decision.complete
 
     def initialize(self, device=None, **kwargs):
+        if self.workflow_mode == "slave":
+            # one job = one pass: a slave must not loop the repeater; the
+            # drained worklist ends the pass (master drives iteration)
+            self.repeater.unlink_from(self.gds[0])
         return super(StandardWorkflow, self).initialize(
             device=device, **kwargs)

@@ -1,0 +1,51 @@
+"""Shared wire protocol for the job-farming control plane.
+
+TPU-native counterpart of reference veles/network_common.py + the
+txzmq streaming-pickle framing (txzmq/connection.py:140).  Design
+difference, documented: the reference split a JSON-line TCP control
+plane from a ZeroMQ pickled-tensor data plane (with posix-shm bypass)
+because slave jobs carried whole minibatches and weight matrices between
+GPU hosts.  On TPU pods tensor traffic rides ICI inside compiled steps
+(veles_tpu.parallel), so this plane only carries job descriptors and
+small deltas: one newline-delimited JSON stream with pickled payloads
+(codec none | gzip, negotiated like the reference's
+none/gzip/snappy/xz set) is sufficient and keeps the elastic semantics
+testable in-process.
+"""
+
+import base64
+import gzip
+import pickle
+import uuid
+
+__all__ = ["encode_payload", "decode_payload", "parse_address", "new_id"]
+
+
+def encode_payload(obj, codec="none"):
+    raw = pickle.dumps(obj, protocol=4)
+    if codec == "gzip":
+        raw = gzip.compress(raw, 1)
+    elif codec != "none":
+        raise ValueError("unknown codec %r" % codec)
+    return {"codec": codec,
+            "b64": base64.b64encode(raw).decode("ascii")}
+
+
+def decode_payload(blob):
+    if blob is None:
+        return None
+    raw = base64.b64decode(blob["b64"])
+    if blob["codec"] == "gzip":
+        raw = gzip.decompress(raw)
+    return pickle.loads(raw)
+
+
+def parse_address(address, default_host="0.0.0.0"):
+    host, sep, port = address.rpartition(":")
+    if not sep:
+        raise ValueError("address must be host:port, got %r" % address)
+    return (host or default_host), int(port)
+
+
+def new_id():
+    return str(uuid.uuid4())

@@ -1,0 +1,286 @@
+"""Master-side control plane: job farming with elastic-failure semantics.
+
+TPU-native counterpart of reference veles/server.py:659.  Preserved
+capabilities (SURVEY.md section 2.6/5):
+
+- handshake validating the workflow CHECKSUM, slave id assignment;
+- per-slave state tracking (the reference's fysom FSM collapses to a
+  dict of outstanding jobs — asyncio replaces Twisted);
+- job generation / update application deferred to a worker thread so the
+  event loop never blocks on workflow code;
+- sync points: a loader that answers "not ready" (False) parks the
+  requester until the next update lands;
+- ADAPTIVE TIMEOUT: a slave whose job takes longer than
+  max(mean + 3 sigma of all job times, job_timeout) is dropped and
+  BLACKLISTED (reference server.py:619-635);
+- drop_slave -> workflow.drop_slave -> loaders requeue the minibatches;
+- respawn hook with exponential backoff (the reference respawned over
+  SSH; on TPU clusters process lifecycle belongs to the scheduler, so
+  the hook takes a user callable).
+"""
+
+import asyncio
+import json
+import threading
+import time
+from collections import deque
+
+from veles_tpu.logger import Logger
+from veles_tpu.network_common import (
+    decode_payload, encode_payload, new_id, parse_address)
+
+__all__ = ["Server", "SlaveDescription"]
+
+
+class SlaveDescription(object):
+    """What workflow code sees as ``slave`` in the data contract."""
+
+    def __init__(self, sid, mid, pid, power):
+        self.id = sid
+        self.mid = mid
+        self.pid = pid
+        self.power = power
+
+    def __repr__(self):
+        return "<Slave %s power=%.1f>" % (self.id[:8], self.power)
+
+
+class _SlaveConn(object):
+    def __init__(self, slave, reader, writer):
+        self.slave = slave
+        self.reader = reader
+        self.writer = writer
+        self.jobs_out = {}          # job_id -> dispatch timestamp
+        self.job_times = deque(maxlen=50)
+        self.parked = False
+
+
+class Server(Logger):
+    """Serve a workflow's jobs to connecting slaves."""
+
+    def __init__(self, address, workflow, launcher=None, codec="none",
+                 job_timeout=60.0, respawn_hook=None):
+        super(Server, self).__init__()
+        self.host, self.port = parse_address(address)
+        self.workflow = workflow
+        self.launcher = launcher
+        self.codec = codec
+        self.job_timeout = job_timeout
+        self.respawn_hook = respawn_hook
+        self.blacklist = set()
+        self.slaves = {}
+        self._waiting = deque()     # parked requesters (sync points)
+        self._all_job_times = deque(maxlen=500)
+        self._loop = None
+        self._server = None
+        self._finishing = False
+        self._done = threading.Event()
+        self.jobs_dispatched = 0
+        self.updates_applied = 0
+
+    # -- public lifecycle ---------------------------------------------------
+
+    def run(self):
+        """Blocking: serve until the workflow finishes."""
+        asyncio.run(self._main())
+
+    def start_background(self):
+        thread = threading.Thread(target=self.run, daemon=True)
+        thread.start()
+        return thread
+
+    def on_workflow_finished(self):
+        self._finishing = True
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._broadcast_stop)
+
+    def stop(self):
+        self.on_workflow_finished()
+
+    def pause(self):
+        self._paused = True
+
+    def resume(self):
+        self._paused = False
+
+    # -- asyncio internals ---------------------------------------------------
+
+    async def _main(self):
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.info("master listening on %s:%d", self.host, self.port)
+        watchdog = asyncio.ensure_future(self._watchdog())
+        try:
+            while not self._finishing:
+                await asyncio.sleep(0.05)
+        finally:
+            watchdog.cancel()
+            self._broadcast_stop()
+            self._server.close()
+            await self._server.wait_closed()
+            self._done.set()
+
+    async def _handle_conn(self, reader, writer):
+        conn = None
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                msg = json.loads(line.decode())
+                conn = await self._dispatch(msg, conn, reader, writer)
+                if conn is None and msg.get("type") != "handshake":
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            self.exception("connection handler failed")
+        finally:
+            if conn is not None:
+                self._drop(conn, "disconnected")
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, msg, conn, reader, writer):
+        mtype = msg.get("type")
+        if mtype == "handshake":
+            return await self._handshake(msg, reader, writer)
+        if conn is None:
+            self._send(writer, {"type": "error",
+                                "reason": "handshake required"})
+            return None
+        if mtype == "job_request":
+            await self._serve_job(conn)
+        elif mtype == "update":
+            await self._apply_update(conn, msg)
+        return conn
+
+    async def _handshake(self, msg, reader, writer):
+        checksum = msg.get("checksum")
+        mid = msg.get("mid", "?")
+        if checksum != self.workflow.checksum:
+            self.warning("rejecting slave %s: checksum mismatch", mid)
+            self._send(writer, {"type": "reject",
+                                "reason": "checksum mismatch"})
+            return None
+        if mid in self.blacklist:
+            self.warning("rejecting blacklisted slave %s", mid)
+            self._send(writer, {"type": "reject",
+                                "reason": "blacklisted"})
+            return None
+        sid = new_id()
+        slave = SlaveDescription(sid, mid, msg.get("pid", 0),
+                                 msg.get("power", 1.0))
+        conn = _SlaveConn(slave, reader, writer)
+        self.slaves[sid] = conn
+        initial = await self._in_thread(
+            self.workflow.generate_initial_data_for_slave, slave)
+        self._send(writer, {
+            "type": "handshake_ack", "id": sid,
+            "data": encode_payload(initial, self.codec)})
+        self.info("slave %s connected (mid %s)", sid[:8], mid)
+        return conn
+
+    async def _serve_job(self, conn):
+        if self._finishing:
+            self._send(conn.writer, {"type": "stop"})
+            return
+        data = await self._in_thread(
+            self.workflow.generate_data_for_slave, conn.slave)
+        if data is False:
+            # sync point: park until an update unlocks new work
+            conn.parked = True
+            self._waiting.append(conn)
+            self._send(conn.writer, {"type": "wait"})
+            return
+        job_id = new_id()
+        conn.jobs_out[job_id] = time.time()
+        self.jobs_dispatched += 1
+        self._send(conn.writer, {
+            "type": "job", "job_id": job_id,
+            "data": encode_payload(data, self.codec)})
+
+    async def _apply_update(self, conn, msg):
+        update = decode_payload(msg.get("data"))
+        job_id = msg.get("job_id")
+        started = conn.jobs_out.pop(job_id, None)
+        if started is not None:
+            elapsed = time.time() - started
+            conn.job_times.append(elapsed)
+            self._all_job_times.append(elapsed)
+        try:
+            result = await self._in_thread(
+                self.workflow.apply_data_from_slave, update, conn.slave)
+            self.updates_applied += 1
+            self._send(conn.writer, {"type": "update_ack",
+                                     "result": 1 if result else 0})
+        except Exception:
+            self.exception("update application failed")
+            self._send(conn.writer, {"type": "update_ack", "result": 0})
+        if self._finishing:
+            self._broadcast_stop()
+            return
+        # updates may unlock parked requesters (sync point release)
+        while self._waiting:
+            parked = self._waiting.popleft()
+            if parked.slave.id in self.slaves and parked.parked:
+                parked.parked = False
+                await self._serve_job(parked)
+
+    async def _watchdog(self):
+        """Adaptive per-slave job timeout -> drop + blacklist."""
+        while True:
+            await asyncio.sleep(0.5)
+            threshold = self._timeout_threshold()
+            now = time.time()
+            for conn in list(self.slaves.values()):
+                overdue = [jid for jid, t0 in conn.jobs_out.items()
+                           if now - t0 > threshold]
+                if overdue:
+                    self.warning(
+                        "slave %s exceeded %.1fs timeout; dropping + "
+                        "blacklisting", conn.slave.id[:8], threshold)
+                    self.blacklist.add(conn.slave.mid)
+                    self._drop(conn, "timeout")
+                    try:
+                        conn.writer.close()
+                    except Exception:
+                        pass
+
+    def _timeout_threshold(self):
+        times = list(self._all_job_times)
+        if len(times) < 4:
+            return self.job_timeout
+        import numpy
+        arr = numpy.array(times)
+        return max(float(arr.mean() + 3 * arr.std()), self.job_timeout)
+
+    def _drop(self, conn, reason):
+        if self.slaves.pop(conn.slave.id, None) is None:
+            return
+        self.info("dropping slave %s (%s)", conn.slave.id[:8], reason)
+        try:
+            self.workflow.drop_slave(conn.slave)
+        except Exception:
+            self.exception("drop_slave failed")
+        if self.respawn_hook is not None and not self._finishing:
+            delay = min(2.0 ** len(self.blacklist), 30.0)
+            self._loop.call_later(
+                delay, lambda: self.respawn_hook(conn.slave))
+
+    def _broadcast_stop(self):
+        for conn in list(self.slaves.values()):
+            try:
+                self._send(conn.writer, {"type": "stop"})
+            except Exception:
+                pass
+
+    def _send(self, writer, msg):
+        writer.write((json.dumps(msg) + "\n").encode())
+
+    async def _in_thread(self, fn, *args):
+        return await self._loop.run_in_executor(None, fn, *args)
