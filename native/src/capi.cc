@@ -1,0 +1,72 @@
+// C API for ctypes (the Python side binds through veles_tpu/native.py;
+// pybind11 is deliberately not used — see build notes in
+// native/CMakeLists.txt).
+#include <cstring>
+#include <string>
+
+#include "workflow.h"
+
+using veles_native::NativeWorkflow;
+
+namespace {
+
+void SetError(char* err, int errlen, const std::string& what) {
+  if (err && errlen > 0) {
+    std::strncpy(err, what.c_str(), errlen - 1);
+    err[errlen - 1] = '\0';
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* veles_workflow_load(const char* path, char* err, int errlen) {
+  try {
+    return new NativeWorkflow(path);
+  } catch (const std::exception& e) {
+    SetError(err, errlen, e.what());
+    return nullptr;
+  }
+}
+
+void veles_workflow_destroy(void* handle) {
+  delete static_cast<NativeWorkflow*>(handle);
+}
+
+long long veles_workflow_input_size(void* handle) {
+  return static_cast<NativeWorkflow*>(handle)->input_size();
+}
+
+long long veles_workflow_output_size(void* handle) {
+  return static_cast<NativeWorkflow*>(handle)->output_size();
+}
+
+long long veles_workflow_unit_count(void* handle) {
+  return static_cast<long long>(
+      static_cast<NativeWorkflow*>(handle)->unit_count());
+}
+
+// Plans the arena for `batch` and returns its size in bytes (<0: error).
+long long veles_workflow_arena_size(void* handle, int batch) {
+  try {
+    auto* wf = static_cast<NativeWorkflow*>(handle);
+    wf->Initialize(batch);
+    return wf->arena_size();
+  } catch (const std::exception&) {
+    return -1;
+  }
+}
+
+int veles_workflow_run(void* handle, const float* in, float* out,
+                       int batch, char* err, int errlen) {
+  try {
+    static_cast<NativeWorkflow*>(handle)->Run(in, out, batch);
+    return 0;
+  } catch (const std::exception& e) {
+    SetError(err, errlen, e.what());
+    return -1;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,29 @@
+// Shared bits for the native inference runtime (libVeles-equivalent,
+// reference libVeles/inc/veles/*.h; written from scratch for the TPU
+// framework build).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+using Shape = std::vector<int64_t>;
+
+inline int64_t NumElements(const Shape& s) {
+  int64_t n = 1;
+  for (auto d : s) n *= d;
+  return n;
+}
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+}  // namespace veles_native

@@ -1,0 +1,65 @@
+#include "engine.h"
+
+#include <algorithm>
+#include <atomic>
+
+namespace veles_native {
+
+Engine::Engine(int workers) {
+  if (workers <= 0)
+    workers = std::max(1u, std::thread::hardware_concurrency());
+  for (int i = 0; i < workers; ++i)
+    threads_.emplace_back([this] { WorkerLoop(); });
+}
+
+Engine::~Engine() {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  for (auto& t : threads_) t.join();
+}
+
+void Engine::WorkerLoop() {
+  while (true) {
+    std::function<void()> task;
+    {
+      std::unique_lock<std::mutex> lock(mutex_);
+      cv_.wait(lock, [this] { return stop_ || !queue_.empty(); });
+      if (stop_ && queue_.empty()) return;
+      task = std::move(queue_.front());
+      queue_.pop();
+    }
+    task();
+  }
+}
+
+void Engine::ParallelFor(int total,
+                         const std::function<void(int, int)>& fn) {
+  int n = workers();
+  int chunk = (total + n - 1) / n;
+  std::atomic<int> remaining{0};
+  std::mutex done_mutex;
+  std::condition_variable done_cv;
+
+  for (int start = 0; start < total; start += chunk) {
+    int count = std::min(chunk, total - start);
+    ++remaining;
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      queue_.push([&, start, count] {
+        fn(start, count);
+        if (--remaining == 0) {
+          std::lock_guard<std::mutex> dl(done_mutex);
+          done_cv.notify_all();
+        }
+      });
+    }
+    cv_.notify_one();
+  }
+  std::unique_lock<std::mutex> lock(done_mutex);
+  done_cv.wait(lock, [&] { return remaining.load() == 0; });
+}
+
+}  // namespace veles_native

@@ -1,0 +1,38 @@
+// Thread-pool engine for batch-parallel inference (the reference's
+// engine.h:43 + thread_pool.h scheduled a unit DAG; an inference chain
+// is linear, so the parallelism that matters is ACROSS batch rows —
+// this engine shards the batch over workers).
+#pragma once
+
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace veles_native {
+
+class Engine {
+ public:
+  explicit Engine(int workers = 0);
+  ~Engine();
+
+  // Runs fn(start, count) over [0, total) split across workers; blocks
+  // until every shard completes.
+  void ParallelFor(int total,
+                   const std::function<void(int, int)>& fn);
+
+  int workers() const { return static_cast<int>(threads_.size()); }
+
+ private:
+  void WorkerLoop();
+
+  std::vector<std::thread> threads_;
+  std::queue<std::function<void()>> queue_;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  bool stop_ = false;
+};
+
+}  // namespace veles_native

@@ -1,0 +1,191 @@
+#include "json.h"
+
+#include <cctype>
+#include <cstdlib>
+
+#include "common.h"
+
+namespace veles_native {
+
+namespace {
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : text_(text) {}
+
+  JsonValue Parse() {
+    JsonValue v = ParseValue();
+    SkipWs();
+    if (pos_ != text_.size()) throw Error("json: trailing garbage");
+    return v;
+  }
+
+ private:
+  void SkipWs() {
+    while (pos_ < text_.size() &&
+           std::isspace(static_cast<unsigned char>(text_[pos_])))
+      ++pos_;
+  }
+
+  char Peek() {
+    SkipWs();
+    if (pos_ >= text_.size()) throw Error("json: unexpected end");
+    return text_[pos_];
+  }
+
+  char Next() {
+    char c = Peek();
+    ++pos_;
+    return c;
+  }
+
+  void Expect(char c) {
+    if (Next() != c)
+      throw Error(std::string("json: expected '") + c + "'");
+  }
+
+  JsonValue ParseValue() {
+    char c = Peek();
+    switch (c) {
+      case '{': return ParseObject();
+      case '[': return ParseArray();
+      case '"': return ParseString();
+      case 't': case 'f': return ParseBool();
+      case 'n': return ParseNull();
+      default:  return ParseNumber();
+    }
+  }
+
+  JsonValue ParseObject() {
+    JsonValue v;
+    v.type = JsonValue::kObject;
+    Expect('{');
+    if (Peek() == '}') { ++pos_; return v; }
+    while (true) {
+      JsonValue key = ParseString();
+      Expect(':');
+      v.object[key.str] = ParseValue();
+      char c = Next();
+      if (c == '}') break;
+      if (c != ',') throw Error("json: expected ',' in object");
+    }
+    return v;
+  }
+
+  JsonValue ParseArray() {
+    JsonValue v;
+    v.type = JsonValue::kArray;
+    Expect('[');
+    if (Peek() == ']') { ++pos_; return v; }
+    while (true) {
+      v.array.push_back(ParseValue());
+      char c = Next();
+      if (c == ']') break;
+      if (c != ',') throw Error("json: expected ',' in array");
+    }
+    return v;
+  }
+
+  JsonValue ParseString() {
+    JsonValue v;
+    v.type = JsonValue::kString;
+    Expect('"');
+    while (true) {
+      if (pos_ >= text_.size()) throw Error("json: unterminated string");
+      char c = text_[pos_++];
+      if (c == '"') break;
+      if (c == '\\') {
+        if (pos_ >= text_.size()) throw Error("json: bad escape");
+        char e = text_[pos_++];
+        switch (e) {
+          case 'n': v.str += '\n'; break;
+          case 't': v.str += '\t'; break;
+          case 'r': v.str += '\r'; break;
+          case 'b': v.str += '\b'; break;
+          case 'f': v.str += '\f'; break;
+          case 'u': {  // basic BMP escapes only
+            if (pos_ + 4 > text_.size()) throw Error("json: bad \\u");
+            unsigned code = std::strtoul(
+                text_.substr(pos_, 4).c_str(), nullptr, 16);
+            pos_ += 4;
+            if (code < 0x80) {
+              v.str += static_cast<char>(code);
+            } else if (code < 0x800) {
+              v.str += static_cast<char>(0xC0 | (code >> 6));
+              v.str += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              v.str += static_cast<char>(0xE0 | (code >> 12));
+              v.str += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              v.str += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: v.str += e;
+        }
+      } else {
+        v.str += c;
+      }
+    }
+    return v;
+  }
+
+  JsonValue ParseBool() {
+    JsonValue v;
+    v.type = JsonValue::kBool;
+    if (text_.compare(pos_, 4, "true") == 0) {
+      v.bool_value = true;
+      pos_ += 4;
+    } else if (text_.compare(pos_, 5, "false") == 0) {
+      v.bool_value = false;
+      pos_ += 5;
+    } else {
+      throw Error("json: bad literal");
+    }
+    return v;
+  }
+
+  JsonValue ParseNull() {
+    if (text_.compare(pos_, 4, "null") != 0)
+      throw Error("json: bad literal");
+    pos_ += 4;
+    return JsonValue();
+  }
+
+  JsonValue ParseNumber() {
+    size_t start = pos_;
+    while (pos_ < text_.size() &&
+           (std::isdigit(static_cast<unsigned char>(text_[pos_])) ||
+            text_[pos_] == '-' || text_[pos_] == '+' ||
+            text_[pos_] == '.' || text_[pos_] == 'e' ||
+            text_[pos_] == 'E'))
+      ++pos_;
+    if (start == pos_) throw Error("json: bad number");
+    JsonValue v;
+    v.type = JsonValue::kNumber;
+    v.number = std::strtod(text_.substr(start, pos_ - start).c_str(),
+                           nullptr);
+    return v;
+  }
+
+  const std::string& text_;
+  size_t pos_ = 0;
+};
+
+}  // namespace
+
+const JsonValue& JsonValue::operator[](const std::string& key) const {
+  auto it = object.find(key);
+  if (it == object.end()) throw Error("json: missing key " + key);
+  return it->second;
+}
+
+const JsonValue& JsonValue::operator[](size_t index) const {
+  if (index >= array.size()) throw Error("json: index out of range");
+  return array[index];
+}
+
+JsonValue ParseJson(const std::string& text) {
+  return Parser(text).Parse();
+}
+
+}  // namespace veles_native

@@ -1,0 +1,35 @@
+// Minimal JSON parser for contents.json (the reference vendored
+// rapidjson as a submodule; this schema needs ~200 lines).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+class JsonValue {
+ public:
+  enum Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+  Type type = kNull;
+  bool bool_value = false;
+  double number = 0;
+  std::string str;
+  std::vector<JsonValue> array;
+  std::map<std::string, JsonValue> object;
+
+  bool IsNull() const { return type == kNull; }
+  const JsonValue& operator[](const std::string& key) const;
+  const JsonValue& operator[](size_t index) const;
+  bool Has(const std::string& key) const {
+    return type == kObject && object.count(key);
+  }
+  int64_t AsInt() const { return static_cast<int64_t>(number); }
+};
+
+// Throws Error on malformed input.
+JsonValue ParseJson(const std::string& text);
+
+}  // namespace veles_native

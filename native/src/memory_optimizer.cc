@@ -1,0 +1,67 @@
+#include "memory_optimizer.h"
+
+#include <algorithm>
+#include <numeric>
+
+namespace veles_native {
+
+namespace {
+
+struct Placed {
+  int64_t offset, size;
+  int first, last;
+};
+
+bool TimeOverlap(int a0, int a1, int b0, int b1) {
+  return a0 <= b1 && b0 <= a1;
+}
+
+}  // namespace
+
+std::vector<BufferPlacement> PlanArena(
+    const std::vector<BufferRequest>& requests, int64_t* arena_size,
+    int64_t alignment) {
+  std::vector<size_t> order(requests.size());
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(), [&](size_t a, size_t b) {
+    return requests[a].size > requests[b].size;
+  });
+
+  std::vector<Placed> placed;
+  std::vector<BufferPlacement> result(requests.size());
+  int64_t total = 0;
+
+  for (size_t idx : order) {
+    const auto& req = requests[idx];
+    int64_t size = ((req.size + alignment - 1) / alignment) * alignment;
+    // candidate offsets: 0 and the top of every time-overlapping block
+    std::vector<int64_t> candidates = {0};
+    for (const auto& p : placed)
+      if (TimeOverlap(p.first, p.last, req.first_use, req.last_use))
+        candidates.push_back(p.offset + p.size);
+    std::sort(candidates.begin(), candidates.end());
+    int64_t chosen = -1;
+    for (int64_t cand : candidates) {
+      bool free = true;
+      for (const auto& p : placed) {
+        if (!TimeOverlap(p.first, p.last, req.first_use, req.last_use))
+          continue;
+        if (cand < p.offset + p.size && p.offset < cand + size) {
+          free = false;
+          break;
+        }
+      }
+      if (free) {
+        chosen = cand;
+        break;
+      }
+    }
+    placed.push_back({chosen, size, req.first_use, req.last_use});
+    result[idx].offset = chosen;
+    total = std::max(total, chosen + size);
+  }
+  *arena_size = total;
+  return result;
+}
+
+}  // namespace veles_native

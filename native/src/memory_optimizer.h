@@ -1,0 +1,29 @@
+// Greedy strip-packing arena planner (reference
+// libVeles/src/memory_optimizer.cc:38-98 behavior, fresh
+// implementation): every buffer has a byte size and a [first_use,
+// last_use] step interval; buffers are placed at the lowest arena
+// offset whose occupied intervals don't overlap in time, largest
+// first.  Returns per-buffer offsets and the total arena size.
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+namespace veles_native {
+
+struct BufferRequest {
+  int64_t size;        // bytes
+  int first_use;       // step index producing it
+  int last_use;        // last step reading it
+};
+
+struct BufferPlacement {
+  int64_t offset;
+};
+
+// Returns placements (same order as requests) + sets *arena_size.
+std::vector<BufferPlacement> PlanArena(
+    const std::vector<BufferRequest>& requests, int64_t* arena_size,
+    int64_t alignment = 64);
+
+}  // namespace veles_native

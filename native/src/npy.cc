@@ -1,0 +1,124 @@
+#include "npy.h"
+
+#include <cstring>
+
+namespace veles_native {
+
+namespace {
+
+float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h >> 15) & 1;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t frac = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (frac == 0) {
+      bits = sign << 31;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(frac & 0x400)) {
+        frac <<= 1;
+        ++shift;
+      }
+      frac &= 0x3FF;
+      bits = (sign << 31) | ((127 - 15 - shift + 1) << 23) | (frac << 13);
+    }
+  } else if (exp == 0x1F) {
+    bits = (sign << 31) | (0xFF << 23) | (frac << 13);  // inf/nan
+  } else {
+    bits = (sign << 31) | ((exp - 15 + 127) << 23) | (frac << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+// Pull "'descr': '<f4'" style fields out of the python-dict header.
+std::string HeaderField(const std::string& header, const std::string& key) {
+  size_t pos = header.find("'" + key + "'");
+  if (pos == std::string::npos) throw Error("npy: missing " + key);
+  pos = header.find(':', pos);
+  size_t start = header.find_first_not_of(" ", pos + 1);
+  char open = header[start];
+  if (open == '\'') {
+    size_t end = header.find('\'', start + 1);
+    return header.substr(start + 1, end - start - 1);
+  }
+  if (open == '(') {
+    size_t end = header.find(')', start);
+    return header.substr(start, end - start + 1);
+  }
+  size_t end = header.find_first_of(",}", start);
+  return header.substr(start, end - start);
+}
+
+}  // namespace
+
+NpyArray LoadNpy(const std::vector<char>& bytes) {
+  if (bytes.size() < 10 || std::memcmp(bytes.data(), "\x93NUMPY", 6))
+    throw Error("npy: bad magic");
+  uint8_t major = bytes[6];
+  size_t header_len, header_off;
+  if (major == 1) {
+    uint16_t len;
+    std::memcpy(&len, bytes.data() + 8, 2);
+    header_len = len;
+    header_off = 10;
+  } else {
+    uint32_t len;
+    std::memcpy(&len, bytes.data() + 8, 4);
+    header_len = len;
+    header_off = 12;
+  }
+  std::string header(bytes.data() + header_off, header_len);
+  std::string descr = HeaderField(header, "descr");
+  std::string order = HeaderField(header, "fortran_order");
+  if (order.find("True") != std::string::npos)
+    throw Error("npy: fortran order unsupported");
+  std::string shape_str = HeaderField(header, "shape");
+
+  NpyArray arr;
+  // parse "(3, 4)" / "(5,)" / "()"
+  for (size_t i = 1; i < shape_str.size();) {
+    while (i < shape_str.size() &&
+           !isdigit(static_cast<unsigned char>(shape_str[i])))
+      ++i;
+    if (i >= shape_str.size()) break;
+    arr.shape.push_back(std::strtoll(shape_str.c_str() + i, nullptr, 10));
+    while (i < shape_str.size() &&
+           isdigit(static_cast<unsigned char>(shape_str[i])))
+      ++i;
+  }
+
+  size_t count = static_cast<size_t>(NumElements(arr.shape));
+  const char* payload = bytes.data() + header_off + header_len;
+  size_t avail = bytes.size() - header_off - header_len;
+  arr.data.resize(count);
+
+  if (descr == "<f4" || descr == "|f4") {
+    if (avail < count * 4) throw Error("npy: truncated f4 payload");
+    std::memcpy(arr.data.data(), payload, count * 4);
+  } else if (descr == "<f8") {
+    if (avail < count * 8) throw Error("npy: truncated f8 payload");
+    const double* src = reinterpret_cast<const double*>(payload);
+    for (size_t i = 0; i < count; ++i)
+      arr.data[i] = static_cast<float>(src[i]);
+  } else if (descr == "<f2") {
+    if (avail < count * 2) throw Error("npy: truncated f2 payload");
+    const uint16_t* src = reinterpret_cast<const uint16_t*>(payload);
+    for (size_t i = 0; i < count; ++i) arr.data[i] = HalfToFloat(src[i]);
+  } else if (descr == "<i4") {
+    const int32_t* src = reinterpret_cast<const int32_t*>(payload);
+    for (size_t i = 0; i < count; ++i)
+      arr.data[i] = static_cast<float>(src[i]);
+  } else if (descr == "<i8") {
+    const int64_t* src = reinterpret_cast<const int64_t*>(payload);
+    for (size_t i = 0; i < count; ++i)
+      arr.data[i] = static_cast<float>(src[i]);
+  } else {
+    throw Error("npy: unsupported dtype " + descr);
+  }
+  return arr;
+}
+
+}  // namespace veles_native

@@ -1,0 +1,18 @@
+// .npy reader with fp16 -> f32 widening (parity with the reference's
+// numpy_array_loader.cc including its fp16 conversion path).
+#pragma once
+
+#include <vector>
+
+#include "common.h"
+
+namespace veles_native {
+
+struct NpyArray {
+  Shape shape;
+  std::vector<float> data;  // always widened to f32
+};
+
+NpyArray LoadNpy(const std::vector<char>& bytes);
+
+}  // namespace veles_native

@@ -1,0 +1,89 @@
+#include "tar.h"
+
+#include <cstring>
+#include <fstream>
+
+#include "common.h"
+
+namespace veles_native {
+
+namespace {
+
+// ustar header is 512 bytes; fields are octal ASCII.
+struct UstarHeader {
+  char name[100];
+  char mode[8];
+  char uid[8];
+  char gid[8];
+  char size[12];
+  char mtime[12];
+  char chksum[8];
+  char typeflag;
+  char linkname[100];
+  char magic[6];
+  char version[2];
+  char uname[32];
+  char gname[32];
+  char devmajor[8];
+  char devminor[8];
+  char prefix[155];
+  char pad[12];
+};
+
+static_assert(sizeof(UstarHeader) == 512, "ustar header must be 512B");
+
+int64_t ParseOctal(const char* field, size_t len) {
+  int64_t value = 0;
+  for (size_t i = 0; i < len && field[i]; ++i) {
+    char c = field[i];
+    if (c == ' ') continue;
+    if (c < '0' || c > '7') break;
+    value = value * 8 + (c - '0');
+  }
+  return value;
+}
+
+bool AllZero(const char* block) {
+  for (int i = 0; i < 512; ++i)
+    if (block[i]) return false;
+  return true;
+}
+
+}  // namespace
+
+TarFile::TarFile(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) throw Error("cannot open package " + path);
+  char block[512];
+  while (in.read(block, 512)) {
+    if (AllZero(block)) break;  // end-of-archive marker
+    const auto* hdr = reinterpret_cast<const UstarHeader*>(block);
+    int64_t size = ParseOctal(hdr->size, sizeof(hdr->size));
+    std::string name(hdr->name, strnlen(hdr->name, sizeof(hdr->name)));
+    if (hdr->typeflag == '0' || hdr->typeflag == '\0') {
+      std::vector<char> data(static_cast<size_t>(size));
+      if (size > 0 && !in.read(data.data(), size))
+        throw Error("truncated tar member " + name);
+      members_[name] = std::move(data);
+    } else {
+      in.seekg(size, std::ios::cur);  // skip non-regular members
+    }
+    // advance to the next 512-byte boundary
+    int64_t rem = size % 512;
+    if (rem) in.seekg(512 - rem, std::ios::cur);
+  }
+}
+
+const std::vector<char>& TarFile::Get(const std::string& name) const {
+  auto it = members_.find(name);
+  if (it == members_.end()) throw Error("missing tar member " + name);
+  return it->second;
+}
+
+std::vector<std::string> TarFile::Names() const {
+  std::vector<std::string> out;
+  for (const auto& kv : members_) out.push_back(kv.first);
+  return out;
+}
+
+}  // namespace veles_native

@@ -1,0 +1,28 @@
+// Minimal ustar (POSIX tar) reader: maps member name -> bytes.
+// The reference runtime consumed zip via a libarchive submodule
+// (libVeles/src/workflow_archive.cc); this build's package format is
+// plain tar so the runtime stays dependency-free.
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+class TarFile {
+ public:
+  // Loads the whole archive into memory; throws Error on damage.
+  explicit TarFile(const std::string& path);
+
+  bool Has(const std::string& name) const {
+    return members_.count(name) != 0;
+  }
+  const std::vector<char>& Get(const std::string& name) const;
+  std::vector<std::string> Names() const;
+
+ private:
+  std::map<std::string, std::vector<char>> members_;
+};
+
+}  // namespace veles_native

@@ -1,0 +1,54 @@
+// Inference units + UUID factory (reference libVeles unit.h:105,
+// unit_factory.cc:1-65 — reimplemented from scratch).
+#pragma once
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "common.h"
+#include "json.h"
+#include "npy.h"
+
+namespace veles_native {
+
+class Unit {
+ public:
+  virtual ~Unit() = default;
+
+  // Configure from contents.json properties + loaded arrays.
+  virtual void Setup(const JsonValue& props,
+                     std::map<std::string, NpyArray> arrays) = 0;
+
+  // Given the input sample shape (without batch), return the output
+  // sample shape.
+  virtual Shape OutputShape(const Shape& input_shape) const = 0;
+
+  // Process `batch` samples: contiguous f32 in -> out.
+  virtual void Run(const float* in, float* out, int batch,
+                   const Shape& input_shape) const = 0;
+
+  const std::string& name() const { return name_; }
+  void set_name(const std::string& n) { name_ = n; }
+
+ private:
+  std::string name_;
+};
+
+class UnitFactory {
+ public:
+  using Creator = std::function<std::unique_ptr<Unit>()>;
+
+  static UnitFactory& Instance();
+
+  void Register(const std::string& uuid, Creator creator);
+  std::unique_ptr<Unit> Create(const std::string& uuid) const;
+
+ private:
+  std::map<std::string, Creator> creators_;
+};
+
+void RegisterStandardUnits();  // idempotent
+
+}  // namespace veles_native

@@ -1,0 +1,344 @@
+// Standard inference units: fully-connected family, conv, pooling,
+// standalone activations.  Math matches veles_tpu/models exactly (same
+// scaled-tanh constants, softplus RELU, ceil-mode pooling).
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+
+#include "unit.h"
+
+namespace veles_native {
+
+namespace {
+
+enum class Act { kLinear, kTanh, kRelu, kStrictRelu, kSigmoid, kSoftmax };
+
+inline float Activate(Act act, float z) {
+  switch (act) {
+    case Act::kTanh:
+      return 1.7159f * std::tanh(0.6666f * z);
+    case Act::kRelu:
+      return z > 15.0f ? z : std::log1p(std::exp(std::min(z, 15.0f)));
+    case Act::kStrictRelu:
+      return z > 0 ? z : 0;
+    case Act::kSigmoid:
+      return 1.0f / (1.0f + std::exp(-z));
+    default:
+      return z;
+  }
+}
+
+// ---------------------------------------------------------------- all2all
+
+class All2AllUnit : public Unit {
+ public:
+  explicit All2AllUnit(Act act) : act_(act) {}
+
+  void Setup(const JsonValue& props,
+             std::map<std::string, NpyArray> arrays) override {
+    weights_ = std::move(arrays.at("weights"));
+    include_bias_ = props.Has("include_bias") &&
+                    props["include_bias"].bool_value;
+    if (include_bias_) bias_ = std::move(arrays.at("bias"));
+    fan_in_ = weights_.shape[0];
+    fan_out_ = weights_.shape[1];
+  }
+
+  Shape OutputShape(const Shape& input_shape) const override {
+    if (NumElements(input_shape) != fan_in_)
+      throw Error("all2all: input size mismatch");
+    return {fan_out_};
+  }
+
+  void Run(const float* in, float* out, int batch,
+           const Shape&) const override {
+    // blocked GEMM: out[b, o] = sum_i in[b, i] * W[i, o]
+    const int64_t kBlock = 64;
+    for (int b = 0; b < batch; ++b) {
+      float* row = out + b * fan_out_;
+      const float* x = in + b * fan_in_;
+      for (int64_t o = 0; o < fan_out_; ++o)
+        row[o] = include_bias_ ? bias_.data[o] : 0.0f;
+      for (int64_t i0 = 0; i0 < fan_in_; i0 += kBlock) {
+        int64_t i1 = std::min(i0 + kBlock, fan_in_);
+        for (int64_t i = i0; i < i1; ++i) {
+          float xi = x[i];
+          const float* wrow = weights_.data.data() + i * fan_out_;
+          for (int64_t o = 0; o < fan_out_; ++o) row[o] += xi * wrow[o];
+        }
+      }
+      if (act_ == Act::kSoftmax) {
+        float mx = row[0];
+        for (int64_t o = 1; o < fan_out_; ++o) mx = std::max(mx, row[o]);
+        float sum = 0;
+        for (int64_t o = 0; o < fan_out_; ++o) {
+          row[o] = std::exp(row[o] - mx);
+          sum += row[o];
+        }
+        for (int64_t o = 0; o < fan_out_; ++o) row[o] /= sum;
+      } else if (act_ != Act::kLinear) {
+        for (int64_t o = 0; o < fan_out_; ++o)
+          row[o] = Activate(act_, row[o]);
+      }
+    }
+  }
+
+ private:
+  Act act_;
+  NpyArray weights_, bias_;
+  bool include_bias_ = false;
+  int64_t fan_in_ = 0, fan_out_ = 0;
+};
+
+// ------------------------------------------------------------------- conv
+
+class ConvUnit : public Unit {
+ public:
+  explicit ConvUnit(Act act) : act_(act) {}
+
+  void Setup(const JsonValue& props,
+             std::map<std::string, NpyArray> arrays) override {
+    weights_ = std::move(arrays.at("weights"));  // HWIO
+    include_bias_ = props.Has("include_bias") &&
+                    props["include_bias"].bool_value;
+    if (include_bias_) bias_ = std::move(arrays.at("bias"));
+    ky_ = weights_.shape[0];
+    kx_ = weights_.shape[1];
+    in_ch_ = weights_.shape[2];
+    n_kernels_ = weights_.shape[3];
+    if (props.Has("sliding")) {
+      sx_ = props["sliding"][0].AsInt();
+      sy_ = props["sliding"][1].AsInt();
+    }
+    if (props.Has("padding")) {
+      const auto& p = props["padding"].array;
+      left_ = p[0].AsInt();
+      top_ = p[1].AsInt();
+      right_ = p[2].AsInt();
+      bottom_ = p[3].AsInt();
+    }
+  }
+
+  Shape OutputShape(const Shape& s) const override {
+    int64_t h = s[0], w = s[1];
+    int64_t out_h = (h + top_ + bottom_ - ky_) / sy_ + 1;
+    int64_t out_w = (w + left_ + right_ - kx_) / sx_ + 1;
+    return {out_h, out_w, n_kernels_};
+  }
+
+  void Run(const float* in, float* out, int batch,
+           const Shape& s) const override {
+    int64_t h = s[0], w = s[1];
+    int64_t ch = s.size() > 2 ? s[2] : 1;
+    Shape os = OutputShape(s);
+    int64_t oh = os[0], ow = os[1];
+    int64_t in_sample = h * w * ch, out_sample = oh * ow * n_kernels_;
+    for (int b = 0; b < batch; ++b) {
+      const float* img = in + b * in_sample;
+      float* dst = out + b * out_sample;
+      for (int64_t oy = 0; oy < oh; ++oy) {
+        for (int64_t ox = 0; ox < ow; ++ox) {
+          float* cell = dst + (oy * ow + ox) * n_kernels_;
+          for (int64_t k = 0; k < n_kernels_; ++k)
+            cell[k] = include_bias_ ? bias_.data[k] : 0.0f;
+          for (int64_t fy = 0; fy < ky_; ++fy) {
+            int64_t iy = oy * sy_ - top_ + fy;
+            if (iy < 0 || iy >= h) continue;
+            for (int64_t fx = 0; fx < kx_; ++fx) {
+              int64_t ix = ox * sx_ - left_ + fx;
+              if (ix < 0 || ix >= w) continue;
+              const float* px = img + (iy * w + ix) * ch;
+              const float* wk =
+                  weights_.data.data() +
+                  ((fy * kx_ + fx) * in_ch_) * n_kernels_;
+              for (int64_t c = 0; c < ch; ++c)
+                for (int64_t k = 0; k < n_kernels_; ++k)
+                  cell[k] += px[c] * wk[c * n_kernels_ + k];
+            }
+          }
+          for (int64_t k = 0; k < n_kernels_; ++k)
+            cell[k] = Activate(act_, cell[k]);
+        }
+      }
+    }
+  }
+
+ private:
+  Act act_;
+  NpyArray weights_, bias_;
+  bool include_bias_ = false;
+  int64_t kx_ = 1, ky_ = 1, in_ch_ = 1, n_kernels_ = 1;
+  int64_t sx_ = 1, sy_ = 1;
+  int64_t left_ = 0, top_ = 0, right_ = 0, bottom_ = 0;
+};
+
+// ---------------------------------------------------------------- pooling
+
+enum class PoolKind { kMax, kAvg, kMaxAbs };
+
+class PoolingUnit : public Unit {
+ public:
+  explicit PoolingUnit(PoolKind kind) : kind_(kind) {}
+
+  void Setup(const JsonValue& props,
+             std::map<std::string, NpyArray>) override {
+    kx_ = props["kx"].AsInt();
+    ky_ = props["ky"].AsInt();
+    sx_ = kx_;
+    sy_ = ky_;
+    if (props.Has("sliding")) {
+      sx_ = props["sliding"][0].AsInt();
+      sy_ = props["sliding"][1].AsInt();
+    }
+  }
+
+  static int64_t OutLen(int64_t n, int64_t k, int64_t s) {
+    if (n <= k) return 1;
+    return (n - k + s - 1) / s + 1;  // ceil mode, covers all input
+  }
+
+  Shape OutputShape(const Shape& s) const override {
+    int64_t ch = s.size() > 2 ? s[2] : 1;
+    return {OutLen(s[0], ky_, sy_), OutLen(s[1], kx_, sx_), ch};
+  }
+
+  void Run(const float* in, float* out, int batch,
+           const Shape& s) const override {
+    int64_t h = s[0], w = s[1], ch = s.size() > 2 ? s[2] : 1;
+    Shape os = OutputShape(s);
+    int64_t oh = os[0], ow = os[1];
+    int64_t in_sample = h * w * ch, out_sample = oh * ow * ch;
+    for (int b = 0; b < batch; ++b) {
+      const float* img = in + b * in_sample;
+      float* dst = out + b * out_sample;
+      for (int64_t oy = 0; oy < oh; ++oy)
+        for (int64_t ox = 0; ox < ow; ++ox)
+          for (int64_t c = 0; c < ch; ++c) {
+            float best = 0, best_abs = -1, sum = 0;
+            bool first = true;
+            for (int64_t fy = 0; fy < ky_; ++fy) {
+              int64_t iy = oy * sy_ + fy;
+              if (iy >= h) continue;
+              for (int64_t fx = 0; fx < kx_; ++fx) {
+                int64_t ix = ox * sx_ + fx;
+                if (ix >= w) continue;
+                float v = img[(iy * w + ix) * ch + c];
+                sum += v;
+                if (kind_ == PoolKind::kMax) {
+                  if (first || v > best) best = v;
+                } else if (kind_ == PoolKind::kMaxAbs) {
+                  if (std::fabs(v) > best_abs) {
+                    best_abs = std::fabs(v);
+                    best = v;
+                  }
+                }
+                first = false;
+              }
+            }
+            float result;
+            if (kind_ == PoolKind::kAvg)
+              result = sum / static_cast<float>(kx_ * ky_);
+            else
+              result = best;
+            dst[(oy * ow + ox) * ch + c] = result;
+          }
+    }
+  }
+
+ private:
+  PoolKind kind_;
+  int64_t kx_ = 2, ky_ = 2, sx_ = 2, sy_ = 2;
+};
+
+// ------------------------------------------------------------- activations
+
+class ActivationUnit : public Unit {
+ public:
+  explicit ActivationUnit(Act act) : act_(act) {}
+
+  void Setup(const JsonValue&, std::map<std::string, NpyArray>) override {}
+
+  Shape OutputShape(const Shape& s) const override { return s; }
+
+  void Run(const float* in, float* out, int batch,
+           const Shape& s) const override {
+    int64_t n = NumElements(s) * batch;
+    for (int64_t i = 0; i < n; ++i) out[i] = Activate(act_, in[i]);
+  }
+
+ private:
+  Act act_;
+};
+
+}  // namespace
+
+UnitFactory& UnitFactory::Instance() {
+  static UnitFactory factory;
+  return factory;
+}
+
+void UnitFactory::Register(const std::string& uuid, Creator creator) {
+  creators_[uuid] = std::move(creator);
+}
+
+std::unique_ptr<Unit> UnitFactory::Create(const std::string& uuid) const {
+  auto it = creators_.find(uuid);
+  if (it == creators_.end()) throw Error("unknown unit uuid " + uuid);
+  return it->second();
+}
+
+void RegisterStandardUnits() {
+  static bool done = false;
+  if (done) return;
+  done = true;
+  auto& f = UnitFactory::Instance();
+  // UUIDs mirror veles_tpu/package.py UNIT_UUIDS
+  auto a2a = [](Act act) {
+    return [act]() -> std::unique_ptr<Unit> {
+      return std::make_unique<All2AllUnit>(act);
+    };
+  };
+  f.Register("5a51b268-0001-4000-8000-76656c6573aa", a2a(Act::kLinear));
+  f.Register("5a51b268-0002-4000-8000-76656c6573aa", a2a(Act::kTanh));
+  f.Register("5a51b268-0003-4000-8000-76656c6573aa", a2a(Act::kRelu));
+  f.Register("5a51b268-0004-4000-8000-76656c6573aa",
+             a2a(Act::kStrictRelu));
+  f.Register("5a51b268-0005-4000-8000-76656c6573aa", a2a(Act::kSigmoid));
+  f.Register("5a51b268-0006-4000-8000-76656c6573aa", a2a(Act::kSoftmax));
+  auto conv = [](Act act) {
+    return [act]() -> std::unique_ptr<Unit> {
+      return std::make_unique<ConvUnit>(act);
+    };
+  };
+  f.Register("5a51b268-0011-4000-8000-76656c6573aa", conv(Act::kLinear));
+  f.Register("5a51b268-0012-4000-8000-76656c6573aa", conv(Act::kTanh));
+  f.Register("5a51b268-0013-4000-8000-76656c6573aa", conv(Act::kRelu));
+  f.Register("5a51b268-0014-4000-8000-76656c6573aa",
+             conv(Act::kStrictRelu));
+  f.Register("5a51b268-0015-4000-8000-76656c6573aa",
+             conv(Act::kSigmoid));
+  auto pool = [](PoolKind kind) {
+    return [kind]() -> std::unique_ptr<Unit> {
+      return std::make_unique<PoolingUnit>(kind);
+    };
+  };
+  f.Register("5a51b268-0021-4000-8000-76656c6573aa", pool(PoolKind::kMax));
+  f.Register("5a51b268-0022-4000-8000-76656c6573aa", pool(PoolKind::kAvg));
+  f.Register("5a51b268-0023-4000-8000-76656c6573aa",
+             pool(PoolKind::kMaxAbs));
+  auto act_unit = [](Act act) {
+    return [act]() -> std::unique_ptr<Unit> {
+      return std::make_unique<ActivationUnit>(act);
+    };
+  };
+  f.Register("5a51b268-0031-4000-8000-76656c6573aa",
+             act_unit(Act::kTanh));
+  f.Register("5a51b268-0032-4000-8000-76656c6573aa",
+             act_unit(Act::kRelu));
+  f.Register("5a51b268-0033-4000-8000-76656c6573aa",
+             act_unit(Act::kStrictRelu));
+  f.Register("5a51b268-0034-4000-8000-76656c6573aa",
+             act_unit(Act::kSigmoid));
+}
+
+}  // namespace veles_native

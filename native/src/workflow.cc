@@ -1,0 +1,90 @@
+#include "workflow.h"
+
+#include <cstring>
+
+#include "engine.h"
+#include "memory_optimizer.h"
+#include "tar.h"
+
+namespace veles_native {
+
+NativeWorkflow::~NativeWorkflow() = default;
+
+NativeWorkflow::NativeWorkflow(const std::string& path) {
+  RegisterStandardUnits();
+  TarFile tar(path);
+  const auto& cj = tar.Get("contents.json");
+  JsonValue contents = ParseJson(std::string(cj.begin(), cj.end()));
+
+  if (contents.Has("input_shape") && !contents["input_shape"].IsNull())
+    for (const auto& d : contents["input_shape"].array)
+      input_shape_.push_back(d.AsInt());
+
+  for (const auto& uj : contents["units"].array) {
+    auto unit = UnitFactory::Instance().Create(uj["uuid"].str);
+    unit->set_name(uj["class"].str);
+    std::map<std::string, NpyArray> arrays;
+    if (uj.Has("arrays"))
+      for (const auto& kv : uj["arrays"].object)
+        arrays[kv.first] = LoadNpy(tar.Get(kv.second.str));
+    unit->Setup(uj["properties"], std::move(arrays));
+    units_.push_back(std::move(unit));
+  }
+  if (units_.empty()) throw Error("package has no units");
+
+  // propagate shapes through the chain
+  stage_shapes_.push_back(input_shape_);
+  Shape cur = input_shape_;
+  for (const auto& unit : units_) {
+    cur = unit->OutputShape(cur);
+    stage_shapes_.push_back(cur);
+  }
+}
+
+int64_t NativeWorkflow::output_size() const {
+  return NumElements(stage_shapes_.back());
+}
+
+void NativeWorkflow::Initialize(int batch) {
+  if (planned_batch_ == batch) return;
+  // One buffer per stage output; stage i's output is produced at step i
+  // and last read at step i+1 (linear inference chain).  The planner
+  // lets non-adjacent buffers share arena bytes, which is the whole
+  // point of the reference's strip packing.
+  std::vector<BufferRequest> requests;
+  int n = static_cast<int>(units_.size());
+  for (int i = 0; i < n; ++i) {
+    int64_t bytes =
+        NumElements(stage_shapes_[i + 1]) * batch * sizeof(float);
+    requests.push_back({bytes, i, std::min(i + 1, n - 1)});
+  }
+  auto placements = PlanArena(requests, &arena_size_);
+  offsets_.clear();
+  for (const auto& p : placements) offsets_.push_back(p.offset);
+  arena_.resize(static_cast<size_t>(arena_size_));
+  planned_batch_ = batch;
+}
+
+void NativeWorkflow::Run(const float* in, float* out, int batch) {
+  Initialize(batch);
+  if (!engine_) engine_ = std::make_unique<Engine>();
+  const float* cur = in;
+  int n = static_cast<int>(units_.size());
+  for (int i = 0; i < n; ++i) {
+    float* dst =
+        (i == n - 1) ? out
+                     : reinterpret_cast<float*>(arena_.data() + offsets_[i]);
+    const Unit* unit = units_[i].get();
+    const Shape& in_shape = stage_shapes_[i];
+    int64_t in_sample = NumElements(in_shape);
+    int64_t out_sample = NumElements(stage_shapes_[i + 1]);
+    // batch rows are independent: shard them over the engine workers
+    engine_->ParallelFor(batch, [&](int start, int count) {
+      unit->Run(cur + start * in_sample, dst + start * out_sample, count,
+                in_shape);
+    });
+    cur = dst;
+  }
+}
+
+}  // namespace veles_native

@@ -1,0 +1,46 @@
+// Package loader + arena-planned inference runner (reference
+// libVeles workflow_loader.cc:41, workflow.cc:73-158 roles, fresh
+// implementation for the tar/contents.json package of
+// veles_tpu/package.py).
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "unit.h"
+
+namespace veles_native {
+
+class NativeWorkflow {
+ public:
+  // Loads a package tar; builds units via the UUID factory.
+  explicit NativeWorkflow(const std::string& path);
+  ~NativeWorkflow();
+
+  // Plans the arena for `batch` samples (idempotent per batch size).
+  void Initialize(int batch);
+
+  // Runs the chain; in has batch*input_size floats, out receives
+  // batch*output_size.
+  void Run(const float* in, float* out, int batch);
+
+  int64_t input_size() const { return NumElements(input_shape_); }
+  int64_t output_size() const;
+  int64_t arena_size() const { return arena_size_; }
+  size_t unit_count() const { return units_.size(); }
+  const Shape& input_shape() const { return input_shape_; }
+
+ private:
+  std::unique_ptr<class Engine> engine_;
+  std::vector<std::unique_ptr<Unit>> units_;
+  std::vector<Shape> stage_shapes_;   // per-stage sample shapes
+  std::vector<int64_t> offsets_;      // per-stage output offsets
+  std::vector<char> arena_;
+  int64_t arena_size_ = 0;
+  int planned_batch_ = -1;
+  Shape input_shape_;
+};
+
+}  // namespace veles_native

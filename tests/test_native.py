@@ -1,0 +1,144 @@
+"""Native inference runtime tests (reference test model:
+libVeles/tests/{workflow,unit_factory,memory_optimizer,
+numpy_array_loader}.cc): package export -> C++ load -> run, compared
+against the JAX forward path."""
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+from tests.test_models import BlobsLoader
+from tests.test_conv import TinyImageLoader
+
+
+@pytest.fixture(scope="module")
+def native():
+    from veles_tpu import native as native_mod
+    try:
+        native_mod.build_native()
+    except Exception as exc:
+        pytest.skip("native build unavailable: %s" % exc)
+    return native_mod
+
+
+def _train_mlp(device, epochs=3):
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64, prng=RandomGenerator("nat", seed=5)),
+        decision_config=dict(max_epochs=epochs),
+    )
+    sw.initialize(device=device)
+    sw.run()
+    return sw
+
+
+def _jax_forward(sw, x):
+    from veles_tpu.compiler import build_forward, extract_state, \
+        workflow_plan
+    plans = workflow_plan(sw)
+    state = extract_state(sw)
+    params = [{"weights": s["weights"], "bias": s["bias"]}
+              for s in state]
+    return numpy.asarray(build_forward(plans)(params, x))
+
+
+def test_export_and_native_mlp_inference(tmp_path, native, cpu_device):
+    sw = _train_mlp(cpu_device)
+    pkg = str(tmp_path / "mlp.veles.tar")
+    sw.package_export(pkg)
+
+    nwf = native.NativeWorkflow(pkg)
+    assert nwf.unit_count == 2
+    assert nwf.input_size == 16
+    assert nwf.output_size == 4
+
+    rng = numpy.random.RandomState(0)
+    x = rng.rand(32, 16).astype(numpy.float32)
+    got = nwf.run(x)
+    want = _jax_forward(sw, x)
+    numpy.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    numpy.testing.assert_allclose(got.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_native_fp16_package(tmp_path, native, cpu_device):
+    """fp16 arrays are widened on load (numpy_array_loader parity)."""
+    sw = _train_mlp(cpu_device)
+    pkg = str(tmp_path / "mlp16.veles.tar")
+    sw.package_export(pkg, precision="float16")
+    nwf = native.NativeWorkflow(pkg)
+    rng = numpy.random.RandomState(1)
+    x = rng.rand(8, 16).astype(numpy.float32)
+    got = nwf.run(x)
+    want = _jax_forward(sw, x)
+    numpy.testing.assert_allclose(got, want, rtol=0.05, atol=0.02)
+
+
+def test_native_conv_inference(tmp_path, native, cpu_device):
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "conv_tanh", "n_kernels": 6, "kx": 3, "ky": 3,
+             "padding": 1, "learning_rate": 0.05},
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "softmax", "output_sample_shape": 3,
+             "learning_rate": 0.05},
+        ],
+        loader_factory=lambda w: TinyImageLoader(
+            w, minibatch_size=48, prng=RandomGenerator("natc", seed=6)),
+        decision_config=dict(max_epochs=2),
+    )
+    sw.initialize(device=cpu_device)
+    sw.run()
+
+    pkg = str(tmp_path / "conv.veles.tar")
+    sw.package_export(pkg)
+    nwf = native.NativeWorkflow(pkg)
+    assert nwf.unit_count == 3
+
+    rng = numpy.random.RandomState(2)
+    x = rng.rand(8, 8, 8, 1).astype(numpy.float32)
+    got = nwf.run(x)
+    want = _jax_forward(sw, x).reshape(8, -1)
+    numpy.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_arena_reuses_memory(tmp_path, native, cpu_device):
+    """Strip packing must reuse bytes across non-adjacent stages: the
+    arena must be smaller than the sum of all stage buffers for a deep
+    chain (reference memory_optimizer.cc objective)."""
+    wf = DummyWorkflow()
+    layers = []
+    for _ in range(6):
+        layers.append({"type": "all2all_tanh", "output_sample_shape": 64,
+                       "learning_rate": 0.05})
+    layers.append({"type": "softmax", "output_sample_shape": 4,
+                   "learning_rate": 0.05})
+    sw = StandardWorkflow(
+        wf.workflow, layers=layers,
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64, prng=RandomGenerator("nata", seed=8)),
+        decision_config=dict(max_epochs=1),
+    )
+    sw.initialize(device=cpu_device)
+    pkg = str(tmp_path / "deep.veles.tar")
+    sw.package_export(pkg)
+    nwf = native.NativeWorkflow(pkg)
+    batch = 64
+    total_naive = sum(
+        batch * 64 * 4 for _ in range(6)) + batch * 4 * 4
+    arena = nwf.arena_size(batch)
+    assert arena < total_naive, (arena, total_naive)
+    # sanity: deep chain still computes
+    out = nwf.run(numpy.random.RandomState(3).rand(4, 16))
+    assert numpy.allclose(out.sum(axis=1), 1.0, atol=1e-4)
