@@ -1,0 +1,108 @@
+"""Snapshot -> resume end-to-end (reference: snapshotter.py:522 +
+workflow.py:338-340 + SURVEY.md section 3.4): training state, RNG, and
+epoch counters survive the pickle round-trip and training continues."""
+
+import os
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.snapshotter import Snapshotter, SnapshotterBase
+from tests.test_models import BlobsLoader
+
+
+def _build(device, max_epochs):
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64, prng=RandomGenerator("snap", seed=9)),
+        decision_config=dict(max_epochs=max_epochs),
+    )
+    sw.initialize(device=device)
+    return sw
+
+
+def test_snapshot_resume_continues_training(tmp_path, cpu_device):
+    sw = _build(cpu_device, max_epochs=2)
+    sw.run()
+    assert bool(sw.decision.complete)
+    epoch_before = sw.decision.epoch_number
+    sw.forwards[0].weights.map_read()
+    weights_before = numpy.array(sw.forwards[0].weights.mem)
+
+    blob = pickle.dumps(sw, protocol=pickle.HIGHEST_PROTOCOL)
+    restored = pickle.loads(blob)
+
+    # reattach to a fresh launcher and continue for 2 more epochs
+    restored.workflow = DummyLauncher()
+    restored.restored_from_snapshot_ = True
+    restored.decision.max_epochs = 4
+    restored.decision.complete <<= False
+    restored.initialize(device=cpu_device)
+
+    # weights survived the round trip
+    restored.forwards[0].weights.map_read()
+    numpy.testing.assert_array_equal(
+        restored.forwards[0].weights.mem, weights_before)
+    # epoch counter continued, not reset
+    assert restored.loader.epoch_number == epoch_before
+
+    restored.run()
+    assert bool(restored.decision.complete)
+    assert restored.decision.epoch_number >= 4
+    assert restored.decision.epoch_metrics[1] < 5.0
+
+
+def test_snapshotter_unit_writes_and_imports(tmp_path, cpu_device):
+    sw = _build(cpu_device, max_epochs=1)
+    snap = Snapshotter(sw, directory=str(tmp_path), prefix="t",
+                       interval=1, time_interval=0, compression="gz")
+    snap.initialize()
+    sw.run()
+    snap.run()
+    assert snap.destination and os.path.exists(snap.destination)
+    # _current symlink maintained (reference :388-409)
+    link = os.path.join(str(tmp_path), "t_current")
+    assert os.path.islink(link)
+
+    restored = SnapshotterBase.import_file(snap.destination)
+    assert type(restored).__name__ == "StandardWorkflow"
+    restored.workflow = DummyLauncher()
+    restored.initialize(device=cpu_device)
+    restored.forwards[0].weights.map_read()
+    sw.forwards[0].weights.map_read()
+    numpy.testing.assert_array_equal(
+        restored.forwards[0].weights.mem, sw.forwards[0].weights.mem)
+
+
+def test_snapshotter_codecs(tmp_path, cpu_device):
+    sw = _build(cpu_device, max_epochs=1)
+    for codec in ("", "gz", "bz2", "xz"):
+        snap = Snapshotter(sw, directory=str(tmp_path),
+                           prefix="c%s" % (codec or "raw"), interval=1,
+                           time_interval=0, compression=codec)
+        snap.initialize()
+        snap.export()
+        restored = SnapshotterBase.import_file(snap.destination)
+        assert restored is not None
+
+
+def test_slave_never_snapshots(tmp_path, cpu_device):
+    sw = _build(cpu_device, max_epochs=1)
+    sw.workflow.workflow_mode = "slave"
+    snap = Snapshotter(sw, directory=str(tmp_path), prefix="s",
+                       interval=1, time_interval=0)
+    snap.initialize()
+    snap.run()
+    assert snap.destination is None

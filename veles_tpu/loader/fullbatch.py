@@ -37,9 +37,14 @@ class FullBatchLoader(Loader):
         self.on_device = kwargs.get("on_device", True)
         self.original_data = Array()
         self.original_labels = []
-        self._mapped_original_labels_ = Array()
         self.device = None
         self.dtype = numpy.dtype(kwargs.get("dtype", numpy.float32))
+
+    def init_unpickled(self):
+        super(FullBatchLoader, self).init_unpickled()
+        # trailing-underscore attrs are not pickled; the mapped labels
+        # are rebuilt from original_labels by _map_original_labels()
+        self._mapped_original_labels_ = Array()
 
     @property
     def shape(self):

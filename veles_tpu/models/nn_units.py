@@ -251,20 +251,18 @@ class GradientDescentBase(Unit):
         return True
 
     def _init_solver_state(self):
-        need_second = self.solver == "adadelta"
-        for accum, param in ((self.accum_weights, self.weights),
-                             (self.accum_bias,
-                              self.bias if self.include_bias else None)):
+        pairs = [(self.accum_weights, self.weights),
+                 (self.accum_bias,
+                  self.bias if self.include_bias else None)]
+        if self.solver == "adadelta":
+            pairs += [(self.accum2_weights, self.weights),
+                      (self.accum2_bias,
+                       self.bias if self.include_bias else None)]
+        for accum, param in pairs:
             if param and not accum:
                 accum.mem = numpy.zeros(param.shape, param.dtype)
+            if accum:  # (re)attach, incl. after snapshot restore
                 accum.initialize(self.device)
-        if need_second:
-            for accum, param in ((self.accum2_weights, self.weights),
-                                 (self.accum2_bias,
-                                  self.bias if self.include_bias else None)):
-                if param and not accum:
-                    accum.mem = numpy.zeros(param.shape, param.dtype)
-                    accum.initialize(self.device)
 
     # -- hyperparameters bundled for the pure function ----------------------
 

@@ -17,13 +17,34 @@ and scalars across the graph without copying.
 __all__ = ["Bool", "LinkableAttribute"]
 
 
+def _op_or(a, b):
+    return bool(a) or bool(b)
+
+
+def _op_and(a, b):
+    return bool(a) and bool(b)
+
+
+def _op_xor(a, b):
+    return bool(a) != bool(b)
+
+
+def _op_not(a):
+    return not bool(a)
+
+
+#: named expression ops: picklable (unlike lambdas), so derived gate
+#: expressions stay LIVE across snapshot/restore
+_BOOL_OPS = {"or": _op_or, "and": _op_and, "xor": _op_xor, "not": _op_not}
+
+
 class Bool(object):
     """A mutable boolean cell supporting live derived expressions."""
 
-    __slots__ = ("_value", "_expr", "_args", "on_change")
+    __slots__ = ("_value", "_op", "_args", "on_change")
 
     def __init__(self, value=False):
-        self._expr = None
+        self._op = None
         self._args = ()
         self._value = bool(value)
         self.on_change = None
@@ -31,19 +52,19 @@ class Bool(object):
     # -- value access ------------------------------------------------------
 
     def __bool__(self):
-        if self._expr is not None:
-            return self._expr(*self._args)
+        if self._op is not None:
+            return _BOOL_OPS[self._op](*self._args)
         return self._value
 
     __nonzero__ = __bool__
 
     @property
     def derived(self):
-        return self._expr is not None
+        return self._op is not None
 
     def __ilshift__(self, value):
         """``flag <<= True`` assigns; assignment breaks derivation."""
-        self._expr = None
+        self._op = None
         self._args = ()
         new = bool(value)
         changed = new != self._value
@@ -55,45 +76,44 @@ class Bool(object):
     # -- derivation --------------------------------------------------------
 
     @staticmethod
-    def _derived(expr, *args):
+    def _derived(op, *args):
         b = Bool()
-        b._expr = expr
+        b._op = op
         b._args = args
         return b
 
     def __or__(self, other):
-        other = _as_bool(other)
-        return Bool._derived(lambda a, b: bool(a) or bool(b), self, other)
+        return Bool._derived("or", self, _as_bool(other))
 
     __ror__ = __or__
 
     def __and__(self, other):
-        other = _as_bool(other)
-        return Bool._derived(lambda a, b: bool(a) and bool(b), self, other)
+        return Bool._derived("and", self, _as_bool(other))
 
     __rand__ = __and__
 
     def __xor__(self, other):
-        other = _as_bool(other)
-        return Bool._derived(lambda a, b: bool(a) != bool(b), self, other)
+        return Bool._derived("xor", self, _as_bool(other))
 
     __rxor__ = __xor__
 
     def __invert__(self):
-        return Bool._derived(lambda a: not bool(a), self)
+        return Bool._derived("not", self)
 
     def __repr__(self):
         kind = "derived" if self.derived else "plain"
         return "<Bool %s %s>" % (kind, bool(self))
 
-    # Derived cells pickle as their current snapshot value; plain cells
-    # round-trip exactly.
+    # Both plain and derived cells round-trip: the op name + operand
+    # Bools pickle fine, and pickle preserves shared-object identity so
+    # a gate expression still tracks the SAME source cells after
+    # restore (the reference's gate-remembering semantics).
     def __getstate__(self):
-        return {"value": bool(self), "derived": self.derived}
+        return {"value": self._value, "op": self._op, "args": self._args}
 
     def __setstate__(self, state):
-        self._expr = None
-        self._args = ()
+        self._op = state.get("op")
+        self._args = state.get("args", ())
         self._value = state["value"]
         self.on_change = None
 
