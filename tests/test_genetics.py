@@ -1,0 +1,117 @@
+"""GA + ensemble tests (reference test model: veles/tests around
+genetics and wine_ensemble.json)."""
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.ensemble import EnsembleTester, EnsembleTrainer
+from veles_tpu.genetics import (
+    GeneticsOptimizer, Population, Tune, apply_values, extract_tunes,
+    gray_decode, gray_encode)
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+from tests.test_models import BlobsLoader
+
+
+def test_gray_roundtrip():
+    for value in (0.0, 0.25, 0.7, 1.0):
+        code = gray_encode(value, 0.0, 1.0, 12)
+        back = gray_decode(code, 0.0, 1.0, 12)
+        assert abs(back - value) < 1e-3
+
+
+def test_tune_extract_and_apply():
+    spec = {"layers": [
+        {"type": "tanh", "lr": Tune(0.05, 0.001, 0.5),
+         "units": Tune(32, 8, 64)},
+        {"type": "softmax", "lr": Tune(0.05, 0.001, 0.5)},
+    ]}
+    tunes = extract_tunes(spec)
+    assert len(tunes) == 3
+    candidate = apply_values(spec, tunes, [0.1, 16.4, 0.2])
+    # int Tune stays int
+    assert candidate["layers"][0]["units"] == 16
+    assert isinstance(candidate["layers"][0]["units"], int)
+    # original untouched
+    assert isinstance(spec["layers"][0]["units"], Tune)
+
+
+def test_population_converges_on_sphere():
+    """GA must find the maximum of -(x-0.3)^2-(y+0.2)^2."""
+    rng = RandomGenerator("ga", seed=11)
+    pop = Population([-1, -1], [1, 1], size=24, rng=rng,
+                     mutation="gaussian", mutation_rate=0.3)
+    for _ in range(15):
+        for c in pop.unevaluated():
+            c.fitness = -((c.values[0] - 0.3) ** 2 +
+                          (c.values[1] + 0.2) ** 2)
+        best = pop.best
+        pop.evolve()
+    assert abs(best.values[0] - 0.3) < 0.15
+    assert abs(best.values[1] + 0.2) < 0.15
+
+
+def test_binary_mutation_stays_in_bounds():
+    rng = RandomGenerator("gab", seed=3)
+    pop = Population([0], [10], size=8, rng=rng, binary_bits=8,
+                     mutation="binary", mutation_rate=0.2)
+    for _ in range(5):
+        for c in pop.unevaluated():
+            c.fitness = -abs(c.values[0] - 7)
+        pop.evolve()
+    for c in pop.chromosomes:
+        assert 0 <= c.values[0] <= 10
+
+
+def test_genetics_optimizer_on_analytic_fitness():
+    spec = {"x": Tune(0.0, -2.0, 2.0), "y": Tune(0.0, -2.0, 2.0)}
+
+    def fitness(candidate):
+        return -((candidate["x"] - 1.0) ** 2 + (candidate["y"] - 0.5) ** 2)
+
+    opt = GeneticsOptimizer(
+        spec, fitness, generations=10, population=20,
+        rng=RandomGenerator("gopt", seed=21), mutation_rate=0.3)
+    best_spec, best_fitness = opt.run()
+    assert best_fitness > -0.05
+    assert abs(best_spec["x"] - 1.0) < 0.25
+    assert len(opt.history) == 10
+
+
+def _member_factory(member, seed):
+    wf = DummyWorkflow()
+    return StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("ens%d" % member, seed=seed)),
+        decision_config=dict(max_epochs=3),
+    )
+
+
+def test_ensemble_train_and_test(tmp_path, cpu_device):
+    trainer = EnsembleTrainer(
+        _member_factory, size=3, directory=str(tmp_path),
+        device=cpu_device)
+    results_path = trainer.run()
+    assert len(trainer.results) == 3
+
+    tester = EnsembleTester(results_path, device=cpu_device)
+    # evaluate on freshly generated blobs (same generator as training)
+    wf = DummyWorkflow()
+    loader = BlobsLoader(wf, minibatch_size=64,
+                         prng=RandomGenerator("enstest", seed=77))
+    loader.initialize(device=None)
+    x = loader.original_data.mem[64:128]
+    labels = numpy.array(
+        [loader.labels_mapping[loader.original_labels[i]]
+         for i in range(64, 128)])
+    err = tester.error_rate(x, labels)
+    assert err < 10.0, "ensemble error %.1f%%" % err

@@ -1,0 +1,9 @@
+"""Ensembles: train N models, test by aggregating their outputs.
+
+TPU-native counterpart of reference veles/ensemble/ (base_workflow.py:59
+job farm, model_workflow.py:50 --ensemble-train, test_workflow.py
+--ensemble-test).
+"""
+
+from veles_tpu.ensemble.workflows import (  # noqa: F401
+    EnsembleTrainer, EnsembleTester)

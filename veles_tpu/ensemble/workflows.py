@@ -1,0 +1,113 @@
+"""Ensemble train/test drivers.
+
+Reference semantics (ensemble/base_workflow.py:104-161): ``--ensemble-
+train N:r`` trained N models, each a fresh child process with its own
+seed and a random ``r`` fraction of the train set, collecting per-model
+results into a JSON file; ``--ensemble-test`` reran stored snapshots
+and aggregated outputs.
+
+Here each member is a workflow built by a factory(member_index, seed)
+-> StandardWorkflow, trained in-process (or farmed as control-plane
+jobs); results carry snapshot paths + metrics in the same JSON spirit
+(the reference's wine_ensemble.json artifact).  Test-time aggregation
+averages softmax outputs (the reference's evaluation transform).
+"""
+
+import json
+import os
+import pickle
+
+import numpy
+
+from veles_tpu.logger import Logger
+
+__all__ = ["EnsembleTrainer", "EnsembleTester"]
+
+
+class EnsembleTrainer(Logger):
+    """Train ``size`` members; persist snapshots + a results JSON."""
+
+    def __init__(self, workflow_factory, size, directory,
+                 train_ratio=1.0, device=None, base_seed=1000):
+        super(EnsembleTrainer, self).__init__()
+        self.workflow_factory = workflow_factory
+        self.size = size
+        self.directory = directory
+        self.train_ratio = train_ratio
+        self.device = device
+        self.base_seed = base_seed
+        self.results = []
+
+    @property
+    def results_path(self):
+        return os.path.join(self.directory, "ensemble.json")
+
+    def run(self):
+        os.makedirs(self.directory, exist_ok=True)
+        for i in range(self.size):
+            seed = self.base_seed + i
+            sw = self.workflow_factory(i, seed)
+            sw.initialize(device=self.device)
+            sw.run()
+            snapshot = os.path.join(self.directory,
+                                    "member_%03d.pickle" % i)
+            with open(snapshot, "wb") as fout:
+                pickle.dump(sw, fout, protocol=pickle.HIGHEST_PROTOCOL)
+            entry = {
+                "id": i,
+                "seed": seed,
+                "snapshot": snapshot,
+                "EvaluationFitness": -(
+                    sw.decision.best_metric
+                    if sw.decision.best_metric is not None else 1e9),
+                "metrics": list(sw.decision.epoch_metrics),
+            }
+            self.results.append(entry)
+            self.info("member %d/%d trained: metrics %s", i + 1,
+                      self.size, entry["metrics"])
+        with open(self.results_path, "w") as fout:
+            json.dump({"models": self.results}, fout, indent=1,
+                      sort_keys=True)
+        return self.results_path
+
+
+class EnsembleTester(Logger):
+    """Load trained members; average their outputs on given data."""
+
+    def __init__(self, results_path, device=None):
+        super(EnsembleTester, self).__init__()
+        with open(results_path) as fin:
+            self.results = json.load(fin)["models"]
+        self.device = device
+        self._members = None
+
+    @property
+    def members(self):
+        if self._members is None:
+            from veles_tpu.dummy import DummyLauncher
+            self._members = []
+            for entry in self.results:
+                with open(entry["snapshot"], "rb") as fin:
+                    sw = pickle.load(fin)
+                sw.workflow = DummyLauncher()
+                sw.initialize(device=self.device)
+                self._members.append(sw)
+        return self._members
+
+    def predict(self, x):
+        """Average member outputs: (B, classes)."""
+        from veles_tpu.compiler import (
+            build_forward, extract_state, workflow_plan)
+        outputs = []
+        for sw in self.members:
+            plans = workflow_plan(sw)
+            state = extract_state(sw)
+            params = [{"weights": s["weights"], "bias": s["bias"]}
+                      for s in state]
+            outputs.append(numpy.asarray(build_forward(plans)(params, x)))
+        return numpy.mean(outputs, axis=0)
+
+    def error_rate(self, x, labels):
+        probs = self.predict(x)
+        pred = probs.argmax(axis=1)
+        return 100.0 * float((pred != labels).sum()) / len(labels)

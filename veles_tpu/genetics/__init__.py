@@ -1,0 +1,11 @@
+"""Hyper-parameter optimization by genetic algorithm.
+
+TPU-native counterpart of reference veles/genetics/ (core.py:122-370
+Chromosome/Population, config.py:45-223 Tune markers,
+optimization_workflow.py:70-260 job-farming optimizer).
+"""
+
+from veles_tpu.genetics.core import (  # noqa: F401
+    Chromosome, Population, gray_encode, gray_decode)
+from veles_tpu.genetics.config import Tune, extract_tunes, apply_values  # noqa
+from veles_tpu.genetics.optimizer import GeneticsOptimizer  # noqa: F401

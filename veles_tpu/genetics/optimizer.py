@@ -1,0 +1,70 @@
+"""GeneticsOptimizer: evolve a population by evaluating candidate
+configurations as jobs.
+
+Reference: genetics/optimization_workflow.py:70-260 farmed chromosome
+evaluations to slaves as master-slave jobs (each spawning a child veles
+process).  Here evaluations run through a pluggable evaluator:
+
+- in-process (default): ``fitness_fn(candidate_spec) -> float``;
+- process pool: ``workers=N`` evaluates candidates concurrently in
+  subprocesses (the task-parallelism strategy the reference used);
+- the control plane (veles_tpu.server) can farm the same callable as
+  jobs across hosts — see tests/test_genetics.py for the wiring.
+
+Fitness is MAXIMIZED (use -validation_error).
+"""
+
+import concurrent.futures
+
+from veles_tpu.genetics.config import apply_values, extract_tunes
+from veles_tpu.genetics.core import Population
+from veles_tpu.logger import Logger
+
+__all__ = ["GeneticsOptimizer"]
+
+
+class GeneticsOptimizer(Logger):
+    def __init__(self, spec, fitness_fn, generations=5, population=12,
+                 workers=0, rng=None, **population_kwargs):
+        super(GeneticsOptimizer, self).__init__()
+        self.spec = spec
+        self.fitness_fn = fitness_fn
+        self.generations = generations
+        self.workers = workers
+        self.tunes = extract_tunes(spec)
+        if not self.tunes:
+            raise ValueError("spec contains no Tune markers")
+        mins = [t.min for _, t in self.tunes]
+        maxs = [t.max for _, t in self.tunes]
+        self.population = Population(
+            mins, maxs, size=population, rng=rng, **population_kwargs)
+        self.history = []  # (generation, best_fitness, best_spec)
+
+    def candidate_spec(self, chromosome):
+        return apply_values(self.spec, self.tunes, chromosome.values)
+
+    def _evaluate_all(self):
+        pending = self.population.unevaluated()
+        specs = [self.candidate_spec(c) for c in pending]
+        if self.workers and len(pending) > 1:
+            with concurrent.futures.ProcessPoolExecutor(
+                    max_workers=self.workers) as pool:
+                fits = list(pool.map(self.fitness_fn, specs))
+        else:
+            fits = [self.fitness_fn(spec) for spec in specs]
+        for chromo, fitness in zip(pending, fits):
+            chromo.fitness = float(fitness)
+
+    def run(self):
+        """Returns (best_spec, best_fitness)."""
+        for gen in range(self.generations):
+            self._evaluate_all()
+            best = self.population.best
+            self.history.append(
+                (gen, best.fitness, self.candidate_spec(best)))
+            self.info("generation %d best fitness %.4f", gen,
+                      best.fitness)
+            if gen < self.generations - 1:
+                self.population.evolve()
+        best = self.population.best
+        return self.candidate_spec(best), best.fitness
