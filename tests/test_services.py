@@ -1,0 +1,168 @@
+"""Service layer tests: plotters (render golden PNGs), graphics
+pub/sub transport, web status HTTP, RESTful serving, publisher reports
+(reference test model: plotter PNG goldens in veles/tests/res, web
+status + forge HTTP tests)."""
+
+import json
+import os
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyUnit, DummyWorkflow
+from veles_tpu.memory import Array
+from veles_tpu.plotting_units import (
+    AccumulatingPlotter, Histogram, ImagePlotter, MatrixPlotter,
+    MultiHistogram, SlaveStats, TableMaxMin)
+from veles_tpu.prng import RandomGenerator
+
+
+@pytest.fixture(scope="module")
+def trained(request):
+    from veles_tpu.backends import Device
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from tests.test_models import BlobsLoader
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64, prng=RandomGenerator("svc", seed=12)),
+        decision_config=dict(max_epochs=3),
+    )
+    sw.initialize(device=Device(backend="cpu"))
+    sw.run()
+    return sw
+
+
+def test_plotters_render_pngs(tmp_path):
+    from veles_tpu.graphics_client import render_plot
+    wf = DummyWorkflow()
+    rng = numpy.random.RandomState(0)
+
+    acc = AccumulatingPlotter(wf, label="err")
+    acc.input = 5.0
+    for v in (5.0, 3.0, 2.0, 1.5):
+        acc.input = v
+        acc.capture()
+
+    mat = MatrixPlotter(wf)
+    mat.input = Array(rng.randint(0, 50, (4, 4)).astype(numpy.int32))
+    mat.capture()
+
+    img = ImagePlotter(wf)
+    img.input = Array(rng.rand(2, 8, 8).astype(numpy.float32))
+    img.capture()
+
+    hist = Histogram(wf)
+    hist.input = Array(rng.randn(500).astype(numpy.float32))
+    hist.capture()
+
+    multi = MultiHistogram(wf)
+    multi.inputs = [Array(rng.randn(100).astype(numpy.float32)),
+                    Array(rng.randn(100).astype(numpy.float32))]
+    multi.capture()
+
+    table = TableMaxMin(wf)
+    table.names = ["w0", "b0"]
+    table.inputs = [Array(rng.randn(10).astype(numpy.float32)),
+                    Array(rng.randn(5).astype(numpy.float32))]
+    table.capture()
+
+    stats = SlaveStats(wf)
+    stats.capture()
+
+    for plot in (acc, mat, img, hist, multi, table, stats):
+        path = render_plot(plot, str(tmp_path))
+        assert os.path.getsize(path) > 500, type(plot).__name__
+
+
+def test_graphics_pubsub_roundtrip(tmp_path):
+    import zmq
+
+    from veles_tpu.graphics_server import GraphicsServer
+    from veles_tpu import plotter as plotter_module
+
+    server = GraphicsServer()
+    context = zmq.Context.instance()
+    sub = context.socket(zmq.SUB)
+    sub.connect(server.endpoints["tcp"])
+    sub.setsockopt(zmq.SUBSCRIBE, b"")
+    import time
+    time.sleep(0.2)  # PUB/SUB join
+
+    wf = DummyWorkflow()
+    acc = AccumulatingPlotter(wf, label="loss")
+    acc.values = [3.0, 2.0, 1.0]
+    server.publish(acc)
+
+    assert sub.poll(3000), "no plot frame received"
+    plot = plotter_module.loads(sub.recv())
+    assert isinstance(plot, AccumulatingPlotter)
+    assert plot.values == [3.0, 2.0, 1.0]
+    sub.close(0)
+    server.shutdown()
+
+
+def test_web_status_roundtrip(trained):
+    from veles_tpu.web_status import StatusReporter, WebStatusServer
+    server = WebStatusServer()
+    server.start_background()
+    try:
+        reporter = StatusReporter(
+            "http://127.0.0.1:%d" % server.port, "sess1", trained)
+        assert reporter.post()["result"] == "ok"
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/status.json" % server.port) as r:
+            sessions = json.loads(r.read())
+        assert len(sessions) == 1
+        assert sessions[0]["id"] == "sess1"
+        assert sessions[0]["epoch"] == 3
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/" % server.port) as r:
+            page = r.read().decode()
+        assert "sess1" in page
+    finally:
+        server.stop()
+
+
+def test_restful_api_serves_inference(trained):
+    from veles_tpu.restful_api import RESTfulAPI
+    api = RESTfulAPI(trained)
+    api.initialize()
+    api.start_background()
+    try:
+        loader = trained.loader
+        x = loader.original_data.mem[0]
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api" % api.port,
+            data=json.dumps({"input": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            answer = json.loads(resp.read())
+        assert answer["result"] == loader.original_labels[0]
+        assert abs(sum(answer["probabilities"][0]) - 1.0) < 1e-3
+        assert api.requests_served == 1
+    finally:
+        api.stop()
+
+
+def test_publisher_markdown_and_html(tmp_path, trained):
+    from veles_tpu.publishing import HTMLBackend, MarkdownBackend, \
+        Publisher
+    pub = Publisher(trained, backends=[
+        MarkdownBackend(str(tmp_path)), HTMLBackend(str(tmp_path))])
+    pub.initialize()
+    pub.run()
+    md = open(os.path.join(str(tmp_path), "report.md")).read()
+    assert "Training report: StandardWorkflow" in md
+    assert "validation" in md
+    assert "| BlobsLoader |" in md
+    html = open(os.path.join(str(tmp_path), "report.html")).read()
+    assert "StandardWorkflow" in html

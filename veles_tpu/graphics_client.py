@@ -1,0 +1,56 @@
+"""Graphics client: subscribes to the PUB socket and renders plots.
+
+Reference veles/graphics_client.py:84 rendered with interactive
+matplotlib backends (incl. WebAgg); this renderer defaults to Agg with
+one PNG per plotter class (updated in place), which doubles as the
+golden-file path used by tests.  Run as
+``python -m veles_tpu.graphics_client --endpoint tcp://... --output d``.
+"""
+
+import argparse
+import os
+
+from veles_tpu import plotter as plotter_module
+
+__all__ = ["render_plot", "main"]
+
+
+def render_plot(plot, output_dir):
+    import matplotlib
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+    fig, axes = plt.subplots(figsize=(6, 4), dpi=96)
+    plot.render(axes)
+    path = os.path.join(output_dir, "%s.png" % type(plot).__name__)
+    fig.savefig(path)
+    plt.close(fig)
+    return path
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--endpoint", required=True)
+    parser.add_argument("--output", default=".")
+    parser.add_argument("--limit", type=int, default=0,
+                        help="exit after N plots (0 = run forever)")
+    args = parser.parse_args(argv)
+
+    import zmq
+    context = zmq.Context.instance()
+    socket = context.socket(zmq.SUB)
+    socket.connect(args.endpoint)
+    socket.setsockopt(zmq.SUBSCRIBE, b"")
+    os.makedirs(args.output, exist_ok=True)
+
+    count = 0
+    while True:
+        blob = socket.recv()
+        plot = plotter_module.loads(blob)
+        render_plot(plot, args.output)
+        count += 1
+        if args.limit and count >= args.limit:
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Graphics transport: ZMQ PUB broadcasting pickled plotters.
+
+Reference veles/graphics_server.py:65-174 bound inproc + ipc + EPGM
+multicast endpoints and launched a matplotlib client subprocess; here
+the PUB socket binds inproc + ipc + tcp (EPGM multicast needs pgm-built
+zmq, absent), and the client (veles_tpu.graphics_client) renders to PNG
+files or an interactive backend.
+"""
+
+import os
+import tempfile
+
+from veles_tpu.logger import Logger
+from veles_tpu import plotter as plotter_module
+
+__all__ = ["GraphicsServer"]
+
+
+class GraphicsServer(Logger):
+    def __init__(self, launcher=None):
+        super(GraphicsServer, self).__init__()
+        import zmq
+        self.context = zmq.Context.instance()
+        self.socket = self.context.socket(zmq.PUB)
+        self.endpoints = {}
+        port = self.socket.bind_to_random_port("tcp://127.0.0.1")
+        self.endpoints["tcp"] = "tcp://127.0.0.1:%d" % port
+        ipc_path = os.path.join(
+            tempfile.gettempdir(),
+            "veles-tpu-graphics-%d.ipc" % os.getpid())
+        try:
+            self.socket.bind("ipc://" + ipc_path)
+            self.endpoints["ipc"] = "ipc://" + ipc_path
+        except Exception:
+            pass
+        inproc = "inproc://veles-tpu-graphics"
+        try:
+            self.socket.bind(inproc)
+            self.endpoints["inproc"] = inproc
+        except Exception:
+            pass
+        if launcher is not None:
+            launcher.graphics_server = self
+        self.published = 0
+        self.info("graphics server on %s", self.endpoints["tcp"])
+
+    def publish(self, plot):
+        self.socket.send(plotter_module.dumps(plot))
+        self.published += 1
+
+    def shutdown(self):
+        self.socket.close(0)
+
+    @staticmethod
+    def launch_client(output_dir, endpoint, extra_env=None):
+        """Spawn the renderer subprocess (reference launched
+        graphics_client the same way)."""
+        import subprocess
+        import sys
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        return subprocess.Popen(
+            [sys.executable, "-m", "veles_tpu.graphics_client",
+             "--endpoint", endpoint, "--output", output_dir], env=env)

@@ -1,0 +1,73 @@
+"""Plotter framework: units that ship themselves to the graphics
+service for rendering.
+
+TPU-native counterpart of reference veles/plotter.py:48 +
+veles/graphics_server.py:65.  A Plotter unit's run() captures its
+linked data and publishes a stripped pickle of itself on the
+GraphicsServer's ZMQ PUB socket; a separate GraphicsClient process
+renders with matplotlib (reference kept the same split so training
+never blocks on rendering).  Payloads are gzip-pickled (the reference
+used snappy, absent from this image; the codec byte is explicit so
+more codecs can register).
+"""
+
+import gzip
+import pickle
+
+from veles_tpu.units import Unit
+
+__all__ = ["Plotter"]
+
+
+class Plotter(Unit):
+    """Base plotter; subclasses implement render(axes)."""
+
+    hide_from_registry = False
+    SERVER_ATTR = "graphics_server"
+
+    def __init__(self, workflow, **kwargs):
+        super(Plotter, self).__init__(workflow, **kwargs)
+        self.clear_plot = kwargs.get("clear_plot", False)
+        self.redraw_plot = kwargs.get("redraw_plot", True)
+
+    @property
+    def graphics_server(self):
+        launcher = self.launcher
+        return getattr(launcher, "graphics_server", None)
+
+    def run(self):
+        if self.workflow is not None and \
+                self.workflow.workflow_mode == "slave":
+            return  # plotting happens on master/standalone only
+        self.capture()
+        server = self.graphics_server
+        if server is not None:
+            server.publish(self)
+
+    def capture(self):
+        """Snapshot linked data into plain attributes before pickling."""
+
+    def render(self, axes):  # pragma: no cover - abstract
+        """Draw onto a matplotlib axes."""
+        raise NotImplementedError
+
+    def __getstate__(self):
+        state = super(Plotter, self).__getstate__()
+        state["_links_from"] = {}
+        state["_links_to"] = {}
+        state["_workflow"] = None
+        return state
+
+
+def dumps(plotter):
+    return b"g" + gzip.compress(
+        pickle.dumps(plotter, protocol=pickle.HIGHEST_PROTOCOL), 1)
+
+
+def loads(blob):
+    codec, payload = blob[:1], blob[1:]
+    if codec == b"g":
+        return pickle.loads(gzip.decompress(payload))
+    if codec == b"r":
+        return pickle.loads(payload)
+    raise ValueError("unknown plot codec %r" % codec)

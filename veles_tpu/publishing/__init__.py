@@ -1,0 +1,6 @@
+"""Report publishing after training (reference veles/publishing/:
+publisher gathers workflow info + plots; backends render it)."""
+
+from veles_tpu.publishing.publisher import Publisher  # noqa: F401
+from veles_tpu.publishing.backends import (  # noqa: F401
+    MarkdownBackend, HTMLBackend)

@@ -1,0 +1,83 @@
+"""Publishing backends: Markdown and HTML report writers
+(reference backend.py / confluence_backend.py / jinja templates)."""
+
+import json
+import os
+
+__all__ = ["MarkdownBackend", "HTMLBackend"]
+
+
+class BackendBase(object):
+    def __init__(self, output_dir):
+        self.output_dir = output_dir
+
+    def render(self, info):
+        raise NotImplementedError
+
+
+class MarkdownBackend(BackendBase):
+    def render(self, info):
+        os.makedirs(self.output_dir, exist_ok=True)
+        lines = [
+            "# Training report: %s" % info["name"],
+            "",
+            "- date: %s" % info["date"],
+            "- checksum: `%s`" % info["checksum"],
+            "- epochs: %s" % info["epochs"],
+            "",
+            "## Metrics",
+            "",
+            "| split | value |",
+            "|---|---|",
+        ]
+        for split in ("test", "validation", "train", "best"):
+            lines.append("| %s | %s |" % (split,
+                                          info["metrics"].get(split)))
+        lines += [
+            "",
+            "## Dataset",
+            "",
+            "| split | samples |",
+            "|---|---|",
+        ]
+        for split in ("test", "validation", "train"):
+            lines.append("| %s | %s |" % (split,
+                                          info["dataset"].get(split)))
+        lines += ["", "## Unit run times", "",
+                  "| unit | runs | seconds |", "|---|---|---|"]
+        for u in info["units"]:
+            lines.append("| %s | %d | %.4f |" % (u["name"], u["runs"],
+                                                 u["time"]))
+        if info.get("results"):
+            lines += ["", "## Results", "", "```json",
+                      json.dumps(info["results"], indent=1,
+                                 default=repr),
+                      "```"]
+        path = os.path.join(self.output_dir, "report.md")
+        with open(path, "w") as fout:
+            fout.write("\n".join(lines) + "\n")
+        return path
+
+
+class HTMLBackend(BackendBase):
+    def render(self, info):
+        os.makedirs(self.output_dir, exist_ok=True)
+        rows = "".join(
+            "<tr><td>%s</td><td>%s</td></tr>" % (k, info["metrics"][k])
+            for k in ("test", "validation", "train", "best"))
+        units = "".join(
+            "<tr><td>%s</td><td>%d</td><td>%.4f</td></tr>" %
+            (u["name"], u["runs"], u["time"]) for u in info["units"])
+        html = (
+            "<html><head><title>%s</title></head><body>"
+            "<h1>%s</h1><p>%s — epochs: %s</p>"
+            "<h2>Metrics</h2><table border=1>%s</table>"
+            "<h2>Units</h2><table border=1>"
+            "<tr><th>unit</th><th>runs</th><th>s</th></tr>%s</table>"
+            "</body></html>" % (
+                info["name"], info["name"], info["date"],
+                info["epochs"], rows, units))
+        path = os.path.join(self.output_dir, "report.html")
+        with open(path, "w") as fout:
+            fout.write(html)
+        return path

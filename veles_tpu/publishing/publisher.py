@@ -1,0 +1,65 @@
+"""Publisher unit: gathers a training report and hands it to backends.
+
+Reference veles/publishing/publisher.py collected workflow name, config,
+image of the workflow graph, plots, and result metrics, then rendered
+through Confluence/Markdown/PDF backends.  The info dict here carries
+the same material; Confluence/PDF need network/latex (absent) and are
+explicit unsupported-backend errors rather than silent stubs.
+"""
+
+import time
+
+from veles_tpu.units import Unit
+
+__all__ = ["Publisher"]
+
+
+class Publisher(Unit):
+    def __init__(self, workflow, **kwargs):
+        super(Publisher, self).__init__(workflow, **kwargs)
+        self.backends = list(kwargs.get("backends", ()))
+        self.plots_dir = kwargs.get("plots_dir")
+        self.reports = []
+
+    def gather_info(self):
+        sw = self.workflow
+        decision = getattr(sw, "decision", None)
+        loader = getattr(sw, "loader", None)
+        info = {
+            "name": type(sw).__name__,
+            "checksum": sw.checksum,
+            "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "epochs": getattr(decision, "epoch_number", None),
+            "metrics": {
+                "test": getattr(decision, "epoch_metrics",
+                                [None] * 3)[0],
+                "validation": getattr(decision, "epoch_metrics",
+                                      [None] * 3)[1],
+                "train": getattr(decision, "epoch_metrics",
+                                 [None] * 3)[2],
+                "best": getattr(decision, "best_metric", None),
+            },
+            "dataset": {
+                "test": loader.class_lengths[0] if loader else 0,
+                "validation": loader.class_lengths[1] if loader else 0,
+                "train": loader.class_lengths[2] if loader else 0,
+            },
+            "units": [
+                {"name": u.name, "runs": u.run_calls,
+                 "time": round(u.timers.get("run", 0.0), 4)}
+                for u in sw.units if u is not sw],
+            "graph_dot": sw.generate_graph(),
+            "plots_dir": self.plots_dir,
+        }
+        results = sw.gather_results()
+        if results:
+            info["results"] = results
+        return info
+
+    def run(self):
+        if self.workflow is not None and \
+                self.workflow.workflow_mode == "slave":
+            return
+        info = self.gather_info()
+        for backend in self.backends:
+            self.reports.append(backend.render(info))

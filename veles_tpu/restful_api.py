@@ -1,0 +1,110 @@
+"""RESTful serving of a trained workflow.
+
+Reference veles/restful_api.py:78: HTTP POST /api with {"input": ...}
+feeds the loader and returns the transformed evaluation result.  Here
+the unit compiles the workflow's forward (veles_tpu.compiler) once and
+serves it with tornado; the response carries the argmax label (and
+probabilities), matching root.common.evaluation_transform's default
+role.
+"""
+
+import json
+import threading
+
+import numpy
+
+from veles_tpu.units import Unit
+
+__all__ = ["RESTfulAPI"]
+
+
+class RESTfulAPI(Unit):
+    def __init__(self, workflow, **kwargs):
+        super(RESTfulAPI, self).__init__(workflow, **kwargs)
+        self.port = kwargs.get("port", 0)
+        self.path = kwargs.get("path", "/api")
+        self._forward = None
+        self._params = None
+        self._thread = None
+        self._loop = None
+        self.requests_served = 0
+
+    def initialize(self, **kwargs):
+        super(RESTfulAPI, self).initialize(**kwargs)
+        self._compile()
+        return True
+
+    def _compile(self):
+        from veles_tpu.compiler import (
+            build_forward, extract_state, workflow_plan)
+        sw = self.workflow
+        plans = workflow_plan(sw)
+        state = extract_state(sw)
+        self._params = [{"weights": s["weights"], "bias": s["bias"]}
+                        for s in state]
+        self._forward = build_forward(plans)
+
+    def infer(self, sample):
+        """sample: nested list/array (with or without batch dim)."""
+        x = numpy.asarray(sample, numpy.float32)
+        loader = getattr(self.workflow, "loader", None)
+        sample_shape = (loader.minibatch_data.shape[1:]
+                        if loader is not None and loader.minibatch_data
+                        else None)
+        if sample_shape is not None and x.shape == tuple(sample_shape):
+            x = x[None]
+        probs = numpy.asarray(self._forward(self._params, x))
+        labels = probs.argmax(axis=1)
+        mapping = (loader.reversed_labels_mapping
+                   if loader is not None else {})
+        named = [mapping.get(int(l), int(l)) for l in labels]
+        self.requests_served += len(labels)
+        return {"result": named if len(named) > 1 else named[0],
+                "probabilities": probs.tolist()}
+
+    # -- HTTP ---------------------------------------------------------------
+
+    def start_background(self):
+        import asyncio
+
+        import tornado.httpserver
+        import tornado.netutil
+        import tornado.web
+
+        unit = self
+
+        class ApiHandler(tornado.web.RequestHandler):
+            def post(self):
+                try:
+                    body = json.loads(self.request.body)
+                    self.write(unit.infer(body["input"]))
+                except Exception as exc:
+                    self.set_status(400)
+                    self.write({"error": str(exc)})
+
+        app = tornado.web.Application([(self.path, ApiHandler)])
+        started = threading.Event()
+
+        def serve():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            server = tornado.httpserver.HTTPServer(app)
+            sockets = tornado.netutil.bind_sockets(
+                self.port, address="127.0.0.1")
+            self.port = sockets[0].getsockname()[1]
+            server.add_sockets(sockets)
+            started.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=serve, daemon=True)
+        self._thread.start()
+        started.wait(5)
+        self.info("REST API on http://127.0.0.1:%d%s", self.port,
+                  self.path)
+        return self._thread
+
+    def stop(self):
+        super(RESTfulAPI, self).stop()
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)

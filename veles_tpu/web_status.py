@@ -1,0 +1,143 @@
+"""Web status service: collects launcher status posts, serves a
+dashboard.
+
+Reference veles/web_status.py:113 (tornado + MongoDB): masters POST
+periodic JSON status (launcher.py:852-885); the dashboard lists every
+known session.  MongoDB is absent from this image, so retention is an
+in-memory ring with optional JSONL persistence — the HTTP surface
+(POST /update, GET /status.json, GET /) is equivalent.
+"""
+
+import json
+import threading
+import time
+from collections import OrderedDict
+
+from veles_tpu.logger import Logger
+
+__all__ = ["WebStatusServer", "StatusReporter"]
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>veles-tpu status</title></head>
+<body><h1>veles-tpu sessions</h1><table border=1 cellpadding=4>
+<tr><th>id</th><th>workflow</th><th>mode</th><th>epoch</th>
+<th>metrics</th><th>slaves</th><th>updated</th></tr>
+%s</table></body></html>"""
+
+
+class WebStatusServer(Logger):
+    def __init__(self, port=0, persist_path=None, max_sessions=100):
+        super(WebStatusServer, self).__init__()
+        import tornado.web
+
+        self.sessions = OrderedDict()
+        self.max_sessions = max_sessions
+        self.persist_path = persist_path
+        server_self = self
+
+        class UpdateHandler(tornado.web.RequestHandler):
+            def post(self):
+                data = json.loads(self.request.body or b"{}")
+                server_self.record(data)
+                self.write({"result": "ok"})
+
+        class StatusHandler(tornado.web.RequestHandler):
+            def get(self):
+                self.set_header("Content-Type", "application/json")
+                self.write(json.dumps(list(
+                    server_self.sessions.values())))
+
+        class PageHandler(tornado.web.RequestHandler):
+            def get(self):
+                rows = []
+                for s in server_self.sessions.values():
+                    rows.append(
+                        "<tr>" + "".join(
+                            "<td>%s</td>" % s.get(k, "")
+                            for k in ("id", "workflow", "mode", "epoch",
+                                      "metrics", "slaves", "updated")) +
+                        "</tr>")
+                self.write(_PAGE % "\n".join(rows))
+
+        self.app = tornado.web.Application([
+            (r"/update", UpdateHandler),
+            (r"/status.json", StatusHandler),
+            (r"/", PageHandler),
+        ])
+        self.port = port
+        self._loop = None
+        self._thread = None
+
+    def record(self, data):
+        data = dict(data)
+        data["updated"] = time.strftime("%H:%M:%S")
+        sid = data.get("id", "?")
+        self.sessions[sid] = data
+        self.sessions.move_to_end(sid)
+        while len(self.sessions) > self.max_sessions:
+            self.sessions.popitem(last=False)
+        if self.persist_path:
+            with open(self.persist_path, "a") as fout:
+                fout.write(json.dumps(data) + "\n")
+
+    def start_background(self):
+        import asyncio
+
+        import tornado.httpserver
+
+        started = threading.Event()
+
+        def serve():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            server = tornado.httpserver.HTTPServer(self.app)
+            sockets = tornado.netutil.bind_sockets(
+                self.port, address="127.0.0.1")
+            self.port = sockets[0].getsockname()[1]
+            server.add_sockets(sockets)
+            started.set()
+            loop.run_forever()
+
+        import tornado.netutil
+        self._thread = threading.Thread(target=serve, daemon=True)
+        self._thread.start()
+        started.wait(5)
+        self.info("web status on http://127.0.0.1:%d/", self.port)
+        return self._thread
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class StatusReporter(object):
+    """Posts periodic session status to a WebStatusServer (the
+    launcher-side half, reference launcher.py:852-885)."""
+
+    def __init__(self, url, session_id, workflow):
+        self.url = url.rstrip("/")
+        self.session_id = session_id
+        self.workflow = workflow
+
+    def snapshot(self):
+        decision = getattr(self.workflow, "decision", None)
+        launcher = self.workflow.launcher
+        return {
+            "id": self.session_id,
+            "workflow": type(self.workflow).__name__,
+            "mode": getattr(launcher, "workflow_mode", "standalone"),
+            "epoch": getattr(decision, "epoch_number", None),
+            "metrics": getattr(decision, "epoch_metrics", None),
+            "slaves": len(getattr(
+                getattr(launcher, "_agent", None), "slaves", {}) or {}),
+        }
+
+    def post(self):
+        import urllib.request
+        req = urllib.request.Request(
+            self.url + "/update",
+            data=json.dumps(self.snapshot()).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
