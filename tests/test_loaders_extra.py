@@ -1,0 +1,210 @@
+"""Extended loader family tests: images+augmentation, HDF5, pickles,
+minibatch saver/replayer, queue/zmq feeds, ensemble loader,
+downloader."""
+
+import gzip
+import json
+import os
+import pickle
+import tarfile
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.prng import RandomGenerator
+
+
+def _write_images(root_dir, split, classes=2, per_class=6):
+    import cv2
+    rng = numpy.random.RandomState(0)
+    paths = []
+    for label in range(classes):
+        d = os.path.join(root_dir, split, "class%d" % label)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            img = (rng.rand(12, 12, 3) * 255).astype(numpy.uint8)
+            img[:, :, label] = 255  # class-colored channel
+            path = os.path.join(d, "img%02d.png" % i)
+            cv2.imwrite(path, img)
+            paths.append(path)
+    return paths
+
+
+def test_file_image_loader_and_augmentation(tmp_path):
+    from veles_tpu.loader.image import (
+        FileImageLoader, ImageAugmentation)
+    _write_images(str(tmp_path), "train", per_class=8)
+    _write_images(str(tmp_path), "valid", per_class=4)
+    wf = DummyWorkflow()
+    loader = FileImageLoader(
+        wf, minibatch_size=8,
+        validation_dir=os.path.join(str(tmp_path), "valid"),
+        train_dir=os.path.join(str(tmp_path), "train"),
+        augmentation=ImageAugmentation(
+            scale=(8, 8), prng=RandomGenerator("aug", seed=1)),
+        prng=RandomGenerator("img_l", seed=2))
+    loader.initialize(device=None)
+    assert loader.class_lengths == [0, 8, 16]
+    assert loader.shape == (8, 8, 3)
+    assert loader.unique_labels_count == 2
+    loader.run()
+    assert loader.minibatch_data.mem.max() <= 1.0
+
+
+def test_augmentation_ops():
+    from veles_tpu.loader.image import ImageAugmentation
+    img = numpy.zeros((10, 10, 3), numpy.uint8)
+    img[:, :5] = 255
+    aug = ImageAugmentation(mirror="always",
+                            prng=RandomGenerator("aug2", seed=1))
+    out = aug.apply(img)
+    assert out[:, :5].sum() == 0 and out[:, 5:].sum() > 0
+    aug2 = ImageAugmentation(crop=(4, 4),
+                             prng=RandomGenerator("aug3", seed=1))
+    assert aug2.apply(img).shape == (4, 4, 3)
+    aug3 = ImageAugmentation(color_space="GRAY",
+                             prng=RandomGenerator("aug4", seed=1))
+    assert aug3.apply(img).ndim == 2
+
+
+def test_hdf5_loader(tmp_path, cpu_device):
+    import h5py
+    rng = numpy.random.RandomState(1)
+    for split, n in (("train", 32), ("valid", 16)):
+        with h5py.File(str(tmp_path / ("%s.h5" % split)), "w") as f:
+            f["data"] = rng.rand(n, 6).astype(numpy.float32)
+            f["labels"] = (numpy.arange(n) % 3).astype(numpy.int64)
+    from veles_tpu.loader.hdf5 import FullBatchHDF5Loader
+    wf = DummyWorkflow()
+    loader = FullBatchHDF5Loader(
+        wf, minibatch_size=16,
+        validation_path=str(tmp_path / "valid.h5"),
+        train_path=str(tmp_path / "train.h5"),
+        prng=RandomGenerator("h5", seed=3))
+    loader.initialize(device=cpu_device)
+    assert loader.class_lengths == [0, 16, 32]
+    assert loader.unique_labels_count == 3
+    loader.run()
+    assert loader.minibatch_size == 16
+
+
+def test_pickles_loader(tmp_path):
+    rng = numpy.random.RandomState(2)
+    train = {"data": rng.rand(20, 4).astype(numpy.float32),
+             "labels": list(numpy.arange(20) % 2)}
+    with open(str(tmp_path / "train.pickle"), "wb") as f:
+        pickle.dump(train, f)
+    from veles_tpu.loader.pickles import PicklesLoader
+    wf = DummyWorkflow()
+    loader = PicklesLoader(
+        wf, minibatch_size=10, train_path=str(tmp_path / "train.pickle"),
+        prng=RandomGenerator("pk", seed=4))
+    loader.initialize(device=None)
+    assert loader.class_lengths == [0, 0, 20]
+    loader.run()
+    numpy.testing.assert_allclose(
+        loader.minibatch_data.mem[:10],
+        train["data"][loader.minibatch_indices.mem[:10]], rtol=1e-6)
+
+
+def test_minibatch_saver_and_replay(tmp_path):
+    from tests.test_models import BlobsLoader
+    from veles_tpu.loader.saver import (
+        MinibatchesLoader, MinibatchesSaver)
+    wf = DummyWorkflow()
+    loader = BlobsLoader(wf, minibatch_size=64,
+                         prng=RandomGenerator("sv", seed=5))
+    loader.initialize(device=None)
+    saver = MinibatchesSaver(wf, path=str(tmp_path / "mb.gz"))
+    saver.loader = loader
+    saver.initialize()
+    served = []
+    for _ in range(6):
+        loader.run()
+        saver.run()
+        served.append(numpy.array(
+            loader.minibatch_data.mem[:loader.minibatch_size]))
+    saver.close()
+
+    wf2 = DummyWorkflow()
+    replay = MinibatchesLoader(wf2, path=str(tmp_path / "mb.gz"),
+                               prng=RandomGenerator("sv2", seed=6))
+    replay.initialize(device=None)
+    assert replay.class_lengths == loader.class_lengths
+    for i in range(6):
+        replay.run()
+        numpy.testing.assert_allclose(
+            replay.minibatch_data.mem[:replay.minibatch_size],
+            served[i], rtol=1e-6)
+
+
+def test_queue_loader_feeds():
+    from veles_tpu.loader.feeds import InteractiveLoader
+    wf = DummyWorkflow()
+    loader = InteractiveLoader(wf, sample_shape=(4,), minibatch_size=1,
+                               prng=RandomGenerator("q", seed=7))
+    loader.initialize(device=None)
+    loader.feed([1.0, 2.0, 3.0, 4.0])
+    loader.run()
+    numpy.testing.assert_array_equal(
+        loader.minibatch_data.mem[0], [1, 2, 3, 4])
+    assert loader.minibatch_size == 1
+
+
+def test_zmq_loader_roundtrip():
+    import zmq
+    from veles_tpu.loader.feeds import ZeroMQLoader
+    wf = DummyWorkflow()
+    loader = ZeroMQLoader(wf, sample_shape=(3,), minibatch_size=1,
+                          prng=RandomGenerator("z", seed=8))
+    loader.initialize(device=None)
+    context = zmq.Context.instance()
+    sock = context.socket(zmq.DEALER)
+    sock.connect(loader.endpoint)
+    sock.send(pickle.dumps(numpy.array([9.0, 8.0, 7.0])))
+    loader.run()
+    numpy.testing.assert_array_equal(
+        loader.minibatch_data.mem[0], [9, 8, 7])
+    assert sock.recv() == b"ok"
+    sock.close(0)
+    loader.stop()
+
+
+def test_ensemble_loader(tmp_path):
+    from veles_tpu.loader.feeds import EnsembleLoader
+    results = {"models": [
+        {"id": 0, "snapshot": "a.pickle", "EvaluationFitness": -1.0},
+        {"id": 1, "snapshot": "b.pickle", "EvaluationFitness": -2.0},
+    ]}
+    path = str(tmp_path / "ens.json")
+    with open(path, "w") as f:
+        json.dump(results, f)
+    wf = DummyWorkflow()
+    loader = EnsembleLoader(wf, results_path=path, minibatch_size=1,
+                            prng=RandomGenerator("el", seed=9))
+    loader.initialize(device=None)
+    assert loader.class_lengths == [2, 0, 0]
+    loader.run()
+    assert loader.current_model["snapshot"] == "a.pickle"
+
+
+def test_downloader_file_url(tmp_path):
+    from veles_tpu.downloader import Downloader
+    payload_dir = tmp_path / "payload"
+    payload_dir.mkdir()
+    (payload_dir / "dataset.txt").write_text("hello")
+    archive = str(tmp_path / "ds.tar")
+    with tarfile.open(archive, "w") as tar:
+        tar.add(str(payload_dir / "dataset.txt"), arcname="dataset.txt")
+    wf = DummyWorkflow()
+    target = str(tmp_path / "out")
+    dl = Downloader(wf, url="file://" + archive, directory=target,
+                    files=["dataset.txt"])
+    dl.initialize()
+    assert (tmp_path / "out" / "dataset.txt").read_text() == "hello"
+    # second initialize: already satisfied, no refetch needed
+    dl2 = Downloader(wf, url="file:///nonexistent", directory=target,
+                     files=["dataset.txt"])
+    dl2.initialize()

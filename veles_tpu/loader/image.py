@@ -1,0 +1,143 @@
+"""Image loaders with augmentation.
+
+Counterpart of reference veles/loader/image.py:106 + file_image.py +
+fullbatch_image.py: scale / crop / rotate / mirror augmentation, color
+space conversion through OpenCV, directory-scanning file loaders, and a
+fullbatch composition that lands the whole image set in HBM.
+
+Augmentation happens at load/refresh time on host (CPU, numpy/cv2);
+the per-step path stays the device gather.  (A Pallas-side augmentation
+pipeline is a possible follow-up; the reference also augmented on CPU.)
+"""
+
+import os
+
+import numpy
+
+from veles_tpu.loader.base import Loader, LoaderError, TEST, VALID, TRAIN
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+__all__ = ["ImageAugmentation", "FullBatchImageLoader",
+           "FileImageLoader", "scan_image_tree"]
+
+IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".pgm",
+                    ".tif", ".tiff", ".webp")
+
+
+class ImageAugmentation(object):
+    """scale: output (w, h); crop: (w, h) random window; mirror:
+    False | True (random) | "always"; rotations: list of degrees to
+    sample from; color_space: target cv2 space name (e.g. "GRAY",
+    "HSV") from BGR source."""
+
+    def __init__(self, scale=None, crop=None, mirror=False,
+                 rotations=(0,), color_space=None, prng=None):
+        from veles_tpu import prng as prng_module
+        self.scale = scale
+        self.crop = crop
+        self.mirror = mirror
+        self.rotations = tuple(rotations)
+        self.color_space = color_space
+        self.prng = prng or prng_module.get("image_augmentation")
+
+    def apply(self, img):
+        import cv2
+        if self.color_space:
+            code = getattr(cv2, "COLOR_BGR2%s" % self.color_space)
+            img = cv2.cvtColor(img, code)
+        if self.scale:
+            img = cv2.resize(img, tuple(self.scale),
+                             interpolation=cv2.INTER_AREA)
+        if len(self.rotations) > 1 or self.rotations[0]:
+            angle = self.rotations[int(
+                self.prng.random_sample() * len(self.rotations))]
+            if angle:
+                h, w = img.shape[:2]
+                mat = cv2.getRotationMatrix2D((w / 2, h / 2), angle, 1.0)
+                img = cv2.warpAffine(img, mat, (w, h))
+        if self.crop:
+            cw, ch = self.crop
+            h, w = img.shape[:2]
+            if h < ch or w < cw:
+                raise LoaderError("crop %s larger than image %s" %
+                                  ((cw, ch), (w, h)))
+            x0 = int(self.prng.random_sample() * (w - cw + 1))
+            y0 = int(self.prng.random_sample() * (h - ch + 1))
+            img = img[y0:y0 + ch, x0:x0 + cw]
+        if self.mirror == "always" or (
+                self.mirror is True and self.prng.random_sample() < 0.5):
+            img = img[:, ::-1]
+        return numpy.ascontiguousarray(img)
+
+
+def scan_image_tree(root_dir):
+    """directory-per-class tree -> sorted [(path, label), ...]
+    (reference file_loader.py:48-277 scanning behavior)."""
+    samples = []
+    for label in sorted(os.listdir(root_dir)):
+        class_dir = os.path.join(root_dir, label)
+        if not os.path.isdir(class_dir):
+            continue
+        for fname in sorted(os.listdir(class_dir)):
+            if fname.lower().endswith(IMAGE_EXTENSIONS):
+                samples.append((os.path.join(class_dir, fname), label))
+    return samples
+
+
+class FullBatchImageLoader(FullBatchLoader):
+    """Loads explicit (path, label) lists per split into one device
+    batch (reference fullbatch_image.py:56-266).
+
+    kwargs: test_paths / validation_paths / train_paths: lists of
+    (path, label); augmentation: ImageAugmentation; grayscale: bool.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(FullBatchImageLoader, self).__init__(workflow, **kwargs)
+        self.split_paths = (kwargs.get("test_paths", ()),
+                            kwargs.get("validation_paths", ()),
+                            kwargs.get("train_paths", ()))
+        self.augmentation = kwargs.get("augmentation")
+        self.grayscale = kwargs.get("grayscale", False)
+
+    def _read_image(self, path):
+        import cv2
+        flag = cv2.IMREAD_GRAYSCALE if self.grayscale \
+            else cv2.IMREAD_COLOR
+        img = cv2.imread(path, flag)
+        if img is None:
+            raise LoaderError("cannot read image %s" % path)
+        if self.augmentation is not None:
+            img = self.augmentation.apply(img)
+        if img.ndim == 2:
+            img = img[..., None]
+        return img
+
+    def load_data(self):
+        for i, split in enumerate(self.split_paths):
+            self.class_lengths[i] = len(split)
+        self._calc_class_end_offsets()
+        flat = [pair for split in self.split_paths for pair in split]
+        first = self._read_image(flat[0][0])
+        self.create_originals(first.shape)
+        for i, (path, label) in enumerate(flat):
+            img = self._read_image(path)
+            if img.shape != first.shape:
+                raise LoaderError(
+                    "image %s shape %s != %s (use augmentation.scale)" %
+                    (path, img.shape, first.shape))
+            self.original_data.mem[i] = img.astype(self.dtype) / 255.0
+            self.original_labels[i] = label
+
+
+class FileImageLoader(FullBatchImageLoader):
+    """Scans directory trees: test_dir / validation_dir / train_dir
+    each holding class subdirectories (reference file_image.py:53)."""
+
+    def __init__(self, workflow, **kwargs):
+        dirs = [kwargs.get("test_dir"), kwargs.get("validation_dir"),
+                kwargs.get("train_dir")]
+        paths = tuple(scan_image_tree(d) if d else () for d in dirs)
+        kwargs["test_paths"], kwargs["validation_paths"], \
+            kwargs["train_paths"] = paths
+        super(FileImageLoader, self).__init__(workflow, **kwargs)
