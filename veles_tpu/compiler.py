@@ -93,14 +93,26 @@ def adopt_state(sw, new_state, device=None):
                 arr.set_device_array(entry[key], device or fwd.device)
 
 
-def _forward_for_loss(plans, params, x):
-    """Forward pass; returns (pre-softmax logits | final output)."""
+def _forward_for_loss(plans, params, x, key=None):
+    """Forward pass; returns (pre-softmax logits | final output).
+
+    ``key``: dropout rng; None (inference / keyless step) makes dropout
+    layers identity (inverted dropout needs no eval-time rescale).
+    """
     from veles_tpu.models.all2all import All2All, All2AllSoftmax
+    from veles_tpu.models.dropout import DropoutForward
     h = x
-    for plan, p in zip(plans, params):
+    for i, (plan, p) in enumerate(zip(plans, params)):
         if plan.forward_cls is All2AllSoftmax:
             # keep logits for a numerically-stable CE
             h = All2All.apply(p, h)
+        elif issubclass(plan.forward_cls, DropoutForward):
+            if key is not None:
+                import jax
+                mask = DropoutForward.make_mask(
+                    jax.random.fold_in(key, i), h.shape,
+                    plan.static.get("dropout_ratio", 0.5), h.dtype)
+                h = h * mask
         else:
             h = plan.forward_cls.apply(p, h, **plan.static)
     return h
@@ -133,8 +145,8 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
 
     hypers = [p.hyper_full() for p in plans]
 
-    def loss_fn(params, x, target, batch_size):
-        out = _forward_for_loss(plans, params, x)
+    def loss_fn(params, x, target, batch_size, key):
+        out = _forward_for_loss(plans, params, x, key)
         if loss == "softmax":
             labels = target
             valid = labels >= 0
@@ -153,11 +165,12 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
         diff = (out2 - t2) * mask
         return jnp.sum(diff * diff) / batch_size, jnp.zeros((), jnp.int32)
 
-    def step(state, x, target, batch_size):
+    def step(state, x, target, batch_size, step_key=None):
         params = [{"weights": s["weights"], "bias": s["bias"]}
                   for s in state]
         (loss_value, n_err), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, x, target, batch_size)
+            loss_fn, has_aux=True)(params, x, target, batch_size,
+                                   step_key)
         new_state = []
         for plan, hyper, s, g in zip(plans, hypers, state, grads):
             if s["weights"] is None:  # param-less layer (pooling, ...)

@@ -1,0 +1,213 @@
+"""Deconvolution (transposed conv) and depooling forward units +
+their GD counterparts — the convolutional-autoencoder building blocks
+(manualrst_veles_algorithms.rst: deconv / depooling).
+
+Deconv here is the gradient of Conv w.r.t. its input expressed as a
+forward op (lax.conv_transpose), matching how Znicz's deconv mirrored
+its conv unit.  Depooling upsamples by the pooling window (nearest for
+avg-depool, zero-stuffing handled by deconv in practice).
+"""
+
+import numpy
+
+from veles_tpu.models.conv import _norm_padding
+from veles_tpu.models.gd import GradientDescent
+from veles_tpu.models.nn_units import ForwardBase, GradientDescentBase
+
+__all__ = ["Deconv", "GDDeconv", "Depooling"]
+
+
+class Deconv(ForwardBase):
+    """y = conv_transpose(x, W); weights (ky, kx, out_ch, in_ch) so a
+    (conv, deconv) pair can SHARE weights (tied autoencoder)."""
+
+    MAPPING = "deconv"
+
+    def __init__(self, workflow, **kwargs):
+        super(Deconv, self).__init__(workflow, **kwargs)
+        self.n_output_channels = kwargs["n_output_channels"]
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        self.sliding = tuple(kwargs.get("sliding", (1, 1)))
+        self.padding = _norm_padding(kwargs.get("padding", 0))
+        self.include_bias = kwargs.get("include_bias", False)
+
+    @classmethod
+    def apply(cls, params, x, *, padding=(0, 0, 0, 0), sliding=(1, 1)):
+        import jax.numpy as jnp
+        from jax import lax
+        W = params["weights"]  # (ky, kx, out_ch, in_ch)
+        if x.ndim == 3:
+            x = x[..., None]
+        left, top, right, bottom = padding
+        sx, sy = sliding
+        ky, kx = W.shape[0], W.shape[1]
+        # `padding` follows the FORWARD conv convention (the pair's conv
+        # unit); lax.conv_transpose wants raw dilated-conv padding,
+        # which for forward padding p is k - 1 - p
+        z = lax.conv_transpose(
+            x, W,
+            strides=(sy, sx),
+            padding=((ky - 1 - top, ky - 1 - bottom),
+                     (kx - 1 - left, kx - 1 - right)),
+            dimension_numbers=("NHWC", "HWOI", "NHWC"),
+            preferred_element_type=jnp.float32)
+        if params.get("bias") is not None:
+            z = z + params["bias"]
+        return z.astype(x.dtype)
+
+    def static_config(self):
+        return {"padding": self.padding, "sliding": self.sliding}
+
+    def output_spatial(self, in_h, in_w):
+        left, top, right, bottom = self.padding
+        sx, sy = self.sliding
+        out_h = (in_h - 1) * sy + self.ky - top - bottom
+        out_w = (in_w - 1) * sx + self.kx - left - right
+        return out_h, out_w
+
+    def create_params(self):
+        if not self.input or self.input.sample_size == 0:
+            raise AttributeError(
+                "%s: input shape unknown at initialize" % self.name)
+        shape = self.input.shape
+        batch, in_h, in_w, in_ch = (
+            shape if len(shape) == 4 else shape + (1,))
+        if not self.output:
+            out_h, out_w = self.output_spatial(in_h, in_w)
+            self.output.mem = numpy.zeros(
+                (batch, out_h, out_w, self.n_output_channels),
+                numpy.float32)
+        if self.weights:
+            return
+        fan_in = self.kx * self.ky * in_ch
+        weights = numpy.zeros(
+            (self.ky, self.kx, self.n_output_channels, in_ch),
+            numpy.float32)
+        self.fill_array(weights, self.weights_filling,
+                        self.weights_stddev, fan_in)
+        self.weights.mem = weights
+        if self.include_bias:
+            self.bias.mem = numpy.zeros(
+                (self.n_output_channels,), numpy.float32)
+
+
+class GDDeconv(GradientDescent):
+    MAPPING = "deconv"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("include_bias", False)
+        super(GDDeconv, self).__init__(workflow, **kwargs)
+        self.sliding = tuple(kwargs.get("sliding", (1, 1)))
+        self.padding = _norm_padding(kwargs.get("padding", 0))
+
+    def backward_static(self):
+        return {"padding": self.padding, "sliding": self.sliding}
+
+    @classmethod
+    def backward(cls, state, hyper, x, y, err_output, *, solver,
+                 include_bias, need_err_input,
+                 padding=(0, 0, 0, 0), sliding=(1, 1)):
+        import jax
+        import jax.numpy as jnp
+        W = state["weights"]
+        err = err_output.astype(x.dtype)
+
+        def lin(W_, x_):
+            return Deconv.apply({"weights": W_, "bias": None}, x_,
+                                padding=padding, sliding=sliding)
+
+        _, vjp = jax.vjp(lin, W, x)
+        grad_w, err_input = vjp(err)
+        if not need_err_input:
+            err_input = None
+        grad_w = GradientDescentBase.regularized(
+            grad_w.astype(jnp.float32), W, hyper["weights_decay"],
+            hyper["l1_vs_l2"])
+        new_w, acc_w, acc2_w = GradientDescentBase.solver_update(
+            solver, W, grad_w.astype(W.dtype), state["accum_weights"],
+            state["accum2_weights"], hyper["learning_rate"],
+            hyper["gradient_moment"], hyper["adadelta_rho"],
+            hyper["solver_epsilon"])
+        new_state = {"weights": new_w, "accum_weights": acc_w,
+                     "accum2_weights": acc2_w}
+        if include_bias:
+            b = state["bias"]
+            grad_b = err.astype(jnp.float32).sum(axis=(0, 1, 2))
+            new_b, acc_b, acc2_b = GradientDescentBase.solver_update(
+                solver, b, grad_b.astype(b.dtype), state["accum_bias"],
+                state["accum2_bias"], hyper["learning_rate_bias"],
+                hyper["gradient_moment_bias"], hyper["adadelta_rho"],
+                hyper["solver_epsilon"])
+            new_state.update({"bias": new_b, "accum_bias": acc_b,
+                              "accum2_bias": acc2_b})
+        return err_input, new_state
+
+
+class Depooling(ForwardBase):
+    """Nearest-neighbour upsample by the pooling window — the
+    avg-depooling inverse used by conv autoencoders."""
+
+    MAPPING = "depooling"
+
+    def __init__(self, workflow, **kwargs):
+        super(Depooling, self).__init__(workflow, **kwargs)
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        self.include_bias = False
+
+    def static_config(self):
+        return {"window": (self.ky, self.kx)}
+
+    def param_arrays(self):
+        return []
+
+    def params_dict(self):
+        return {}
+
+    def params_numpy(self):
+        return {}
+
+    @classmethod
+    def apply(cls, params, x, *, window):
+        import jax.numpy as jnp
+        if x.ndim == 3:
+            x = x[..., None]
+        ky, kx = window
+        return jnp.repeat(jnp.repeat(x, ky, axis=1), kx, axis=2)
+
+    def create_params(self):
+        if not self.input or self.input.sample_size == 0:
+            raise AttributeError(
+                "%s: input shape unknown at initialize" % self.name)
+        if not self.output:
+            b, h, w, c = self.input.shape
+            self.output.mem = numpy.zeros(
+                (b, h * self.ky, w * self.kx, c), numpy.float32)
+
+
+class GDDepooling(GradientDescentBase):
+    MAPPING = "depooling"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("include_bias", False)
+        super(GDDepooling, self).__init__(workflow, **kwargs)
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        self._demanded.discard("weights")
+
+    def _init_solver_state(self):
+        pass
+
+    def backward_static(self):
+        return {"window": (self.ky, self.kx)}
+
+    @classmethod
+    def backward(cls, state, hyper, x, y, err_output, *, solver,
+                 include_bias, need_err_input, window):
+        import jax
+        def fwd(x_):
+            return Depooling.apply({}, x_, window=window)
+        _, vjp = jax.vjp(fwd, x)
+        (err_input,) = vjp(err_output.astype(x.dtype))
+        return err_input, {}

@@ -36,6 +36,9 @@ class DropoutForward(ForwardBase):
         self.demand("minibatch_class")
         self._step = 0
 
+    def static_config(self):
+        return {"dropout_ratio": self.dropout_ratio}
+
     def create_params(self):
         if not self.input or self.input.sample_size == 0:
             raise AttributeError(

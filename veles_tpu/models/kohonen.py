@@ -1,0 +1,139 @@
+"""Kohonen self-organising map units
+(manualrst_veles_algorithms.rst: Kohonen maps; Znicz kohonen.py
+capability, submodule empty — fresh design).
+
+KohonenForward computes the winner neuron per sample; KohonenTrainer
+applies the SOM update  w += alpha * neigh(dist_to_winner) * (x - w)
+with time-decayed learning rate and gaussian neighbourhood over the
+(rows, cols) grid.  Both paths are single jitted calls: the winner
+search is one matmul-shaped distance computation on the MXU, the
+update one masked outer accumulation.
+"""
+
+import numpy
+
+from veles_tpu import prng as prng_module
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+__all__ = ["KohonenForward", "KohonenTrainer"]
+
+
+def _grid_coords(rows, cols):
+    import jax.numpy as jnp
+    r = jnp.arange(rows)
+    c = jnp.arange(cols)
+    rr, cc = jnp.meshgrid(r, c, indexing="ij")
+    return jnp.stack([rr.ravel(), cc.ravel()], axis=1).astype(
+        jnp.float32)
+
+
+class KohonenBase(Unit):
+    def __init__(self, workflow, **kwargs):
+        super(KohonenBase, self).__init__(workflow, **kwargs)
+        self.shape = tuple(kwargs.get("shape", (8, 8)))  # (rows, cols)
+        self.input = None
+        self.weights = Array()
+        self.prng = kwargs.get("prng", prng_module.get())
+        self.device = None
+        self._jit_fn_ = None
+        self.demand("input")
+
+    def init_unpickled(self):
+        super(KohonenBase, self).init_unpickled()
+        self._jit_fn_ = None
+
+    @property
+    def neurons_number(self):
+        return self.shape[0] * self.shape[1]
+
+    def initialize(self, device=None, **kwargs):
+        self.device = device
+        super(KohonenBase, self).initialize(**kwargs)
+        if not self.input or self.input.sample_size == 0:
+            raise AttributeError("%s: input shape unknown" % self.name)
+        if not self.weights:
+            w = numpy.zeros(
+                (self.neurons_number, self.input.sample_size),
+                numpy.float32)
+            self.prng.fill(w, -0.5, 0.5)
+            self.weights.mem = w
+        self.weights.initialize(device)
+        return True
+
+
+class KohonenForward(KohonenBase):
+    """output = winner index per sample (argmin distance)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenForward, self).__init__(workflow, **kwargs)
+        self.output = Array()
+
+    @staticmethod
+    def winners(weights, x):
+        import jax.numpy as jnp
+        x2 = x.reshape(x.shape[0], -1)
+        # |x-w|^2 = |x|^2 - 2 x.w + |w|^2 ; |x|^2 constant per row
+        cross = jnp.dot(x2, weights.T,
+                        preferred_element_type=jnp.float32)
+        w_norm = jnp.sum(weights * weights, axis=1)
+        return jnp.argmin(w_norm - 2.0 * cross, axis=1).astype(
+            jnp.int32)
+
+    def run(self):
+        import jax
+        if self._jit_fn_ is None:
+            self._jit_fn_ = jax.jit(KohonenForward.winners)
+        self.input.map_read()
+        self.weights.map_read()
+        out = self._jit_fn_(self.weights.mem, self.input.mem)
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+
+class KohonenTrainer(KohonenBase):
+    """SOM update with gaussian neighbourhood + decaying radius/alpha."""
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenTrainer, self).__init__(workflow, **kwargs)
+        self.alpha = kwargs.get("alpha", 0.3)
+        self.alpha_decay = kwargs.get("alpha_decay", 0.995)
+        self.radius = kwargs.get("radius", max(self.shape) / 2.0)
+        self.radius_decay = kwargs.get("radius_decay", 0.995)
+        self.time = 0
+
+    @staticmethod
+    def update(weights, x, coords, alpha, radius):
+        import jax.numpy as jnp
+        coords = jnp.asarray(coords)
+        x2 = x.reshape(x.shape[0], -1)
+        winners = KohonenForward.winners(weights, x2)
+        win_coords = coords[winners]                     # (B, 2)
+        d2 = jnp.sum(
+            (coords[None, :, :] - win_coords[:, None, :]) ** 2, axis=2)
+        neigh = jnp.exp(-d2 / (2.0 * radius * radius))   # (B, N)
+        diff = x2[:, None, :] - weights[None, :, :]      # (B, N, F)
+        delta = alpha * jnp.einsum("bn,bnf->nf", neigh, diff) / \
+            x2.shape[0]
+        return weights + delta.astype(weights.dtype)
+
+    def run(self):
+        import functools
+
+        import jax
+        if self._jit_fn_ is None:
+            rows, cols = self.shape
+            coords = numpy.asarray(_grid_coords(rows, cols))
+            self._jit_fn_ = jax.jit(functools.partial(
+                KohonenTrainer.update, coords=coords))
+        self.time += 1
+        alpha = self.alpha * (self.alpha_decay ** self.time)
+        radius = max(self.radius * (self.radius_decay ** self.time),
+                     0.5)
+        self.input.map_read()
+        self.weights.map_read()
+        new_w = self._jit_fn_(
+            self.weights.mem, self.input.mem,
+            alpha=numpy.float32(alpha), radius=numpy.float32(radius))
+        self.weights.map_invalidate()
+        self.weights.mem = numpy.asarray(new_w)

@@ -31,19 +31,23 @@ def _build_mapping(module, base):
 
 
 def forward_mapping():
-    from veles_tpu.models import activation, conv, dropout, pooling
+    from veles_tpu.models import (
+        activation, conv, deconv, dropout, pooling, rnn)
     from veles_tpu.models.nn_units import ForwardBase
     mapping = {}
-    for module in (all2all, conv, pooling, dropout, activation):
+    for module in (all2all, conv, pooling, dropout, activation, deconv,
+                   rnn):
         mapping.update(_build_mapping(module, ForwardBase))
     return mapping
 
 
 def gd_mapping():
-    from veles_tpu.models import activation, dropout, gd_conv, gd_pooling
+    from veles_tpu.models import (
+        activation, deconv, dropout, gd_conv, gd_pooling, rnn)
     from veles_tpu.models.nn_units import GradientDescentBase
     mapping = {}
-    for module in (gd_module, gd_conv, gd_pooling, dropout, activation):
+    for module in (gd_module, gd_conv, gd_pooling, dropout, activation,
+                   deconv, rnn):
         mapping.update(_build_mapping(module, GradientDescentBase))
     return mapping
 

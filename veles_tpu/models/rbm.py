@@ -1,0 +1,116 @@
+"""Restricted Boltzmann Machine units
+(manualrst_veles_algorithms.rst: RBM; Znicz submodule empty — fresh
+design).
+
+Binary-binary RBM trained with CD-k contrastive divergence.  The whole
+CD step — up, k Gibbs alternations, down, gradient, update — is one
+jitted call using counter-based jax.random for the stochastic binary
+states (reproducible, nothing to checkpoint beyond the step counter).
+"""
+
+import numpy
+
+from veles_tpu import prng as prng_module
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+__all__ = ["RBM"]
+
+
+class RBM(Unit):
+    def __init__(self, workflow, **kwargs):
+        super(RBM, self).__init__(workflow, **kwargs)
+        self.hidden_size = kwargs["hidden_size"]
+        self.learning_rate = kwargs.get("learning_rate", 0.1)
+        self.cd_k = kwargs.get("cd_k", 1)
+        self.input = None  # linked minibatch (values in [0, 1])
+        self.weights = Array()
+        self.hidden_bias = Array()
+        self.visible_bias = Array()
+        self.prng = kwargs.get("prng", prng_module.get())
+        self.device = None
+        self._jit_fn_ = None
+        self._step = 0
+        self.reconstruction_error = 0.0
+        self.demand("input")
+
+    def init_unpickled(self):
+        super(RBM, self).init_unpickled()
+        self._jit_fn_ = None
+
+    def initialize(self, device=None, **kwargs):
+        self.device = device
+        super(RBM, self).initialize(**kwargs)
+        if not self.input or self.input.sample_size == 0:
+            raise AttributeError("%s: input shape unknown" % self.name)
+        visible = self.input.sample_size
+        if not self.weights:
+            w = numpy.zeros((visible, self.hidden_size), numpy.float32)
+            self.prng.fill_normal(w, 0.0, 0.01)
+            self.weights.mem = w
+            self.hidden_bias.mem = numpy.zeros(
+                self.hidden_size, numpy.float32)
+            self.visible_bias.mem = numpy.zeros(visible, numpy.float32)
+        return True
+
+    @staticmethod
+    def cd_step(key, W, hb, vb, v0, lr, cd_k):
+        import jax
+        import jax.numpy as jnp
+
+        def h_probs(v):
+            return jax.nn.sigmoid(
+                jnp.dot(v, W, preferred_element_type=jnp.float32) + hb)
+
+        def v_probs(h):
+            return jax.nn.sigmoid(
+                jnp.dot(h, W.T, preferred_element_type=jnp.float32) + vb)
+
+        v0 = v0.reshape(v0.shape[0], -1)
+        ph0 = h_probs(v0)
+        key, sub = jax.random.split(key)
+        h = (jax.random.uniform(sub, ph0.shape) < ph0).astype(
+            jnp.float32)
+        vk = v0
+        for _ in range(cd_k):
+            vk = v_probs(h)  # probabilities (common CD practice)
+            phk = h_probs(vk)
+            key, sub = jax.random.split(key)
+            h = (jax.random.uniform(sub, phk.shape) < phk).astype(
+                jnp.float32)
+        phk = h_probs(vk)
+        batch = v0.shape[0]
+        grad_w = (jnp.dot(v0.T, ph0,
+                          preferred_element_type=jnp.float32) -
+                  jnp.dot(vk.T, phk,
+                          preferred_element_type=jnp.float32)) / batch
+        grad_hb = jnp.mean(ph0 - phk, axis=0)
+        grad_vb = jnp.mean(v0 - vk, axis=0)
+        err = jnp.mean((v0 - vk) ** 2)
+        return (W + lr * grad_w, hb + lr * grad_hb, vb + lr * grad_vb,
+                err)
+
+    def run(self):
+        import functools
+
+        import jax
+        if self._jit_fn_ is None:
+            self._jit_fn_ = jax.jit(functools.partial(
+                RBM.cd_step, cd_k=self.cd_k))
+        self._step += 1
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.prng.seed_value or 0), self._step)
+        for arr in (self.input, self.weights, self.hidden_bias,
+                    self.visible_bias):
+            arr.map_read()
+        new_w, new_hb, new_vb, err = self._jit_fn_(
+            key, self.weights.mem, self.hidden_bias.mem,
+            self.visible_bias.mem, self.input.mem,
+            numpy.float32(self.learning_rate))
+        self.weights.map_invalidate()
+        self.weights.mem = numpy.asarray(new_w)
+        self.hidden_bias.map_invalidate()
+        self.hidden_bias.mem = numpy.asarray(new_hb)
+        self.visible_bias.map_invalidate()
+        self.visible_bias.mem = numpy.asarray(new_vb)
+        self.reconstruction_error = float(err)

@@ -1,0 +1,186 @@
+"""Recurrent layers: vanilla RNN and LSTM.
+
+The reference's RNN/LSTM lived in Znicz and were marked untested
+(manualrst_veles_algorithms.rst:113-135).  TPU-first design: the time
+loop is ``lax.scan`` (single compiled loop, no Python unrolling), the
+input projection for ALL timesteps is one big batched matmul feeding
+the MXU, and BPTT comes from ``jax.vjp`` through the scan — no manual
+backward kernels.
+
+Input is (B, T, F); output (B, T, H) ("sequence" mode) or (B, H)
+(final state, ``return_sequences=False``).
+"""
+
+import numpy
+
+from veles_tpu.models.gd import GradientDescent
+from veles_tpu.models.nn_units import ForwardBase, GradientDescentBase
+
+__all__ = ["RNN", "LSTM", "GDRNN", "GDLSTM"]
+
+
+class RecurrentBase(ForwardBase):
+    def __init__(self, workflow, **kwargs):
+        super(RecurrentBase, self).__init__(workflow, **kwargs)
+        self.hidden_size = kwargs["hidden_size"]
+        self.return_sequences = kwargs.get("return_sequences", True)
+
+    def static_config(self):
+        return {"return_sequences": self.return_sequences}
+
+    #: gates per hidden unit (1 for RNN, 4 for LSTM)
+    GATES = 1
+
+    def create_params(self):
+        if not self.input or self.input.sample_size == 0:
+            raise AttributeError(
+                "%s: input shape unknown at initialize" % self.name)
+        batch, seq, features = self.input.shape
+        h = self.hidden_size
+        if not self.output:
+            out_shape = (batch, seq, h) if self.return_sequences \
+                else (batch, h)
+            self.output.mem = numpy.zeros(out_shape, numpy.float32)
+        if self.weights:
+            return
+        g = self.GATES
+        # packed: input kernel (F, G*H) then recurrent kernel (H, G*H)
+        weights = numpy.zeros((features + h, g * h), numpy.float32)
+        self.fill_array(weights, self.weights_filling,
+                        self.weights_stddev, features + h)
+        self.weights.mem = weights
+        if self.include_bias:
+            self.bias.mem = numpy.zeros((g * h,), numpy.float32)
+
+
+class RNN(RecurrentBase):
+    """h_t = tanh(x_t Wx + h_{t-1} Wh + b)."""
+
+    MAPPING = "rnn"
+    GATES = 1
+
+    @classmethod
+    def apply(cls, params, x, *, return_sequences=True):
+        import jax.numpy as jnp
+        from jax import lax
+        W = params["weights"]
+        features = x.shape[-1]
+        Wx, Wh = W[:features], W[features:]
+        h_size = Wh.shape[0]
+        b = params.get("bias")
+        # one MXU matmul for every timestep's input projection
+        xw = jnp.einsum("btf,fh->bth", x, Wx,
+                        preferred_element_type=jnp.float32)
+        if b is not None:
+            xw = xw + b
+
+        def step(h, xw_t):
+            h = jnp.tanh(xw_t + jnp.dot(
+                h, Wh, preferred_element_type=jnp.float32))
+            return h.astype(x.dtype), h.astype(x.dtype)
+
+        h0 = jnp.zeros((x.shape[0], h_size), x.dtype)
+        h_last, hs = lax.scan(step, h0, jnp.swapaxes(xw, 0, 1))
+        return (jnp.swapaxes(hs, 0, 1) if return_sequences
+                else h_last).astype(x.dtype)
+
+
+class LSTM(RecurrentBase):
+    """Standard LSTM (gates i, f, g, o packed on the last axis)."""
+
+    MAPPING = "lstm"
+    GATES = 4
+
+    @classmethod
+    def apply(cls, params, x, *, return_sequences=True):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        W = params["weights"]
+        features = x.shape[-1]
+        Wx, Wh = W[:features], W[features:]
+        h_size = Wh.shape[0]
+        b = params.get("bias")
+        xw = jnp.einsum("btf,fh->bth", x, Wx,
+                        preferred_element_type=jnp.float32)
+        if b is not None:
+            xw = xw + b
+
+        def step(carry, xw_t):
+            h, c = carry
+            z = xw_t + jnp.dot(h, Wh,
+                               preferred_element_type=jnp.float32)
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            h = h.astype(x.dtype)
+            return (h, c.astype(x.dtype)), h
+
+        h0 = jnp.zeros((x.shape[0], h_size), x.dtype)
+        (h_last, _), hs = lax.scan(
+            step, (h0, h0), jnp.swapaxes(xw, 0, 1))
+        return (jnp.swapaxes(hs, 0, 1) if return_sequences
+                else h_last).astype(x.dtype)
+
+
+class _GDRecurrent(GradientDescent):
+    MAPPING = None  # abstract: do not register (would shadow all2all)
+    FORWARD_CLS = None
+
+    def __init__(self, workflow, **kwargs):
+        super(_GDRecurrent, self).__init__(workflow, **kwargs)
+        self.return_sequences = kwargs.get("return_sequences", True)
+
+    def backward_static(self):
+        return {"return_sequences": self.return_sequences}
+
+    @classmethod
+    def backward(cls, state, hyper, x, y, err_output, *, solver,
+                 include_bias, need_err_input, return_sequences=True):
+        import jax
+        import jax.numpy as jnp
+        W = state["weights"]
+        b = state["bias"] if include_bias else None
+
+        def fwd(W_, b_, x_):
+            return cls.FORWARD_CLS.apply(
+                {"weights": W_, "bias": b_}, x_,
+                return_sequences=return_sequences)
+
+        _, vjp = jax.vjp(fwd, W, b, x)
+        grad_w, grad_b, err_input = vjp(err_output.astype(y.dtype))
+        if not need_err_input:
+            err_input = None
+        grad_w = GradientDescentBase.regularized(
+            grad_w.astype(jnp.float32), W, hyper["weights_decay"],
+            hyper["l1_vs_l2"])
+        new_w, acc_w, acc2_w = GradientDescentBase.solver_update(
+            solver, W, grad_w.astype(W.dtype), state["accum_weights"],
+            state["accum2_weights"], hyper["learning_rate"],
+            hyper["gradient_moment"], hyper["adadelta_rho"],
+            hyper["solver_epsilon"])
+        new_state = {"weights": new_w, "accum_weights": acc_w,
+                     "accum2_weights": acc2_w}
+        if include_bias and grad_b is not None:
+            new_b, acc_b, acc2_b = GradientDescentBase.solver_update(
+                solver, b, grad_b.astype(b.dtype), state["accum_bias"],
+                state["accum2_bias"], hyper["learning_rate_bias"],
+                hyper["gradient_moment_bias"], hyper["adadelta_rho"],
+                hyper["solver_epsilon"])
+            new_state.update({"bias": new_b, "accum_bias": acc_b,
+                              "accum2_bias": acc2_b})
+        return err_input, new_state
+
+
+class GDRNN(_GDRecurrent):
+    MAPPING = "rnn"
+    FORWARD_CLS = RNN
+
+
+class GDLSTM(_GDRecurrent):
+    MAPPING = "lstm"
+    FORWARD_CLS = LSTM
