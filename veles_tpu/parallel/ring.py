@@ -1,0 +1,126 @@
+"""Long-context attention over the sequence axis of a mesh.
+
+The reference (2015-era) has no attention; SURVEY.md section 5 marks
+long-context as "no reference behavior to match".  This framework still
+ships it as a first-class capability of the parallel layer, TPU-native:
+
+- :func:`ring_attention` — blockwise (flash-style online-softmax)
+  attention where K/V shards rotate around the mesh's sequence axis via
+  ``lax.ppermute`` over ICI; memory per chip stays O(T_local^2-free):
+  each step touches one (T_local x T_local) score block, so sequences
+  scale linearly with the ring size.
+- :func:`ulysses_attention` — the all-to-all alternative: resharding
+  (seq-sharded -> head-sharded) with ``lax.all_to_all``, full local
+  attention per head group, and the inverse all-to-all back.
+
+Both support causal masking with globally-correct positions and are
+exact (tested against a single-device oracle on the virtual mesh).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention", "attention_reference"]
+
+
+def attention_reference(q, k, v, causal=False):
+    """Single-device oracle: q,k,v (B, T, H, D) -> (B, T, H, D)."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _ring_body(my_index, n_shards, t_local, axis_name, causal, scale,
+               q, k, v):
+    """Per-shard ring loop; q,k,v are the LOCAL shards (B, Tl, H, D)."""
+    batch, _, heads, depth = q.shape
+    q_pos = my_index * t_local + jnp.arange(t_local)
+
+    m = jnp.full((batch, heads, t_local), -jnp.inf, jnp.float32)
+    l = jnp.zeros((batch, heads, t_local), jnp.float32)
+    o = jnp.zeros((batch, heads, t_local, depth), jnp.float32)
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, o = carry
+        src = (my_index - i) % n_shards  # origin rank of current block
+        k_pos = src * t_local + jnp.arange(t_local)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m = m_new
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, o
+
+    _, _, m, l, o = lax.fori_loop(0, n_shards, body, (k, v, m, l, o))
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False):
+    """q,k,v (B, T, H, D), T sharded over ``seq_axis``."""
+    scale = 1.0 / float(jnp.sqrt(q.shape[-1]))
+    n_shards = mesh.shape[seq_axis]
+    t_local = q.shape[1] // n_shards
+
+    def sharded(q_s, k_s, v_s):
+        my = lax.axis_index(seq_axis)
+        return _ring_body(my, n_shards, t_local, seq_axis, causal,
+                          scale, q_s, k_s, v_s)
+
+    spec = P(None, seq_axis)
+    fn = jax.shard_map(
+        sharded, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh, seq_axis="seq", causal=False):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style):
+    reshard (T/n, H) -> (T, H/n), run full local attention on the head
+    group, reshard back.  Requires heads %% n_shards == 0."""
+    n_shards = mesh.shape[seq_axis]
+    if q.shape[2] % n_shards:
+        raise ValueError("heads %d not divisible by mesh axis %d" %
+                         (q.shape[2], n_shards))
+
+    def sharded(q_s, k_s, v_s):
+        # local: (B, T/n, H, D) -> all_to_all -> (B, T, H/n, D)
+        def spread(x):
+            return lax.all_to_all(x, seq_axis, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+        def gather_back(x):
+            return lax.all_to_all(x, seq_axis, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+        qh, kh, vh = spread(q_s), spread(k_s), spread(v_s)
+        out = attention_reference(qh, kh, vh, causal=causal)
+        return gather_back(out)
+
+    spec = P(None, seq_axis)
+    fn = jax.shard_map(
+        sharded, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    return fn(q, k, v)
