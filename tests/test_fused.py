@@ -1,0 +1,78 @@
+"""FusedTrainer: the single-dispatch training path inside the standard
+workflow loop."""
+
+import numpy
+import pytest
+
+from veles_tpu.prng import RandomGenerator
+from tests.test_models import BlobsLoader, build_mnist_like
+
+
+def _build_fused(device, max_epochs=10):
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("fused", seed=7)),
+        decision_config=dict(max_epochs=max_epochs),
+    )
+    sw.fuse()
+    sw.initialize(device=device)
+    return sw
+
+
+def test_fused_workflow_trains(cpu_device):
+    sw = _build_fused(cpu_device)
+    sw.run()
+    assert bool(sw.decision.complete)
+    assert sw.decision.epoch_metrics[1] is not None
+    assert sw.decision.epoch_metrics[1] < 5.0
+    assert sw.fused_trainer.run_calls > 0
+    # forwards/gds left the control graph
+    assert sw.forwards[0].run_calls == 0
+    assert sw.gds[0].run_calls == 0
+
+
+def test_fused_matches_unit_path_quality(cpu_device):
+    fused = _build_fused(cpu_device)
+    fused.run()
+    unit = build_mnist_like(cpu_device, )
+    unit.decision.max_epochs = 10
+    unit.run()
+    # same architecture/task: both reach ~0 validation error
+    assert fused.decision.epoch_metrics[1] <= \
+        unit.decision.epoch_metrics[1] + 3.0
+
+
+def test_fused_snapshot_roundtrip(cpu_device):
+    import pickle
+
+    from veles_tpu.dummy import DummyLauncher
+    sw = _build_fused(cpu_device, max_epochs=2)
+    sw.run()
+    sw.fused_trainer.sync()
+    sw.forwards[0].weights.map_read()
+    w_before = numpy.array(sw.forwards[0].weights.mem)
+    assert numpy.abs(w_before).sum() > 0
+
+    blob = pickle.dumps(sw)
+    restored = pickle.loads(blob)
+    restored.workflow = DummyLauncher()
+    restored.restored_from_snapshot_ = True
+    restored.decision.max_epochs = 4
+    restored.decision.complete <<= False
+    restored.initialize(device=cpu_device)
+    restored.forwards[0].weights.map_read()
+    numpy.testing.assert_array_equal(
+        restored.forwards[0].weights.mem, w_before)
+    restored.run()
+    assert restored.decision.epoch_metrics[1] < 5.0

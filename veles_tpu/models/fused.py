@@ -1,0 +1,152 @@
+"""FusedTrainer — run the whole forward+loss+backward+update chain as
+ONE jitted dispatch inside a standard workflow.
+
+This is the performance path promised by veles_tpu.compiler: the unit
+graph keeps orchestrating (loader serves minibatches, decision stops
+training, snapshotter checkpoints), but between loader and decision a
+single FusedTrainer replaces forwards + evaluator + GD units.  Per
+minibatch there is exactly one XLA computation and zero host transfers
+besides the scalar metrics the decision unit needs.
+
+``StandardWorkflow.fuse()`` rewires an existing workflow in place, so
+every already-written config gains the fused path without changes.
+"""
+
+import numpy
+
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.units import Unit
+
+__all__ = ["FusedTrainer", "fuse_standard_workflow"]
+
+
+class FusedTrainer(Unit):
+    """Wraps compiler.build_train_step over a StandardWorkflow's
+    layers; exposes evaluator-compatible metrics (n_err / mse_sum) so
+    the decision unit works unchanged."""
+
+    def __init__(self, workflow, sw, **kwargs):
+        super(FusedTrainer, self).__init__(workflow, **kwargs)
+        self.sw = sw
+        self.loss = sw.loss
+        self.device = None
+        self._step_fn = None
+        self._state = None
+        self._dropout_base_key = kwargs.get("dropout_seed", 0)
+        self._iteration = 0
+        # evaluator-compatible surface for DecisionGD / DecisionMSE
+        self.n_err = 0
+        self.mse_sum = 0.0
+        self.n_samples = 0
+        self.last_loss = None
+
+    def initialize(self, device=None, **kwargs):
+        self.device = device
+        super(FusedTrainer, self).initialize(**kwargs)
+        return True
+
+    def _compile(self):
+        import jax
+
+        from veles_tpu.compiler import (
+            build_train_step, extract_state, workflow_plan)
+        plans = workflow_plan(self.sw)
+        self._plans = plans
+        self._step_fn = build_train_step(
+            plans, loss=self.loss, donate=True)
+        self._forward_only = jax.jit(
+            __import__("veles_tpu.compiler", fromlist=["x"])
+            .build_forward(plans))
+        self._state = extract_state(self.sw)
+        self._has_dropout = any(
+            p.static.get("dropout_ratio") is not None for p in plans)
+
+    def sync(self):
+        """Write the fused state back into the unit Arrays (on demand:
+        snapshots, plotting, package export)."""
+        from veles_tpu.compiler import adopt_state
+        if self._state is not None:
+            adopt_state(self.sw, self._state, self.device)
+
+    _sync_state_to_units = sync
+
+    def run(self):
+        import jax
+
+        if self._step_fn is None:
+            self._compile()
+        loader = self.sw.loader
+        x = loader.minibatch_data.devmem
+        if self.loss == "softmax":
+            target = loader.minibatch_labels.devmem
+        else:
+            target = loader.minibatch_targets.devmem
+        batch_size = numpy.float32(loader.minibatch_size)
+
+        if loader.minibatch_class == TRAIN:
+            self._iteration += 1
+            key = None
+            if self._has_dropout:
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(self._dropout_base_key),
+                    self._iteration)
+            if key is not None:
+                self._state, metrics = self._step_fn(
+                    self._state, x, target, batch_size, key)
+            else:
+                self._state, metrics = self._step_fn(
+                    self._state, x, target, batch_size)
+            self.last_loss = float(metrics["loss"])
+            self.n_err = int(metrics["n_err"])
+            self.mse_sum = self.last_loss * float(batch_size)
+        else:
+            # eval minibatch: forward only, metrics on device
+            params = [{"weights": s["weights"], "bias": s["bias"]}
+                      for s in self._state]
+            out = self._forward_only(params, x)
+            if self.loss == "softmax":
+                import jax.numpy as jnp
+                labels = target
+                valid = numpy.asarray(labels) >= 0
+                pred = numpy.asarray(jnp.argmax(out, axis=-1))
+                self.n_err = int(
+                    ((pred != numpy.asarray(labels)) & valid).sum())
+            else:
+                diff = (numpy.asarray(out).reshape(out.shape[0], -1) -
+                        numpy.asarray(target).reshape(out.shape[0], -1))
+                mask = numpy.arange(out.shape[0]) < int(batch_size)
+                self.mse_sum = float(
+                    (diff[mask] ** 2).mean(axis=1).sum())
+        self.n_samples = int(batch_size)
+
+    def __getstate__(self):
+        # state lives in the unit Arrays for snapshots
+        self._sync_state_to_units()
+        state = super(FusedTrainer, self).__getstate__()
+        state["_step_fn"] = None
+        state["_state"] = None
+        state["_forward_only"] = None
+        state["_plans"] = None
+        return state
+
+
+def fuse_standard_workflow(sw, dropout_seed=0):
+    """Rewire a StandardWorkflow: loader -> FusedTrainer -> decision.
+
+    The forward/GD units stay constructed (they own the param Arrays and
+    the snapshot format) but leave the control graph.
+    """
+    trainer = FusedTrainer(sw, sw, dropout_seed=dropout_seed)
+    # detach the old chain from control flow
+    for unit in sw.forwards + [sw.evaluator] + sw.gds:
+        unit.unlink_all()
+    trainer.link_from(sw.loader)
+    sw.decision.link_from(trainer)
+    # decision reads its metrics from the trainer now
+    sw.decision.evaluator = trainer
+    sw.repeater.link_from(sw.decision)
+    sw.end_point.link_from(sw.decision)
+    sw.end_point.gate_block = ~sw.decision.complete
+    sw.fused_trainer = trainer
+    return trainer

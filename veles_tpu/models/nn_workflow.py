@@ -144,6 +144,12 @@ class StandardWorkflow(Workflow):
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
 
+    def fuse(self, **kwargs):
+        """Swap the per-unit chain for the single-dispatch fused train
+        step (veles_tpu.models.fused); call before initialize()."""
+        from veles_tpu.models.fused import fuse_standard_workflow
+        return fuse_standard_workflow(self, **kwargs)
+
     def initialize(self, device=None, **kwargs):
         if self.workflow_mode == "slave":
             # one job = one pass: a slave must not loop the repeater; the
