@@ -1,0 +1,70 @@
+"""InputJoiner / MeanDispNormalizer / Avatar unit tests across
+backends."""
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.memory import Array
+from veles_tpu.service_units import Avatar, InputJoiner, \
+    MeanDispNormalizer, Shell
+
+
+@pytest.mark.parametrize("backend", ["cpu", "numpy"])
+def test_input_joiner(backend):
+    from veles_tpu.backends import Device
+    device = Device(backend=backend)
+    wf = DummyWorkflow()
+    rng = numpy.random.RandomState(0)
+    a = Array(rng.rand(6, 4).astype(numpy.float32))
+    b = Array(rng.rand(6, 3).astype(numpy.float32))
+    joiner = InputJoiner(wf, inputs=[a, b])
+    joiner.initialize(device=device)
+    joiner.run()
+    joiner.output.map_read()
+    numpy.testing.assert_allclose(
+        joiner.output.mem,
+        numpy.concatenate([a.mem, b.mem], axis=1), rtol=1e-6)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "numpy"])
+def test_mean_disp_normalizer_unit(backend):
+    from veles_tpu.backends import Device
+    device = Device(backend=backend)
+    wf = DummyWorkflow()
+    rng = numpy.random.RandomState(1)
+    data = rng.rand(8, 5).astype(numpy.float32) * 10
+    mean = data.mean(axis=0)
+    rdisp = 1.0 / (data.max(axis=0) - data.min(axis=0))
+    unit = MeanDispNormalizer(wf)
+    unit.input = Array(data)
+    unit.mean = mean
+    unit.rdisp = rdisp
+    unit.initialize(device=device)
+    unit.run()
+    unit.output.map_read()
+    numpy.testing.assert_allclose(
+        unit.output.mem, (data - mean) * rdisp, rtol=1e-5)
+
+
+def test_avatar_clones(cpu_device):
+    wf = DummyWorkflow()
+    from veles_tpu.dummy import DummyUnit
+    src = DummyUnit(wf, output=Array(numpy.ones(4, numpy.float32)))
+    avatar = Avatar(wf).clone(src, "output")
+    avatar.initialize(device=cpu_device)
+    avatar.run()
+    avatar.output.map_read()
+    numpy.testing.assert_array_equal(avatar.output.mem, numpy.ones(4))
+    # mutating the clone leaves the source untouched
+    avatar.output.map_write()
+    avatar.output.mem[:] = 7
+    src.output.map_read()
+    numpy.testing.assert_array_equal(src.output.mem, numpy.ones(4))
+
+
+def test_shell_noop_without_tty():
+    wf = DummyWorkflow()
+    shell = Shell(wf)
+    shell.initialize()
+    shell.run()  # stdin is not a tty under pytest: must not block

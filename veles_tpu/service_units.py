@@ -1,0 +1,161 @@
+"""Accelerated utility units over the ops layer.
+
+Reference counterparts: InputJoiner (veles/input_joiner.py:49, the
+join.jcl templated concat kernel), MeanDispNormalizer
+(veles/mean_disp_normalizer.py:50, the (x-mean)*rdisp kernel), Avatar
+(veles/avatar.py:22, device-side Array cloning), and the Shell
+interaction unit (veles/interaction.py:49).
+"""
+
+import numpy
+
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+__all__ = ["InputJoiner", "MeanDispNormalizer", "Avatar", "Shell"]
+
+
+def _on_device(device):
+    return device is not None and device.exists and \
+        not isinstance(device, NumpyDevice)
+
+
+class InputJoiner(Unit):
+    """Concatenates N input Arrays along axis 1 (ops.join)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(InputJoiner, self).__init__(workflow, **kwargs)
+        self.inputs = list(kwargs.get("inputs", ()))
+        self.output = Array()
+        self.device = None
+
+    def link_inputs(self, *pairs):
+        """pairs: (unit, attr_name) whose Arrays join in order."""
+        for unit, attr in pairs:
+            self.inputs.append(getattr(unit, attr))
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        self.device = device
+        super(InputJoiner, self).initialize(**kwargs)
+        if not self.inputs:
+            raise ValueError("InputJoiner needs at least one input")
+        return True
+
+    def run(self):
+        if _on_device(self.device):
+            from veles_tpu import ops
+            for arr in self.inputs:
+                arr.initialize(self.device)
+            parts = [arr.devmem for arr in self.inputs]
+            parts = [p.reshape(p.shape[0], -1) for p in parts]
+            self.output.set_device_array(ops.join(*parts), self.device)
+        else:
+            mats = []
+            for arr in self.inputs:
+                arr.map_read()
+                mats.append(arr.mem.reshape(len(arr.mem), -1))
+            self.output.map_invalidate()
+            self.output.mem = numpy.concatenate(mats, axis=1)
+
+
+class MeanDispNormalizer(Unit):
+    """output = (input - mean) * rdisp elementwise over samples."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MeanDispNormalizer, self).__init__(workflow, **kwargs)
+        self.input = None   # linked Array
+        self.mean = None    # linked Array or ndarray
+        self.rdisp = None
+        self.output = Array()
+        self.device = None
+        self.demand("input", "mean", "rdisp")
+
+    def initialize(self, device=None, **kwargs):
+        self.device = device
+        return super(MeanDispNormalizer, self).initialize(**kwargs)
+
+    @staticmethod
+    def _as_host(value):
+        if hasattr(value, "map_read"):
+            value.map_read()
+            return value.mem
+        return numpy.asarray(value)
+
+    def run(self):
+        if _on_device(self.device):
+            from veles_tpu import ops
+            mean = self.device.put(self._as_host(self.mean).ravel())
+            rdisp = self.device.put(self._as_host(self.rdisp).ravel())
+            self.input.initialize(self.device)
+            x = self.input.devmem
+            out = ops.mean_disp_normalize(
+                x.reshape(x.shape[0], -1), mean, rdisp).reshape(x.shape)
+            self.output.set_device_array(out, self.device)
+        else:
+            self.input.map_read()
+            x = self.input.mem
+            flat = x.reshape(len(x), -1).astype(numpy.float32)
+            out = (flat - self._as_host(self.mean).ravel()) * \
+                self._as_host(self.rdisp).ravel()
+            self.output.map_invalidate()
+            self.output.mem = out.reshape(x.shape)
+
+
+class Avatar(Unit):
+    """Copies a set of source Arrays to cloned output Arrays each run
+    (device-side memcpy in the reference)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Avatar, self).__init__(workflow, **kwargs)
+        self._pairs = []  # (source Array, clone Array)
+        self.device = None
+
+    def clone(self, unit, *attrs):
+        """Mirror unit.<attr> into self.<attr>; returns self."""
+        for attr in attrs:
+            source = getattr(unit, attr)
+            mirror = Array()
+            setattr(self, attr, mirror)
+            self._pairs.append((source, mirror))
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        self.device = device
+        return super(Avatar, self).initialize(**kwargs)
+
+    def run(self):
+        for source, mirror in self._pairs:
+            if _on_device(self.device) and \
+                    source._devmem_ is not None:
+                mirror.set_device_array(source.devmem, self.device)
+            else:
+                source.map_read()
+                mirror.map_invalidate()
+                mirror.mem = numpy.array(source.mem)
+
+
+class Shell(Unit):
+    """Drops into an interactive shell mid-workflow (reference
+    interaction.Shell embedded IPython).  Uses IPython when available,
+    else code.interact; gated off unless stdin is a tty or
+    ``force=True`` (so test runs never block)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Shell, self).__init__(workflow, **kwargs)
+        self.force = kwargs.get("force", False)
+        self.banner = kwargs.get(
+            "banner", "veles-tpu shell: `workflow` is live; ^D resumes")
+
+    def run(self):
+        import sys
+        if not self.force and not sys.stdin.isatty():
+            return
+        namespace = {"workflow": self.workflow, "unit": self}
+        try:
+            import IPython
+            IPython.embed(banner1=self.banner, user_ns=namespace)
+        except ImportError:
+            import code
+            code.interact(banner=self.banner, local=namespace)
