@@ -1,0 +1,108 @@
+"""CLI tests (reference test model: veles/tests/test_velescli.py):
+full run via the module protocol, dump-graph, overrides, --optimize."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WF = textwrap.dedent('''
+    import numpy
+    from veles_tpu.loader import FullBatchLoader
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.config import root
+
+
+    class CliBlobs(FullBatchLoader):
+        def load_data(self):
+            self.class_lengths[:] = [0, 32, 96]
+            self._calc_class_end_offsets()
+            self.create_originals((8,))
+            rng = numpy.random.RandomState(1)
+            centers = rng.randn(3, 8) * 2
+            for i in range(self.total_samples):
+                label = i % 3
+                self.original_data.mem[i] = (
+                    centers[label] + rng.randn(8) * 0.2)
+                self.original_labels[i] = label
+
+
+    def build(launcher):
+        return StandardWorkflow(
+            launcher,
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+                {"type": "softmax", "output_sample_shape": 3,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+            ],
+            loader_factory=lambda w: CliBlobs(
+                w, minibatch_size=32,
+                prng=RandomGenerator("cli", seed=4)),
+            decision_config=dict(
+                max_epochs=root.cli_test.get("max_epochs", 2)),
+            result_file=root.common.get("result_file"),
+        )
+
+
+    def run(load, main):
+        wf, snapshotted = load(build)
+        main(device="cpu")
+
+
+    # --optimize hooks
+    def tunable_spec():
+        from veles_tpu.genetics import Tune
+        return {"x": Tune(0.0, -1.0, 1.0)}
+
+
+    def fitness(spec):
+        return -(spec["x"] - 0.5) ** 2
+''')
+
+
+@pytest.fixture(scope="module")
+def wf_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("cli") / "cli_workflow.py"
+    path.write_text(_WF)
+    return str(path)
+
+
+def _run_cli(*args, timeout=240):
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", VELES_BACKEND="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    return subprocess.run(
+        [sys.executable, "-m", "veles_tpu"] + list(args),
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd="/root/repo")
+
+
+def test_cli_trains_workflow(wf_file, tmp_path):
+    result_file = str(tmp_path / "results.json")
+    proc = _run_cli(wf_file, "-", "-d", "cpu",
+                    "--result-file", result_file,
+                    "root.cli_test.max_epochs=2")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.path.exists(result_file)
+
+
+def test_cli_dump_graph(wf_file, tmp_path):
+    dot = str(tmp_path / "graph.dot")
+    proc = _run_cli(wf_file, "-", "--dump-graph", dot)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    text = open(dot).read()
+    assert "digraph" in text and "CliBlobs" in text
+
+
+def test_cli_optimize(wf_file, tmp_path):
+    result_file = str(tmp_path / "opt.json")
+    proc = _run_cli(wf_file, "-", "--optimize", "4:10",
+                    "--result-file", result_file)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    best = json.load(open(result_file))
+    assert abs(best["spec"]["x"] - 0.5) < 0.3

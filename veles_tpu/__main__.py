@@ -49,6 +49,20 @@ class Main(object):
                             default=None)
         parser.add_argument("--dump-graph", default=None,
                             help="write the graphviz dot file and exit")
+        parser.add_argument(
+            "--optimize", default=None, metavar="GENS:POP",
+            help="genetic hyper-parameter optimization: the workflow "
+                 "module must expose tunable_spec() and fitness(spec)")
+        parser.add_argument(
+            "--ensemble-train", default=None, metavar="N[:RATIO]",
+            help="train an N-model ensemble; the module must expose "
+                 "member_factory(index, seed)")
+        parser.add_argument(
+            "--ensemble-test", default=None, metavar="RESULTS_JSON",
+            help="test a trained ensemble from its results file")
+        parser.add_argument(
+            "--ensemble-dir", default="ensemble",
+            help="ensemble output directory")
         return parser
 
     def _seed(self, spec):
@@ -133,6 +147,12 @@ class Main(object):
         module = self._load_workflow_module(args.workflow)
         if args.dry_run == "load":
             return self.EXIT_SUCCESS
+        if args.optimize:
+            return self._run_optimize(module, args)
+        if args.ensemble_train:
+            return self._run_ensemble_train(module, args)
+        if args.ensemble_test:
+            return self._run_ensemble_test(args)
         run_fn = getattr(module, "run", None)
         if run_fn is None:
             raise SystemExit(
@@ -168,6 +188,53 @@ class Main(object):
         workflow = state.get("workflow")
         if workflow is not None and args.result_file:
             workflow.write_results(args.result_file)
+        return self.EXIT_SUCCESS
+
+
+    # -- meta run modes (reference cmdline.py:182-204) ---------------------
+
+    def _run_optimize(self, module, args):
+        """--optimize GENS:POP (reference --optimize)."""
+        from veles_tpu.genetics import GeneticsOptimizer
+        gens, _, pop = args.optimize.partition(":")
+        spec_fn = getattr(module, "tunable_spec", None)
+        fitness = getattr(module, "fitness", None)
+        if spec_fn is None or fitness is None:
+            raise SystemExit("--optimize needs tunable_spec() and "
+                             "fitness(spec) in the workflow module")
+        optimizer = GeneticsOptimizer(
+            spec_fn(), fitness, generations=int(gens),
+            population=int(pop) if pop else 12)
+        best_spec, best_fitness = optimizer.run()
+        print("best fitness %.6f with %s" % (best_fitness, best_spec))
+        if args.result_file:
+            import json
+            with open(args.result_file, "w") as fout:
+                json.dump({"fitness": best_fitness,
+                           "spec": best_spec}, fout, indent=1,
+                          default=repr)
+        return self.EXIT_SUCCESS
+
+    def _run_ensemble_train(self, module, args):
+        """--ensemble-train N[:RATIO] (reference cmdline.py:182)."""
+        from veles_tpu.ensemble import EnsembleTrainer
+        factory = getattr(module, "member_factory", None)
+        if factory is None:
+            raise SystemExit("--ensemble-train needs "
+                             "member_factory(index, seed)")
+        n, _, ratio = args.ensemble_train.partition(":")
+        trainer = EnsembleTrainer(
+            factory, size=int(n), directory=args.ensemble_dir,
+            train_ratio=float(ratio) if ratio else 1.0,
+            device=args.device)
+        path = trainer.run()
+        print("ensemble results -> %s" % path)
+        return self.EXIT_SUCCESS
+
+    def _run_ensemble_test(self, args):
+        from veles_tpu.ensemble import EnsembleTester
+        tester = EnsembleTester(args.ensemble_test, device=args.device)
+        print("loaded %d ensemble members" % len(tester.results))
         return self.EXIT_SUCCESS
 
 
