@@ -1,0 +1,68 @@
+"""Forge hub tests over real HTTP (reference test model: forge
+server+client HTTP tests): upload a trained package, list, fetch,
+and run native inference on the fetched copy."""
+
+import numpy
+import pytest
+
+from veles_tpu.forge import ForgeServer, details, fetch, list_packages, \
+    upload
+
+
+@pytest.fixture()
+def forge(tmp_path):
+    server = ForgeServer(str(tmp_path / "store"))
+    server.start_background()
+    yield server
+    server.stop()
+
+
+def test_forge_upload_list_fetch(forge, tmp_path, cpu_device):
+    from tests.test_native import _train_mlp
+    sw = _train_mlp(cpu_device, epochs=1)
+    pkg = str(tmp_path / "m.veles.tar")
+    sw.package_export(pkg)
+
+    url = "http://127.0.0.1:%d" % forge.port
+    upload(url, "blobs-mlp", "1.0.0", pkg,
+           metadata={"workflow": "StandardWorkflow"})
+    upload(url, "blobs-mlp", "1.1.0", pkg)
+
+    packages = list_packages(url)
+    assert len(packages) == 1
+    assert packages[0]["version"] == "1.1.0"
+
+    info = details(url, "blobs-mlp")
+    assert info["versions"] == ["1.0.0", "1.1.0"]
+
+    out = str(tmp_path / "fetched.tar")
+    path, version = fetch(url, "blobs-mlp", out)
+    assert version == "1.1.0"
+    assert open(path, "rb").read() == open(pkg, "rb").read()
+
+
+def test_forge_fetched_package_runs_natively(forge, tmp_path,
+                                             cpu_device):
+    from tests.test_native import _train_mlp
+    from veles_tpu import native as native_mod
+    try:
+        native_mod.build_native()
+    except Exception as exc:
+        pytest.skip("native build unavailable: %s" % exc)
+
+    sw = _train_mlp(cpu_device, epochs=1)
+    pkg = str(tmp_path / "m.veles.tar")
+    sw.package_export(pkg)
+    url = "http://127.0.0.1:%d" % forge.port
+    upload(url, "mlp", "0.1", pkg)
+    out, _ = fetch(url, "mlp", str(tmp_path / "f.tar"))
+    nwf = native_mod.NativeWorkflow(out)
+    probs = nwf.run(numpy.random.RandomState(0).rand(4, 16))
+    assert numpy.allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_forge_unknown_package_404(forge):
+    import urllib.error
+    url = "http://127.0.0.1:%d" % forge.port
+    with pytest.raises(urllib.error.HTTPError):
+        fetch(url, "nope", "/tmp/x.tar")

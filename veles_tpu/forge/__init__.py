@@ -1,0 +1,6 @@
+"""Forge — the model-package hub (reference veles/forge/: tornado
+service with upload/fetch + CLI client, per-package storage)."""
+
+from veles_tpu.forge.server import ForgeServer  # noqa: F401
+from veles_tpu.forge.client import (  # noqa: F401
+    upload, fetch, list_packages, details)

@@ -1,0 +1,163 @@
+"""Forge server: package registry over HTTP.
+
+Reference veles/forge/forge_server.py kept each package as a git repo
+with email-confirmed uploads; this build stores versioned directories
+(<root>/<name>/<version>/package.tar + metadata.json) and serves:
+
+  GET  /service?query=list                  -> JSON package index
+  GET  /service?query=details&name=N        -> metadata + versions
+  GET  /fetch?name=N[&version=V]            -> package bytes (latest)
+  POST /upload?name=N&version=V             -> store package (body)
+
+Versions order lexicographically ("1.0.0" style); "latest" resolves to
+the highest.
+"""
+
+import json
+import os
+import threading
+
+from veles_tpu.logger import Logger
+
+__all__ = ["ForgeServer"]
+
+
+class ForgeServer(Logger):
+    def __init__(self, root_dir, port=0):
+        super(ForgeServer, self).__init__()
+        self.root_dir = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self.port = port
+        self._loop = None
+        self._thread = None
+
+    # -- storage ------------------------------------------------------------
+
+    def _package_dir(self, name, version):
+        safe = os.path.basename(name)
+        return os.path.join(self.root_dir, safe, version)
+
+    def versions(self, name):
+        pdir = os.path.join(self.root_dir, os.path.basename(name))
+        if not os.path.isdir(pdir):
+            return []
+        return sorted(os.listdir(pdir))
+
+    def store(self, name, version, payload, metadata=None):
+        pdir = self._package_dir(name, version)
+        os.makedirs(pdir, exist_ok=True)
+        with open(os.path.join(pdir, "package.tar"), "wb") as fout:
+            fout.write(payload)
+        meta = dict(metadata or {})
+        meta.update({"name": name, "version": version,
+                     "size": len(payload)})
+        with open(os.path.join(pdir, "metadata.json"), "w") as fout:
+            json.dump(meta, fout, indent=1, sort_keys=True)
+        self.info("stored %s==%s (%d bytes)", name, version,
+                  len(payload))
+
+    def load(self, name, version="latest"):
+        if version == "latest":
+            versions = self.versions(name)
+            if not versions:
+                raise KeyError("unknown package %s" % name)
+            version = versions[-1]
+        pdir = self._package_dir(name, version)
+        with open(os.path.join(pdir, "package.tar"), "rb") as fin:
+            return fin.read(), version
+
+    def metadata(self, name, version):
+        with open(os.path.join(self._package_dir(name, version),
+                               "metadata.json")) as fin:
+            return json.load(fin)
+
+    def index(self):
+        out = []
+        for name in sorted(os.listdir(self.root_dir)):
+            versions = self.versions(name)
+            if versions:
+                out.append(self.metadata(name, versions[-1]))
+        return out
+
+    # -- HTTP ---------------------------------------------------------------
+
+    def start_background(self):
+        import asyncio
+
+        import tornado.httpserver
+        import tornado.netutil
+        import tornado.web
+
+        forge = self
+
+        class ServiceHandler(tornado.web.RequestHandler):
+            def get(self):
+                query = self.get_argument("query", "list")
+                if query == "list":
+                    self.write({"packages": forge.index()})
+                elif query == "details":
+                    name = self.get_argument("name")
+                    versions = forge.versions(name)
+                    if not versions:
+                        self.set_status(404)
+                        self.write({"error": "unknown package"})
+                        return
+                    self.write({
+                        "name": name, "versions": versions,
+                        "metadata": forge.metadata(name, versions[-1])})
+                else:
+                    self.set_status(400)
+                    self.write({"error": "unknown query"})
+
+        class FetchHandler(tornado.web.RequestHandler):
+            def get(self):
+                name = self.get_argument("name")
+                version = self.get_argument("version", "latest")
+                try:
+                    payload, version = forge.load(name, version)
+                except (KeyError, OSError):
+                    self.set_status(404)
+                    return
+                self.set_header("Content-Type",
+                                "application/octet-stream")
+                self.set_header("X-Package-Version", version)
+                self.write(payload)
+
+        class UploadHandler(tornado.web.RequestHandler):
+            def post(self):
+                name = self.get_argument("name")
+                version = self.get_argument("version")
+                meta_json = self.get_argument("metadata", "{}")
+                forge.store(name, version, self.request.body,
+                            json.loads(meta_json))
+                self.write({"result": "ok"})
+
+        app = tornado.web.Application([
+            (r"/service", ServiceHandler),
+            (r"/fetch", FetchHandler),
+            (r"/upload", UploadHandler),
+        ])
+        started = threading.Event()
+
+        def serve():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            server = tornado.httpserver.HTTPServer(
+                app, max_buffer_size=1 << 30)
+            sockets = tornado.netutil.bind_sockets(
+                self.port, address="127.0.0.1")
+            self.port = sockets[0].getsockname()[1]
+            server.add_sockets(sockets)
+            started.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=serve, daemon=True)
+        self._thread.start()
+        started.wait(5)
+        self.info("forge on http://127.0.0.1:%d/", self.port)
+        return self._thread
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
