@@ -47,6 +47,10 @@ class Main(object):
         parser.add_argument("--result-file", default=None)
         parser.add_argument("--dry-run", choices=("load", "init"),
                             default=None)
+        parser.add_argument(
+            "--sync-run", action="store_true",
+            help="block after every unit's device call for honest "
+                 "per-unit timings")
         parser.add_argument("--dump-graph", default=None,
                             help="write the graphviz dot file and exit")
         parser.add_argument(
@@ -140,6 +144,8 @@ class Main(object):
             root.common.engine.backend = args.device
         if args.result_file:
             root.common.result_file = args.result_file
+        if args.sync_run:
+            root.common.sync_run = True
         if not args.workflow:
             parser.print_help()
             return self.EXIT_FAILURE

@@ -86,9 +86,29 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
 
     # -- lifecycle ---------------------------------------------------------
 
+    @staticmethod
+    def init_multihost():
+        """Multi-host topology discovery: replaces the reference's SSH
+        node spawn + socket handshake (launcher.py:808-906).  The
+        cluster scheduler sets VELES_COORDINATOR (host:port),
+        VELES_NUM_PROCESSES and VELES_PROCESS_ID; after
+        jax.distributed.initialize every process sees the global
+        device list and meshes span the pod/slice."""
+        import os
+        coordinator = os.environ.get("VELES_COORDINATOR")
+        if not coordinator:
+            return False
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ.get("VELES_NUM_PROCESSES", 1)),
+            process_id=int(os.environ.get("VELES_PROCESS_ID", 0)))
+        return True
+
     def initialize(self, device=None, **kwargs):
         if self._workflow is None:
             raise RuntimeError("no workflow attached to the launcher")
+        self.init_multihost()
         if device is None or isinstance(device, str):
             from veles_tpu.backends import Device
             device = Device(backend=device or "auto")

@@ -17,6 +17,7 @@ import numpy
 
 from veles_tpu import prng
 from veles_tpu.backends import NumpyDevice
+from veles_tpu.config import root
 from veles_tpu.memory import Array
 from veles_tpu.units import Unit
 
@@ -137,6 +138,10 @@ class ForwardBase(Unit):
                 type(self).apply, **self.static_config()))
         out = self._jit_fn_(self.params_dict(), self.input.devmem)
         self.output.set_device_array(out, self.device)
+        if root.common.get("sync_run", False):
+            # honest per-unit timings (reference --sync-run,
+            # accelerated_units.py:186-193)
+            jax.block_until_ready(out)
 
     def _numpy_run(self):
         params = self.params_numpy()
@@ -399,6 +404,9 @@ class GradientDescentBase(Unit):
         if self.need_err_input and err_input is not None:
             self.err_input.set_device_array(err_input, self.device)
         self._adopt_state(new_state, device_side=True)
+        if root.common.get("sync_run", False):
+            import jax
+            jax.block_until_ready(new_state)
 
     def _numpy_run(self):
         for arr in (self.input, self.output, self.err_output):
