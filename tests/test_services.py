@@ -166,3 +166,67 @@ def test_publisher_markdown_and_html(tmp_path, trained):
     assert "| BlobsLoader |" in md
     html = open(os.path.join(str(tmp_path), "report.html")).read()
     assert "StandardWorkflow" in html
+
+
+def test_standard_workflow_plotters(tmp_path):
+    """link_plotters wires epoch-curve/confusion/histogram plotters into
+    the training loop and they render after a real run."""
+    from veles_tpu.backends import Device
+    from veles_tpu.graphics_client import render_plot
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from tests.test_models import BlobsLoader
+
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("plotwf", seed=13)),
+        decision_config=dict(max_epochs=3),
+    )
+    plotters = sw.link_plotters()
+    sw.initialize(device=Device(backend="cpu"))
+    sw.run()
+    curves = plotters[0]
+    assert len(curves.values) == 3  # one point per epoch
+    for plot in plotters:
+        import os as _os
+        path = render_plot(plot, str(tmp_path))
+        assert _os.path.getsize(path) > 500
+
+
+def test_gather_results(tmp_path):
+    """Loader + decision contribute IResultProvider metrics."""
+    import json as _json
+    from veles_tpu.backends import Device
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from tests.test_models import BlobsLoader
+
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("results", seed=14)),
+        decision_config=dict(max_epochs=2),
+    )
+    sw.initialize(device=Device(backend="cpu"))
+    sw.run()
+    results = sw.gather_results()
+    assert results["Total epochs"] == 2
+    assert results["Errors"]["validation"] is not None
+    path = str(tmp_path / "r.json")
+    sw.write_results(path)
+    assert "Errors" in _json.load(open(path))

@@ -244,6 +244,20 @@ class Loader(Unit):
 
     # -- distributed contract (reference loader/base.py:631-687) ------------
 
+    # -- IResultProvider (reference loader/base.py:689-701) ------------------
+
+    def get_metric_names(self):
+        if not self.testing:
+            return {"Total epochs"}
+        return {"Labels"} if self.has_labels else set()
+
+    def get_metric_values(self):
+        if not self.testing:
+            return {"Total epochs": self.epoch_number}
+        if self.has_labels:
+            return {"Labels": self.reversed_labels_mapping}
+        return {}
+
     def generate_data_for_master(self):
         return True
 

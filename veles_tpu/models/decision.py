@@ -8,7 +8,7 @@ Bool, and sets ``complete`` when ``fail_iterations`` epochs pass without
 improvement or ``max_epochs`` is reached.
 """
 
-from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.loader.base import CLASS_NAME, TRAIN, VALID
 from veles_tpu.mutable import Bool
 from veles_tpu.units import Unit
 
@@ -82,6 +82,17 @@ class DecisionBase(Unit):
             if better:
                 self.best_train_metric = metric
             self.train_improved <<= better
+
+    def get_metric_names(self):
+        return {"Errors", "Best metric", "Best epoch"}
+
+    def get_metric_values(self):
+        return {
+            "Errors": {CLASS_NAME[i]: self.epoch_metrics[i]
+                       for i in range(3)},
+            "Best metric": self.best_metric,
+            "Best epoch": self.best_epoch,
+        }
 
     def _on_epoch_ended(self):
         self.info("Epoch %d metrics: test %s, validation %s, train %s",

@@ -150,6 +150,44 @@ class StandardWorkflow(Workflow):
         from veles_tpu.models.fused import fuse_standard_workflow
         return fuse_standard_workflow(self, **kwargs)
 
+    def link_plotters(self):
+        """Attach the standard plotter set (reference Znicz standard
+        workflow behavior): per-class error curves, the confusion
+        matrix, and per-layer weight histograms, all running after the
+        decision each minibatch and publishing to the launcher's
+        graphics server when one is attached."""
+        from veles_tpu.plotting_units import (
+            AccumulatingPlotter, MatrixPlotter, MultiHistogram)
+        self.plotters = []
+        decision = self.decision
+        for cls_idx, cls_name in ((1, "validation"), (2, "train")):
+            plot = AccumulatingPlotter(
+                self, label="%s error %%" % cls_name)
+            plot.input = decision
+
+            def capture(plot=plot, idx=cls_idx):
+                # one point per finished epoch
+                if not bool(decision.epoch_ended):
+                    return
+                value = decision.epoch_metrics[idx]
+                if value is not None:
+                    plot.values.append(float(value))
+            plot.capture = capture
+            plot.link_from(self.decision)
+            self.plotters.append(plot)
+        if hasattr(self.evaluator, "confusion_matrix"):
+            conf = MatrixPlotter(self)
+            conf.input = self.evaluator.confusion_matrix
+            conf.link_from(self.decision)
+            self.plotters.append(conf)
+        hist = MultiHistogram(self)
+        hist.inputs = [f.weights for f in self.forwards
+                       if f.weights is not None and hasattr(
+                           f.weights, "map_read")]
+        hist.link_from(self.decision)
+        self.plotters.append(hist)
+        return self.plotters
+
     def initialize(self, device=None, **kwargs):
         if self.workflow_mode == "slave":
             # one job = one pass: a slave must not loop the repeater; the
