@@ -271,13 +271,21 @@ class DeviceInfo(object):
             root.common.dirs.get("cache", "/tmp"), "device_infos.json")
         self._load()
 
+    #: shipped autotune tables (analog of the reference's checked-in
+    #: devices/device_infos.json) — consulted when the cache is cold
+    SHIPPED_PATH = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "devices", "device_infos.json")
+
     def _load(self):
-        try:
-            with open(self._path) as fin:
-                data = json.load(fin)
-            self.table = data.get(self.device_kind, {})
-        except (OSError, ValueError):
-            self.table = {}
+        self.table = {}
+        for path in (self.SHIPPED_PATH, self._path):
+            try:
+                with open(path) as fin:
+                    data = json.load(fin)
+                self.table.update(data.get(self.device_kind, {}))
+            except (OSError, ValueError):
+                pass
 
     def get(self, op_key, default=None):
         return self.table.get(op_key, default)

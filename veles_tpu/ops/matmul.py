@@ -55,11 +55,15 @@ def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, comp_ref,
             comp_ref[:] = jnp.zeros_like(comp_ref)
 
     # HIGHEST keeps true f32 multiply accuracy for f32 inputs (the MXU
-    # otherwise decomposes f32 into a single bf16 pass); bf16 inputs take
-    # the native fast path either way.
+    # otherwise decomposes f32 into bf16 passes).  bf16 inputs MUST use
+    # DEFAULT: Mosaic rejects HIGHEST for bf16 operands on real TPUs
+    # ("Bad lhs type") — the native single-pass path is the only one.
+    precision = (jax.lax.Precision.DEFAULT
+                 if a_ref.dtype == jnp.bfloat16
+                 else jax.lax.Precision.HIGHEST)
     partial = jnp.dot(a_ref[:], b_ref[:],
                       preferred_element_type=jnp.float32,
-                      precision=jax.lax.Precision.HIGHEST)
+                      precision=precision)
     if precision_level == 0:
         acc_ref[:] += partial
     elif precision_level == 1:
@@ -134,22 +138,36 @@ def matmul(a, b, precision_level=0, blocks=None, out_dtype=None):
 
 
 def matmul_benchmark(size=3001, dtype=jnp.float32, precision_level=0,
-                     repeats=5, blocks=None):
+                     repeats=10, blocks=None):
     """Time the kernel on an NxN self-multiply — the same measurement the
     reference's autotuner and DeviceBenchmark unit make
-    (reference: ocl/benchmark.cl:1-11, accelerated_units.py:706)."""
+    (reference: ocl/benchmark.cl:1-11, accelerated_units.py:706).
+
+    Measured as the slope between a 1-long and an (repeats+1)-long
+    DEPENDENT chain, each ended by a scalar fetch: dispatch/tunnel
+    latency cancels, pure device time per matmul remains."""
     import time
+
     import numpy
     a = jnp.asarray(
-        numpy.random.RandomState(13).rand(size, size), dtype=dtype)
-    fn = lambda: matmul(a, a, precision_level=precision_level,  # noqa: E731
-                        blocks=blocks)
-    fn().block_until_ready()  # compile
-    start = time.time()
-    for _ in range(repeats):
-        result = fn()
-    result.block_until_ready()
-    return (time.time() - start) / repeats
+        (numpy.random.RandomState(13).rand(size, size) - 0.5) * 0.01,
+        dtype=dtype)
+
+    def mm(x):
+        return matmul(x, a, precision_level=precision_level,
+                      blocks=blocks)
+
+    float(mm(a)[0, 0])  # compile + warmup
+
+    def chain(n):
+        start = time.perf_counter()
+        acc = a
+        for _ in range(n):
+            acc = mm(acc)
+        float(acc[0, 0])
+        return time.perf_counter() - start
+
+    return max((chain(repeats + 1) - chain(1)) / repeats, 1e-9)
 
 
 def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
