@@ -1,5 +1,6 @@
 #include "npy.h"
 
+#include <cstdint>
 #include <cstring>
 
 namespace veles_native {
@@ -65,11 +66,14 @@ NpyArray LoadNpy(const std::vector<char>& bytes) {
     header_len = len;
     header_off = 10;
   } else {
+    if (bytes.size() < 12) throw Error("npy: truncated header length");
     uint32_t len;
     std::memcpy(&len, bytes.data() + 8, 4);
     header_len = len;
     header_off = 12;
   }
+  if (header_len > bytes.size() - header_off)
+    throw Error("npy: header overruns file");
   std::string header(bytes.data() + header_off, header_len);
   std::string descr = HeaderField(header, "descr");
   std::string order = HeaderField(header, "fortran_order");
@@ -93,6 +97,9 @@ NpyArray LoadNpy(const std::vector<char>& bytes) {
   size_t count = static_cast<size_t>(NumElements(arr.shape));
   const char* payload = bytes.data() + header_off + header_len;
   size_t avail = bytes.size() - header_off - header_len;
+  // count*8 is the largest element stride below; reject sizes that would
+  // overflow the multiplication before the truncation checks run.
+  if (count > SIZE_MAX / 8) throw Error("npy: element count overflow");
   arr.data.resize(count);
 
   if (descr == "<f4" || descr == "|f4") {
@@ -108,10 +115,12 @@ NpyArray LoadNpy(const std::vector<char>& bytes) {
     const uint16_t* src = reinterpret_cast<const uint16_t*>(payload);
     for (size_t i = 0; i < count; ++i) arr.data[i] = HalfToFloat(src[i]);
   } else if (descr == "<i4") {
+    if (avail < count * 4) throw Error("npy: truncated i4 payload");
     const int32_t* src = reinterpret_cast<const int32_t*>(payload);
     for (size_t i = 0; i < count; ++i)
       arr.data[i] = static_cast<float>(src[i]);
   } else if (descr == "<i8") {
+    if (avail < count * 8) throw Error("npy: truncated i8 payload");
     const int64_t* src = reinterpret_cast<const int64_t*>(payload);
     for (size_t i = 0; i < count; ++i)
       arr.data[i] = static_cast<float>(src[i]);

@@ -66,3 +66,25 @@ def test_forge_unknown_package_404(forge):
     url = "http://127.0.0.1:%d" % forge.port
     with pytest.raises(urllib.error.HTTPError):
         fetch(url, "nope", "/tmp/x.tar")
+
+
+def test_forge_rejects_path_traversal(forge, tmp_path):
+    """Upload with traversal components must 400 and write nothing
+    outside the store root (advisor finding, round 1)."""
+    import urllib.error
+    import urllib.request
+
+    url = ("http://127.0.0.1:%d/upload?name=pkg&version=..%%2F..%%2Fevil"
+           % forge.port)
+    req = urllib.request.Request(url, data=b"payload", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req)
+    assert exc_info.value.code == 400
+    # nothing escaped the store directory
+    assert not (tmp_path / "evil").exists()
+    assert not (tmp_path.parent / "evil").exists()
+
+    with pytest.raises(ValueError):
+        forge.store("../pkg", "1.0.0", b"x")
+    with pytest.raises(ValueError):
+        forge.store("pkg", "../../1.0.0", b"x")

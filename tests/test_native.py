@@ -142,3 +142,29 @@ def test_arena_reuses_memory(tmp_path, native, cpu_device):
     # sanity: deep chain still computes
     out = nwf.run(numpy.random.RandomState(3).rand(4, 16))
     assert numpy.allclose(out.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_native_rejects_malformed_npy(tmp_path, native, cpu_device):
+    """A package whose npy header length overruns the file must fail
+    cleanly (no OOB read; advisor finding, round 1)."""
+    import struct
+    import tarfile
+
+    sw = _train_mlp(cpu_device, epochs=1)
+    pkg = str(tmp_path / "ok.tar")
+    sw.package_export(pkg)
+
+    # corrupt every npy: claim a header length far past EOF
+    evil = str(tmp_path / "evil.tar")
+    with tarfile.open(pkg) as tin, tarfile.open(evil, "w") as tout:
+        for member in tin.getmembers():
+            data = tin.extractfile(member).read()
+            if member.name.endswith(".npy"):
+                data = (data[:8] + struct.pack("<H", 0xFFFF) +
+                        data[10:])
+            member.size = len(data)
+            import io
+            tout.addfile(member, io.BytesIO(data))
+
+    with pytest.raises(RuntimeError):
+        native.NativeWorkflow(evil)

@@ -135,3 +135,72 @@ def test_slave_death_requeues_jobs(cpu_device):
     server._done.wait(10)
     assert bool(master.decision.complete)
     assert healthy_client.jobs_done > 0
+
+
+def test_frame_auth_full_cycle(cpu_device):
+    """Matched shared secrets: HMAC-authenticated frames, run completes."""
+    master = _build("master", "net_m5", cpu_device, max_epochs=2)
+    slave = _build("slave", "net_s5", cpu_device, max_epochs=2)
+    server, _ = _start_server(master, secret=b"sesame")
+    client = Client("127.0.0.1:%d" % server.port, slave, secret=b"sesame")
+    client.run()
+    server._done.wait(10)
+    assert client.jobs_done > 0
+    assert bool(master.decision.complete)
+
+
+def test_frame_auth_mismatch_rejected(cpu_device):
+    """A peer without the right secret is dropped before any unpickling."""
+    master = _build("master", "net_m6", cpu_device)
+    slave = _build("slave", "net_s6", cpu_device)
+    server, _ = _start_server(master, secret=b"right")
+    client = Client("127.0.0.1:%d" % server.port, slave,
+                    secret=b"wrong", reconnect_limit=1)
+    try:
+        client.run()
+    finally:
+        server.stop()
+        server._done.wait(5)
+    assert client.jobs_done == 0
+    assert server.updates_applied == 0
+
+
+def test_checksum_reject_reason(cpu_device):
+    master = _build("master", "net_m7", cpu_device)
+    slave = _build("slave", "net_s7", cpu_device)
+    server, _ = _start_server(master)
+    client = Client("127.0.0.1:%d" % server.port, slave)
+    object.__setattr__(client, "workflow", _ChecksumProxy(slave))
+    try:
+        client.run()
+    finally:
+        server.stop()
+    assert client.reject_reason == "checksum mismatch"
+
+
+def test_pause_resume(cpu_device):
+    """Server pause parks connected slaves (no job flow); resume releases
+    the parked requests and the run completes (reference
+    server.py:734-745)."""
+    master = _build("master", "net_m8", cpu_device, max_epochs=2)
+    slave = _build("slave", "net_s8", cpu_device, max_epochs=2)
+    server, _ = _start_server(master)
+    server.pause()
+
+    client = Client("127.0.0.1:%d" % server.port, slave)
+    cthread = client.start_background()
+
+    deadline = time.time() + 5
+    while client.sid is None and time.time() < deadline:
+        time.sleep(0.01)
+    assert client.sid is not None, "handshake should succeed while paused"
+    time.sleep(0.5)
+    assert client.jobs_done == 0, "no jobs must flow while paused"
+    assert client.paused
+    assert server.paused
+
+    server.resume()
+    cthread.join(20)
+    server._done.wait(10)
+    assert client.jobs_done > 0
+    assert bool(master.decision.complete)

@@ -10,14 +10,14 @@ client.py:278-354), reconnection with an attempt budget, and
 """
 
 import asyncio
-import json
 import os
 import random
 import threading
 
 from veles_tpu.logger import Logger
 from veles_tpu.network_common import (
-    decode_payload, encode_payload, parse_address)
+    ProtocolError, default_secret, pack_payload, parse_address,
+    read_frame, unpack_payload, write_frame)
 
 __all__ = ["Client"]
 
@@ -25,7 +25,7 @@ __all__ = ["Client"]
 class Client(Logger):
     def __init__(self, address, workflow, launcher=None, codec="none",
                  async_slave=False, reconnect_limit=5,
-                 death_probability=0.0):
+                 death_probability=0.0, secret=None):
         super(Client, self).__init__()
         self.host, self.port = parse_address(address,
                                              default_host="127.0.0.1")
@@ -35,9 +35,12 @@ class Client(Logger):
         self.async_slave = async_slave
         self.reconnect_limit = reconnect_limit
         self.death_probability = death_probability
+        self.secret = secret if secret is not None else default_secret()
         self.sid = None
         self.jobs_done = 0
+        self.reject_reason = None
         self._stopping = False
+        self._paused = False
         self._pending_update = None
         self._loop = None
 
@@ -57,8 +60,13 @@ class Client(Logger):
     def stop(self):
         self._stopping = True
 
+    @property
+    def paused(self):
+        """True while the master has this slave parked."""
+        return self._paused
+
     def pause(self):
-        pass
+        pass  # pausing is master-driven; see Server.pause()
 
     def resume(self):
         pass
@@ -82,6 +90,12 @@ class Client(Logger):
             try:
                 await self._session()
                 return
+            except ProtocolError as exc:
+                # authentication failure is not transient: don't retry
+                self.reject_reason = str(exc)
+                self.error("protocol failure: %s", exc)
+                self._stopping = True
+                return
             except (ConnectionError, OSError) as exc:
                 attempts += 1
                 self.warning("connection lost (%s); retry %d/%d", exc,
@@ -99,14 +113,15 @@ class Client(Logger):
                 "power": self.computing_power,
                 "mid": "%s:%d" % (os.uname().nodename, os.getpid()),
                 "pid": os.getpid()})
-            msg = await self._recv(reader)
+            msg, payload = await self._recv(reader)
             if msg.get("type") == "reject":
-                self.error("master rejected us: %s", msg.get("reason"))
+                self.reject_reason = msg.get("reason")
+                self.error("master rejected us: %s", self.reject_reason)
                 self._stopping = True
                 return
             assert msg.get("type") == "handshake_ack"
             self.sid = msg["id"]
-            initial = decode_payload(msg.get("data"))
+            initial = unpack_payload(payload, msg.get("codec", "none"))
             if initial:
                 await self._in_thread(
                     self.workflow.apply_initial_data_from_master, initial)
@@ -118,12 +133,22 @@ class Client(Logger):
     async def _job_loop(self, reader, writer):
         self._send(writer, {"type": "job_request"})
         while not self._stopping:
-            msg = await self._recv(reader)
+            msg, payload = await self._recv(reader)
             mtype = msg.get("type")
             if mtype == "stop":
                 self.info("master signalled stop after %d jobs",
                           self.jobs_done)
                 return
+            if mtype == "pause":
+                # master parked our outstanding job_request server-side;
+                # nothing to do but note it — the next frame wakes us
+                self._paused = True
+                continue
+            if mtype == "resume":
+                # the server releases our parked request itself;
+                # re-requesting here would double-request
+                self._paused = False
+                continue
             if mtype == "wait":
                 await asyncio.sleep(0.1)
                 self._send(writer, {"type": "job_request"})
@@ -138,7 +163,7 @@ class Client(Logger):
                 # client.py:438-442)
                 self.warning("fault injection: dying")
                 raise ConnectionResetError("injected death")
-            data = decode_payload(msg.get("data"))
+            data = unpack_payload(payload, msg.get("codec", "none"))
             if self.async_slave:
                 # pipeline: ask for the next job before running this one
                 self._send(writer, {"type": "job_request"})
@@ -146,7 +171,7 @@ class Client(Logger):
             self.jobs_done += 1
             self._send(writer, {
                 "type": "update", "job_id": msg.get("job_id"),
-                "data": encode_payload(update, self.codec)})
+                "codec": self.codec}, payload=update)
             if not self.async_slave:
                 self._send(writer, {"type": "job_request"})
 
@@ -163,14 +188,18 @@ class Client(Logger):
 
     # -- helpers -------------------------------------------------------------
 
-    def _send(self, writer, msg):
-        writer.write((json.dumps(msg) + "\n").encode())
+    _NO_PAYLOAD = object()
+
+    def _send(self, writer, msg, payload=_NO_PAYLOAD):
+        raw = (pack_payload(payload, self.codec)
+               if payload is not Client._NO_PAYLOAD else b"")
+        write_frame(writer, msg, raw, self.secret)
 
     async def _recv(self, reader):
-        line = await reader.readline()
-        if not line:
+        try:
+            return await read_frame(reader, self.secret)
+        except asyncio.IncompleteReadError:
             raise ConnectionResetError("EOF from master")
-        return json.loads(line.decode())
 
     async def _in_thread(self, fn, *args):
         return await self._loop.run_in_executor(None, fn, *args)

@@ -163,12 +163,16 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
         mask = (jnp.arange(out2.shape[0]) < batch_size
                 ).astype(out2.dtype)[:, None]
         diff = (out2 - t2) * mask
-        return jnp.sum(diff * diff) / batch_size, jnp.zeros((), jnp.int32)
+        # aux: per-sample mean over features, summed over samples — the
+        # same definition EvaluatorMSE uses, so train and eval epoch
+        # RMSE (DecisionMSE) accumulate commensurate terms
+        mse_sum = jnp.sum(jnp.sum(diff * diff, axis=1) / out2.shape[1])
+        return jnp.sum(diff * diff) / batch_size, mse_sum
 
     def step(state, x, target, batch_size, step_key=None):
         params = [{"weights": s["weights"], "bias": s["bias"]}
                   for s in state]
-        (loss_value, n_err), grads = jax.value_and_grad(
+        (loss_value, aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, x, target, batch_size,
                                    step_key)
         new_state = []
@@ -202,7 +206,12 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
                 entry.update({"bias": new_b, "accum_bias": acc_b,
                               "accum2_bias": acc2_b})
             new_state.append(entry)
-        metrics = {"loss": loss_value, "n_err": n_err}
+        if loss == "softmax":
+            metrics = {"loss": loss_value, "n_err": aux}
+        else:
+            metrics = {"loss": loss_value,
+                       "n_err": jnp.zeros((), jnp.int32),
+                       "mse_sum": aux}
         return new_state, metrics
 
     jit_kwargs = {}

@@ -15,11 +15,22 @@ the highest.
 
 import json
 import os
+import re
 import threading
 
 from veles_tpu.logger import Logger
 
 __all__ = ["ForgeServer"]
+
+# Package names and versions become path components; anything outside
+# this alphabet (or a leading dot) is rejected to block traversal.
+_SAFE_COMPONENT = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._+-]*\Z")
+
+
+def _safe_component(value, what):
+    if not _SAFE_COMPONENT.match(value or "") or ".." in value:
+        raise ValueError("illegal %s %r" % (what, value))
+    return value
 
 
 class ForgeServer(Logger):
@@ -34,11 +45,17 @@ class ForgeServer(Logger):
     # -- storage ------------------------------------------------------------
 
     def _package_dir(self, name, version):
-        safe = os.path.basename(name)
-        return os.path.join(self.root_dir, safe, version)
+        path = os.path.join(self.root_dir,
+                            _safe_component(name, "package name"),
+                            _safe_component(version, "version"))
+        root = os.path.realpath(self.root_dir)
+        if not os.path.realpath(path).startswith(root + os.sep):
+            raise ValueError("package path escapes root_dir")
+        return path
 
     def versions(self, name):
-        pdir = os.path.join(self.root_dir, os.path.basename(name))
+        pdir = os.path.join(self.root_dir,
+                            _safe_component(name, "package name"))
         if not os.path.isdir(pdir):
             return []
         return sorted(os.listdir(pdir))
@@ -97,7 +114,12 @@ class ForgeServer(Logger):
                     self.write({"packages": forge.index()})
                 elif query == "details":
                     name = self.get_argument("name")
-                    versions = forge.versions(name)
+                    try:
+                        versions = forge.versions(name)
+                    except ValueError:
+                        self.set_status(400)
+                        self.write({"error": "illegal name"})
+                        return
                     if not versions:
                         self.set_status(404)
                         self.write({"error": "unknown package"})
@@ -115,6 +137,9 @@ class ForgeServer(Logger):
                 version = self.get_argument("version", "latest")
                 try:
                     payload, version = forge.load(name, version)
+                except ValueError:
+                    self.set_status(400)
+                    return
                 except (KeyError, OSError):
                     self.set_status(404)
                     return
@@ -128,8 +153,13 @@ class ForgeServer(Logger):
                 name = self.get_argument("name")
                 version = self.get_argument("version")
                 meta_json = self.get_argument("metadata", "{}")
-                forge.store(name, version, self.request.body,
-                            json.loads(meta_json))
+                try:
+                    forge.store(name, version, self.request.body,
+                                json.loads(meta_json))
+                except ValueError:
+                    self.set_status(400)
+                    self.write({"error": "illegal name or version"})
+                    return
                 self.write({"result": "ok"})
 
         app = tornado.web.Application([

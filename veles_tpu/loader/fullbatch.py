@@ -40,6 +40,37 @@ class FullBatchLoader(Loader):
         self.device = None
         self.dtype = numpy.dtype(kwargs.get("dtype", numpy.float32))
 
+    @staticmethod
+    def _coerce_array(value):
+        """Accept `loader.original_data = ndarray` (the natural user
+        assignment) as well as a prepared Array."""
+        if isinstance(value, Array):
+            return value
+        arr = Array()
+        if value is not None:
+            arr.mem = numpy.ascontiguousarray(value)
+        return arr
+
+    @property
+    def original_data(self):
+        return self._original_data
+
+    @original_data.setter
+    def original_data(self, value):
+        self._original_data = self._coerce_array(value)
+
+    @property
+    def original_labels(self):
+        return self._original_labels
+
+    @original_labels.setter
+    def original_labels(self, value):
+        # ndarray assignment is the natural user move; the mapping pass
+        # below needs a plain list (labels may be any hashable)
+        if isinstance(value, numpy.ndarray):
+            value = value.tolist()
+        self._original_labels = [] if value is None else value
+
     def init_unpickled(self):
         super(FullBatchLoader, self).init_unpickled()
         # trailing-underscore attrs are not pickled; the mapped labels
@@ -111,6 +142,10 @@ class FullBatchLoader(Loader):
             uniques = sorted(set(self.original_labels))
             self.labels_mapping.update(
                 (lbl, i) for i, lbl in enumerate(uniques))
+        if self._mapped_original_labels_.mem is None:
+            # labels assigned directly (no create_originals call)
+            self._mapped_original_labels_.mem = numpy.zeros(
+                len(self.original_labels), Loader.LABEL_DTYPE)
         self._mapped_original_labels_.map_write()
         for i, raw in enumerate(self.original_labels):
             self._mapped_original_labels_[i] = self.labels_mapping[raw]
@@ -212,6 +247,14 @@ class FullBatchLoaderMSE(LoaderMSEMixin, FullBatchLoader):
     def __init__(self, workflow, **kwargs):
         super(FullBatchLoaderMSE, self).__init__(workflow, **kwargs)
         self.original_targets = Array()
+
+    @property
+    def original_targets(self):
+        return self._original_targets
+
+    @original_targets.setter
+    def original_targets(self, value):
+        self._original_targets = self._coerce_array(value)
 
     def create_minibatch_data(self):
         super(FullBatchLoaderMSE, self).create_minibatch_data()

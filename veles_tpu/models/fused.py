@@ -99,7 +99,12 @@ class FusedTrainer(Unit):
                     self._state, x, target, batch_size)
             self.last_loss = float(metrics["loss"])
             self.n_err = int(metrics["n_err"])
-            self.mse_sum = self.last_loss * float(batch_size)
+            # mse_sum from the step's aux metric matches EvaluatorMSE's
+            # definition (per-feature mean, summed over samples); the
+            # scalar loss is SSE/batch over ALL elements and would
+            # inflate epoch RMSE by sqrt(num_features)
+            self.mse_sum = float(metrics.get(
+                "mse_sum", self.last_loss * float(batch_size)))
         else:
             # eval minibatch: forward only, metrics on device
             params = [{"weights": s["weights"], "bias": s["bias"]}

@@ -20,14 +20,14 @@ capabilities (SURVEY.md section 2.6/5):
 """
 
 import asyncio
-import json
 import threading
 import time
 from collections import deque
 
 from veles_tpu.logger import Logger
 from veles_tpu.network_common import (
-    decode_payload, encode_payload, new_id, parse_address)
+    ProtocolError, default_secret, new_id, pack_payload, parse_address,
+    read_frame, unpack_payload, write_frame)
 
 __all__ = ["Server", "SlaveDescription"]
 
@@ -59,7 +59,7 @@ class Server(Logger):
     """Serve a workflow's jobs to connecting slaves."""
 
     def __init__(self, address, workflow, launcher=None, codec="none",
-                 job_timeout=60.0, respawn_hook=None):
+                 job_timeout=60.0, respawn_hook=None, secret=None):
         super(Server, self).__init__()
         self.host, self.port = parse_address(address)
         self.workflow = workflow
@@ -67,6 +67,7 @@ class Server(Logger):
         self.codec = codec
         self.job_timeout = job_timeout
         self.respawn_hook = respawn_hook
+        self.secret = secret if secret is not None else default_secret()
         self.blacklist = set()
         self.slaves = {}
         self._waiting = deque()     # parked requesters (sync points)
@@ -74,6 +75,8 @@ class Server(Logger):
         self._loop = None
         self._server = None
         self._finishing = False
+        self._paused = False
+        self._stop_event = None
         self._done = threading.Event()
         self.jobs_dispatched = 0
         self.updates_applied = 0
@@ -92,30 +95,60 @@ class Server(Logger):
     def on_workflow_finished(self):
         self._finishing = True
         if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._broadcast_stop)
+            self._loop.call_soon_threadsafe(self._signal_stop)
 
     def stop(self):
         self.on_workflow_finished()
 
     def pause(self):
-        self._paused = True
+        """Park all slaves: broadcast 'pause'; job requests queue up
+        server-side until resume() (reference server.py:734-745)."""
+        if self._loop is None:
+            self._paused = True
+            return
+        self._loop.call_soon_threadsafe(self._do_pause)
 
     def resume(self):
+        if self._loop is None:
+            self._paused = False
+            return
+        self._loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(self._do_resume()))
+
+    @property
+    def paused(self):
+        return self._paused
+
+    def _do_pause(self):
+        self._paused = True
+        self._broadcast({"type": "pause"})
+
+    async def _do_resume(self):
         self._paused = False
+        self._broadcast({"type": "resume"})
+        await self._release_parked()
 
     # -- asyncio internals ---------------------------------------------------
 
+    def _signal_stop(self):
+        self._broadcast_stop()
+        if self._stop_event is not None:
+            self._stop_event.set()
+
     async def _main(self):
         self._loop = asyncio.get_running_loop()
+        self._stop_event = asyncio.Event()
+        if self._finishing:
+            self._stop_event.set()
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         self.info("master listening on %s:%d", self.host, self.port)
         watchdog = asyncio.ensure_future(self._watchdog())
         try:
-            while not self._finishing:
-                await asyncio.sleep(0.05)
+            await self._stop_event.wait()
         finally:
+            self._finishing = True
             watchdog.cancel()
             self._broadcast_stop()
             self._server.close()
@@ -126,15 +159,15 @@ class Server(Logger):
         conn = None
         try:
             while True:
-                line = await reader.readline()
-                if not line:
-                    break
-                msg = json.loads(line.decode())
-                conn = await self._dispatch(msg, conn, reader, writer)
+                msg, payload = await read_frame(reader, self.secret)
+                conn = await self._dispatch(
+                    msg, payload, conn, reader, writer)
                 if conn is None and msg.get("type") != "handshake":
                     break
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
+        except ProtocolError as exc:
+            self.warning("rejecting peer: %s", exc)
         except Exception:
             self.exception("connection handler failed")
         finally:
@@ -145,7 +178,7 @@ class Server(Logger):
             except Exception:
                 pass
 
-    async def _dispatch(self, msg, conn, reader, writer):
+    async def _dispatch(self, msg, payload, conn, reader, writer):
         mtype = msg.get("type")
         if mtype == "handshake":
             return await self._handshake(msg, reader, writer)
@@ -156,7 +189,7 @@ class Server(Logger):
         if mtype == "job_request":
             await self._serve_job(conn)
         elif mtype == "update":
-            await self._apply_update(conn, msg)
+            await self._apply_update(conn, msg, payload)
         return conn
 
     async def _handshake(self, msg, reader, writer):
@@ -179,15 +212,22 @@ class Server(Logger):
         self.slaves[sid] = conn
         initial = await self._in_thread(
             self.workflow.generate_initial_data_for_slave, slave)
-        self._send(writer, {
-            "type": "handshake_ack", "id": sid,
-            "data": encode_payload(initial, self.codec)})
+        self._send(writer, {"type": "handshake_ack", "id": sid},
+                   payload=initial)
+        if self._paused:
+            self._send(writer, {"type": "pause"})
         self.info("slave %s connected (mid %s)", sid[:8], mid)
         return conn
 
     async def _serve_job(self, conn):
         if self._finishing:
             self._send(conn.writer, {"type": "stop"})
+            return
+        if self._paused:
+            # parked until resume(); no reply — the slave already got
+            # 'pause' and is not busy-waiting
+            conn.parked = True
+            self._waiting.append(conn)
             return
         data = await self._in_thread(
             self.workflow.generate_data_for_slave, conn.slave)
@@ -200,12 +240,11 @@ class Server(Logger):
         job_id = new_id()
         conn.jobs_out[job_id] = time.time()
         self.jobs_dispatched += 1
-        self._send(conn.writer, {
-            "type": "job", "job_id": job_id,
-            "data": encode_payload(data, self.codec)})
+        self._send(conn.writer, {"type": "job", "job_id": job_id},
+                   payload=data)
 
-    async def _apply_update(self, conn, msg):
-        update = decode_payload(msg.get("data"))
+    async def _apply_update(self, conn, msg, payload):
+        update = unpack_payload(payload, msg.get("codec", "none"))
         job_id = msg.get("job_id")
         started = conn.jobs_out.pop(job_id, None)
         if started is not None:
@@ -225,7 +264,11 @@ class Server(Logger):
             self._broadcast_stop()
             return
         # updates may unlock parked requesters (sync point release)
-        while self._waiting:
+        if not self._paused:
+            await self._release_parked()
+
+    async def _release_parked(self):
+        while self._waiting and not self._paused:
             parked = self._waiting.popleft()
             if parked.slave.id in self.slaves and parked.parked:
                 parked.parked = False
@@ -272,15 +315,25 @@ class Server(Logger):
             self._loop.call_later(
                 delay, lambda: self.respawn_hook(conn.slave))
 
-    def _broadcast_stop(self):
+    def _broadcast(self, msg):
         for conn in list(self.slaves.values()):
             try:
-                self._send(conn.writer, {"type": "stop"})
+                self._send(conn.writer, msg)
             except Exception:
                 pass
 
-    def _send(self, writer, msg):
-        writer.write((json.dumps(msg) + "\n").encode())
+    def _broadcast_stop(self):
+        self._broadcast({"type": "stop"})
+
+    _NO_PAYLOAD = object()
+
+    def _send(self, writer, msg, payload=_NO_PAYLOAD):
+        if payload is not Server._NO_PAYLOAD:
+            msg = dict(msg, codec=self.codec)
+            raw = pack_payload(payload, self.codec)
+        else:
+            raw = b""
+        write_frame(writer, msg, raw, self.secret)
 
     async def _in_thread(self, fn, *args):
         return await self._loop.run_in_executor(None, fn, *args)
