@@ -1,0 +1,65 @@
+"""CIFAR-10 convolutional workflow — BASELINE quality target 17.21 %
+validation error (/root/reference/docs/source/
+manualrst_veles_algorithms.rst:50; the reference's conv config).
+
+    python -m veles_tpu examples/cifar10.py
+
+Needs the CIFAR-10 python batches under ``$VELES_DATA``
+(cifar-10-batches-py/); see veles_tpu/datasets.py.
+"""
+
+from veles_tpu.config import root
+from veles_tpu.datasets import Cifar10Loader
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+
+root.cifar.update({
+    "minibatch_size": 100,
+    "learning_rate": 0.02,
+    "gradient_moment": 0.9,
+    "weights_decay": 4e-5,
+    "dropout": 0.5,
+    "max_epochs": 80,
+    "fail_iterations": 20,
+})
+
+
+def _conv(n, k, act="conv_relu", stride=1, pad=1):
+    cfg = root.cifar
+    return {"type": act, "n_kernels": n, "kx": k, "ky": k,
+            "sliding": (stride, stride), "padding": pad,
+            "learning_rate": cfg.learning_rate,
+            "gradient_moment": cfg.gradient_moment,
+            "weights_decay": cfg.weights_decay}
+
+
+def build(launcher):
+    cfg = root.cifar
+    dense = {"learning_rate": cfg.learning_rate,
+             "gradient_moment": cfg.gradient_moment,
+             "weights_decay": cfg.weights_decay}
+    return StandardWorkflow(
+        launcher,
+        layers=[
+            _conv(32, 3), _conv(32, 3),
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            _conv(64, 3), _conv(64, 3),
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            _conv(128, 3),
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "all2all_relu", "output_sample_shape": 256, **dense},
+            {"type": "dropout", "dropout_ratio": cfg.dropout},
+            {"type": "softmax", "output_sample_shape": 10, **dense},
+        ],
+        loader_factory=lambda w: Cifar10Loader(
+            w, minibatch_size=cfg.minibatch_size,
+            prng=RandomGenerator("cifar", seed=3)),
+        decision_config=dict(max_epochs=cfg.max_epochs,
+                             fail_iterations=cfg.fail_iterations),
+        result_file=root.common.get("result_file"),
+    )
+
+
+def run(load, main):
+    load(build)
+    main()

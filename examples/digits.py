@@ -1,0 +1,54 @@
+"""Handwritten-digits workflow — the OFFLINE real-data quality anchor.
+
+1,797 real 8x8 handwritten digits (UCI, bundled with scikit-learn) so
+the full loader->workflow->decision->snapshotter quality path runs on
+genuine data in environments without network access or cached MNIST.
+The repo's committed quality number (QUALITY.json) comes from this
+workflow; tests/test_quality.py asserts it stays reached.
+
+    python -m veles_tpu examples/digits.py
+"""
+
+from veles_tpu.config import root
+from veles_tpu.datasets import DigitsLoader
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+
+root.digits.update({
+    "hidden": 64,
+    "minibatch_size": 48,
+    "learning_rate": 0.08,
+    "gradient_moment": 0.9,
+    "weights_decay": 1e-4,
+    "max_epochs": 60,
+    "fail_iterations": 20,
+})
+
+
+def build(launcher):
+    cfg = root.digits
+    return StandardWorkflow(
+        launcher,
+        layers=[
+            {"type": "all2all_tanh",
+             "output_sample_shape": cfg.hidden,
+             "learning_rate": cfg.learning_rate,
+             "gradient_moment": cfg.gradient_moment,
+             "weights_decay": cfg.weights_decay},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": cfg.learning_rate,
+             "gradient_moment": cfg.gradient_moment,
+             "weights_decay": cfg.weights_decay},
+        ],
+        loader_factory=lambda w: DigitsLoader(
+            w, minibatch_size=cfg.minibatch_size,
+            prng=RandomGenerator("digits", seed=2)),
+        decision_config=dict(max_epochs=cfg.max_epochs,
+                             fail_iterations=cfg.fail_iterations),
+        result_file=root.common.get("result_file"),
+    )
+
+
+def run(load, main):
+    load(build)
+    main()

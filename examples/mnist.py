@@ -1,0 +1,55 @@
+"""MNIST fully-connected workflow — the BASELINE config-1 parity model.
+
+Reference anchor: 784-100-10 fully-connected softmax network, 1.48 %
+validation error (/root/reference/docs/source/
+manualrst_veles_algorithms.rst:31).  Run:
+
+    python -m veles_tpu examples/mnist.py [examples/mnist_config.py]
+
+Needs the MNIST idx files under ``$VELES_DATA`` (downloaded
+automatically when the network allows; see veles_tpu/datasets.py).
+"""
+
+from veles_tpu.config import root
+from veles_tpu.datasets import MnistLoader
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+
+root.mnist.update({
+    "hidden": 100,
+    "minibatch_size": 100,
+    "learning_rate": 0.1,
+    "gradient_moment": 0.9,
+    "weights_decay": 5e-5,
+    "max_epochs": 100,
+    "fail_iterations": 25,       # early stop when validation stalls
+})
+
+
+def build(launcher):
+    cfg = root.mnist
+    return StandardWorkflow(
+        launcher,
+        layers=[
+            {"type": "all2all_tanh",
+             "output_sample_shape": cfg.hidden,
+             "learning_rate": cfg.learning_rate,
+             "gradient_moment": cfg.gradient_moment,
+             "weights_decay": cfg.weights_decay},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": cfg.learning_rate,
+             "gradient_moment": cfg.gradient_moment,
+             "weights_decay": cfg.weights_decay},
+        ],
+        loader_factory=lambda w: MnistLoader(
+            w, minibatch_size=cfg.minibatch_size,
+            prng=RandomGenerator("mnist", seed=1)),
+        decision_config=dict(max_epochs=cfg.max_epochs,
+                             fail_iterations=cfg.fail_iterations),
+        result_file=root.common.get("result_file"),
+    )
+
+
+def run(load, main):
+    load(build)
+    main()

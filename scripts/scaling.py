@@ -1,0 +1,235 @@
+"""Scaling-efficiency harness (BASELINE target: >= 70 % at 8 -> 64
+chips, grad-merge -> ICI psum).
+
+Two parts:
+
+1. MEASURE: runs the fused data-parallel train step on 1..8 devices at
+   fixed per-device batch (weak scaling), recording step wall time and
+   the collective traffic the compiled program actually issues (summed
+   from all-reduce ops in the optimized HLO).  On this host the devices
+   are XLA virtual CPU devices, so the times validate *semantics and
+   collective volume*, not ICI speed; run unmodified on a real pod
+   (it detects >= 2 real TPU devices) to measure real step times.
+
+2. PROJECT: an analytic ICI model — ring all-reduce over the data axis,
+   t_comm(n) = 2 (n-1)/n * grad_bytes / ici_bw + (n-1) * hop_latency,
+   no overlap credited (conservative: XLA overlaps grad all-reduce with
+   the tail of the backward pass) — combined with the single-chip step
+   time measured by bench.py on the real chip, yields projected
+   efficiency at 8/16/32/64 chips.
+
+   Model constants (documented, overridable by flags): v5e ICI
+   2D torus, 1600 Gbit/s aggregate per chip -> ~100 GB/s usable per
+   all-reduce direction; 1 us per hop launch latency.
+
+    python scripts/scaling.py [--out SCALING.json]
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one worker invocation per device count: the XLA device count is fixed
+# at backend init, so each measurement needs a fresh interpreter
+_WORKER = r"""
+import json, os, re, sys, time
+sys.path.insert(0, %(repo)r)
+if os.environ.get("VELES_SCALING_CPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+if os.environ.get("VELES_SCALING_CPU"):
+    jax.config.update("jax_platforms", "cpu")
+import numpy
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veles_tpu.compiler import build_train_step
+from veles_tpu.models.zoo import alexnet_layers, build_plans_and_state
+from veles_tpu.parallel import make_mesh
+
+n = %(n)d
+per_device_batch = %(pdb)d
+size = %(size)d
+devices = jax.devices()[:n]
+mesh = make_mesh({"data": n}, devices)
+
+specs = alexnet_layers(classes=10)
+plans, state, _ = build_plans_and_state(specs, (size, size, 3), seed=1)
+
+repl = NamedSharding(mesh, P())
+bsh = NamedSharding(mesh, P("data"))
+state_sh = jax.tree.map(lambda leaf: repl, state,
+                        is_leaf=lambda x: x is None)
+state_sh = jax.tree.map(
+    lambda leaf, sh: None if leaf is None else sh, state, state_sh,
+    is_leaf=lambda x: x is None)
+
+step = build_train_step(plans, mesh=mesh, data_axis="data",
+                        state_shardings=state_sh, batch_sharding=bsh,
+                        donate=False)
+
+batch = per_device_batch * n
+rng = numpy.random.RandomState(0)
+x = jax.device_put(rng.rand(batch, size, size, 3).astype(numpy.float32),
+                   bsh)
+y = jax.device_put(rng.randint(0, 10, batch).astype(numpy.int32), bsh)
+state = jax.tree.map(
+    lambda leaf, sh: None if leaf is None else jax.device_put(leaf, sh),
+    state, state_sh, is_leaf=lambda v: v is None)
+
+import jax.random as jrandom
+key = jrandom.PRNGKey(0)
+lowered = jax.jit(step).lower(state, x, y, numpy.float32(batch), key)
+compiled = lowered.compile()
+hlo = compiled.as_text()
+
+from veles_tpu.parallel.analysis import parse_collective_bytes
+total = parse_collective_bytes(hlo)["all-reduce"]
+
+s2, metrics = step(state, x, y, numpy.float32(batch), key)
+jax.block_until_ready(s2)
+
+def chain(k):
+    t0 = time.perf_counter()
+    s = state
+    m = None
+    for i in range(k):
+        s, m = step(s, x, y, numpy.float32(batch), key)
+    float(m["loss"])
+    return time.perf_counter() - t0
+
+best = float("inf")
+for _ in range(2):
+    t1, t2 = chain(1), chain(4)
+    best = min(best, (t2 - t1) / 3)
+print(json.dumps({"n": n, "batch": batch,
+                  "step_seconds": max(best, 1e-9),
+                  "allreduce_bytes": total}))
+"""
+
+
+def measure(device_counts, per_device_batch, size):
+    results = []
+    on_real_pod = False
+    try:
+        import jax
+        on_real_pod = (len(jax.devices()) >= 2 and
+                       jax.devices()[0].platform == "tpu")
+    except Exception:
+        pass
+    for n in device_counts:
+        env = dict(os.environ)
+        if not on_real_pod:
+            env["VELES_SCALING_CPU"] = "1"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "") +
+                " --xla_force_host_platform_device_count=%d" % n).strip()
+            env["VELES_BACKEND"] = "cpu"
+        body = _WORKER % {"repo": REPO, "n": n,
+                          "pdb": per_device_batch, "size": size}
+        proc = subprocess.run([sys.executable, "-c", body], env=env,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError("worker n=%d failed:\n%s" %
+                               (n, proc.stderr[-2000:]))
+        results.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    return results, on_real_pod
+
+
+def project(step_seconds_1chip, grad_bytes, ici_gbps=100.0,
+            hop_latency_s=1e-6, counts=(8, 16, 32, 64)):
+    """Ring all-reduce model, no overlap credited."""
+    out = {}
+    bw = ici_gbps * 1e9
+    for n in counts:
+        t_comm = 2.0 * (n - 1) / n * grad_bytes / bw + \
+            (n - 1) * hop_latency_s
+        t_step = step_seconds_1chip + t_comm
+        out[str(n)] = {
+            "t_comm_ms": round(t_comm * 1e3, 4),
+            "t_step_ms": round(t_step * 1e3, 4),
+            "efficiency_pct": round(
+                100.0 * step_seconds_1chip / t_step, 2),
+        }
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=os.path.join(REPO,
+                                                      "SCALING.json"))
+    parser.add_argument("--per-device-batch", type=int, default=8)
+    parser.add_argument("--size", type=int, default=67,
+                        help="input image side (67 keeps CPU runs fast; "
+                             "use 227 on a real pod)")
+    parser.add_argument("--counts", default="1,2,4,8")
+    parser.add_argument("--ici-gbps", type=float, default=100.0,
+                        help="usable all-reduce bandwidth GB/s per chip "
+                             "(v5e 2D-torus derated)")
+    parser.add_argument("--step-seconds", type=float, default=None,
+                        help="single-chip step time from bench.py "
+                             "(defaults to BENCH extras if present)")
+    args = parser.parse_args()
+
+    counts = [int(c) for c in args.counts.split(",")]
+    measured, on_real_pod = measure(counts, args.per_device_batch,
+                                    args.size)
+
+    grad_bytes = measured[-1]["allreduce_bytes"]
+    step_1 = args.step_seconds
+    source = "flag"
+    if step_1 is None:
+        # prefer the real-chip AlexNet step from the bench extras
+        for bench_file in ("BENCH_r02.json", "BENCH_local.json"):
+            path = os.path.join(REPO, bench_file)
+            if os.path.exists(path):
+                try:
+                    parsed = json.load(open(path))
+                    parsed = parsed.get("parsed", parsed)
+                    step_1 = parsed["extras"]["alexnet"]["float32"][
+                        "step_seconds"]
+                    source = bench_file
+                    break
+                except (KeyError, ValueError, TypeError):
+                    continue
+    if step_1 is None:
+        step_1 = measured[0]["step_seconds"]
+        source = "cpu-measured (NOT TPU-representative)"
+
+    report = {
+        "measured": measured,
+        "measured_on": "real tpu pod" if on_real_pod
+        else "virtual cpu devices (semantics + collective bytes only)",
+        "allreduce_bytes_per_step": grad_bytes,
+        "model": {
+            "kind": "ring all-reduce, no overlap credited",
+            "ici_usable_gbps": args.ici_gbps,
+            "hop_latency_s": 1e-6,
+            "single_chip_step_seconds": step_1,
+            "step_seconds_source": source,
+        },
+        "projection": project(step_1, grad_bytes,
+                              ici_gbps=args.ici_gbps),
+        "target": {"efficiency_pct_8_to_64": 70.0,
+                   "source": "BASELINE.md"},
+    }
+    # the 8->64 headline: efficiency(64) relative to efficiency(8)
+    e8 = report["projection"]["8"]["efficiency_pct"]
+    e64 = report["projection"]["64"]["efficiency_pct"]
+    report["projected_8_to_64_relative_pct"] = round(100.0 * e64 / e8, 2)
+
+    with open(args.out, "w") as fout:
+        json.dump(report, fout, indent=1, sort_keys=True)
+        fout.write("\n")
+    print(json.dumps({"scaling_8_to_64_relative_pct":
+                      report["projected_8_to_64_relative_pct"],
+                      "out": args.out}))
+
+
+if __name__ == "__main__":
+    main()

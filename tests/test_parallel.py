@@ -167,3 +167,64 @@ def test_graft_entry_dryrun_multichip():
     spec.loader.exec_module(mod)
     mod.dryrun_multichip(8)
     mod.dryrun_multichip(3)
+
+
+def test_collective_bytes_analysis():
+    """parse_collective_bytes finds the dp gradient all-reduce and its
+    volume matches the parameter bytes (scaling.py's honest input)."""
+    import jax
+    import numpy
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from veles_tpu.compiler import build_train_step, LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.parallel import make_mesh
+    from veles_tpu.parallel.analysis import (
+        collective_bytes, parse_collective_bytes)
+
+    # direct parser check, incl. tuple results
+    hlo = """
+  ar0 = f32[100]{0} all-reduce(x), replica_groups={}
+  ar1 = (f32[2,3]{1,0}, bf16[4]{0}) all-reduce(y, z)
+  other = f32[8]{0} add(a, b)
+"""
+    parsed = parse_collective_bytes(hlo)
+    assert parsed["all-reduce"] == 400 + 24 + 8
+    assert parsed["total"] == parsed["all-reduce"]
+
+    n = 4
+    mesh = make_mesh({"data": n}, jax.devices()[:n])
+    plans = [LayerPlan(All2AllTanh, hyper={"learning_rate": 0.1}),
+             LayerPlan(All2AllSoftmax, hyper={"learning_rate": 0.1})]
+    rng = numpy.random.RandomState(0)
+    state = [
+        {"weights": rng.rand(16, 8).astype(numpy.float32),
+         "bias": numpy.zeros(8, numpy.float32),
+         "accum_weights": numpy.zeros((16, 8), numpy.float32),
+         "accum_bias": numpy.zeros(8, numpy.float32),
+         "accum2_weights": None, "accum2_bias": None},
+        {"weights": rng.rand(8, 4).astype(numpy.float32),
+         "bias": numpy.zeros(4, numpy.float32),
+         "accum_weights": numpy.zeros((8, 4), numpy.float32),
+         "accum_bias": numpy.zeros(4, numpy.float32),
+         "accum2_weights": None, "accum2_bias": None},
+    ]
+    repl = NamedSharding(mesh, P())
+    bsh = NamedSharding(mesh, P("data"))
+    state_sh = jax.tree.map(lambda leaf: None if leaf is None else repl,
+                            state, is_leaf=lambda x: x is None)
+    step = build_train_step(plans, mesh=mesh, data_axis="data",
+                            state_shardings=state_sh,
+                            batch_sharding=bsh, donate=False)
+    x = jax.device_put(rng.rand(8, 16).astype(numpy.float32), bsh)
+    y = jax.device_put(rng.randint(0, 4, 8).astype(numpy.int32), bsh)
+    state = jax.tree.map(
+        lambda leaf: None if leaf is None else jax.device_put(leaf, repl),
+        state, is_leaf=lambda v: v is None)
+    traffic = collective_bytes(
+        jax.jit(step), state, x, y, numpy.float32(8), None)
+    param_bytes = 4 * (16 * 8 + 8 + 8 * 4 + 4)
+    # the grad all-reduce must move at least the parameter gradients
+    # (XLA may add small scalar reductions for the loss/n_err metrics)
+    assert traffic["all-reduce"] >= param_bytes
+    assert traffic["all-reduce"] <= param_bytes + 4096

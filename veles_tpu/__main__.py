@@ -151,6 +151,10 @@ class Main(object):
             return self.EXIT_FAILURE
         self._apply_config(args.config, overrides)
         module = self._load_workflow_module(args.workflow)
+        if overrides:
+            # workflow modules may install config defaults at import
+            # time; command-line overrides must still win
+            self._apply_config(None, overrides)
         if args.dry_run == "load":
             return self.EXIT_SUCCESS
         if args.optimize:

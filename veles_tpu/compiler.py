@@ -218,10 +218,18 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
     if donate:
         jit_kwargs["donate_argnums"] = (0,)
     if mesh is not None and state_shardings is not None:
+        # 5-tuple: the optional step_key (dropout PRNG) rides replicated
         jit_kwargs["in_shardings"] = (
             state_shardings, batch_sharding, batch_sharding and
-            _labels_sharding(mesh, data_axis, loss), None)
+            _labels_sharding(mesh, data_axis, loss), None, None)
         jit_kwargs["out_shardings"] = (state_shardings, None)
+        jitted = jax.jit(step, **jit_kwargs)
+
+        def sharded_step(state, x, target, batch_size, step_key=None):
+            # fixed arity so in_shardings always matches (None is an
+            # empty pytree when no dropout key is used)
+            return jitted(state, x, target, batch_size, step_key)
+        return sharded_step
     return jax.jit(step, **jit_kwargs)
 
 

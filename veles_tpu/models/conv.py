@@ -54,12 +54,17 @@ class Conv(ForwardBase):
             x = x[..., None]
         left, top, right, bottom = padding
         sx, sy = sliding
+        # preferred_element_type=f32 + cast breaks the conv transpose
+        # rule for bf16 (mixed-dtype cotangent); the MXU accumulates
+        # bf16 convs in f32 in hardware regardless, so only request a
+        # wider output when the input is already f32.
+        pet = jnp.float32 if x.dtype == jnp.float32 else None
         z = lax.conv_general_dilated(
             x, W,
             window_strides=(sy, sx),
             padding=((top, bottom), (left, right)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=pet)
         if params.get("bias") is not None:
             z = z + params["bias"]
         return cls._activate(z).astype(x.dtype)

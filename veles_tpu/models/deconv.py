@@ -45,13 +45,16 @@ class Deconv(ForwardBase):
         # `padding` follows the FORWARD conv convention (the pair's conv
         # unit); lax.conv_transpose wants raw dilated-conv padding,
         # which for forward padding p is k - 1 - p
+        # see conv.py: f32-preferred output breaks the bf16 transpose
+        # rule; the MXU accumulates in f32 in hardware either way
+        pet = jnp.float32 if x.dtype == jnp.float32 else None
         z = lax.conv_transpose(
             x, W,
             strides=(sy, sx),
             padding=((ky - 1 - top, ky - 1 - bottom),
                      (kx - 1 - left, kx - 1 - right)),
             dimension_numbers=("NHWC", "HWOI", "NHWC"),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=pet)
         if params.get("bias") is not None:
             z = z + params["bias"]
         return z.astype(x.dtype)

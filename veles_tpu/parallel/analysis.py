@@ -1,0 +1,61 @@
+"""Compiled-program communication analysis.
+
+Used by scripts/scaling.py to report how many bytes of collective
+traffic one compiled train step actually issues (the honest input to
+the ICI scaling model), and handy for eyeballing sharding regressions.
+"""
+
+import re
+
+__all__ = ["parse_collective_bytes", "collective_bytes"]
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "f64": 8, "s64": 8, "u64": 8, "pred": 1, "s8": 1,
+                "u8": 1, "s16": 2, "u16": 2, "c64": 8, "c128": 16}
+
+# XLA:TPU rewrites collectives to async -start/-done pairs in optimized
+# HLO; counting the -start (plus the sync forms CPU keeps) covers both
+_COLLECTIVES = ("all-reduce(", "all-reduce-start(",
+                "all-gather(", "all-gather-start(",
+                "reduce-scatter(",
+                "all-to-all(",
+                "collective-permute(", "collective-permute-start(")
+
+
+def parse_collective_bytes(hlo_text, kinds=_COLLECTIVES):
+    """Sum result bytes of collective ops in optimized HLO text.
+
+    Handles tuple-shaped results ("ar = (f32[96], f32[11,11,3,96], ...)
+    all-reduce(...)").  Async -start forms count under their base kind
+    ("all-reduce-start" -> "all-reduce").  Returns {kind: bytes} with a
+    "total" key.
+    """
+    def base(kind):
+        return kind.rstrip("(").replace("-start", "")
+
+    out = {base(kind): 0 for kind in kinds}
+    for line in hlo_text.splitlines():
+        if "=" not in line:
+            continue
+        for kind in kinds:
+            if kind not in line:
+                continue
+            shapes_part = line.split("=", 1)[1].split(kind, 1)[0]
+            for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", shapes_part):
+                if dt not in _DTYPE_BYTES:
+                    continue
+                count = 1
+                for d in dims.split(","):
+                    if d:
+                        count *= int(d)
+                out[base(kind)] += count * _DTYPE_BYTES[dt]
+            break
+    out["total"] = sum(out.values())
+    return out
+
+
+def collective_bytes(jitted_fn, *example_args):
+    """Compile ``jitted_fn`` for the example args and report its
+    collective traffic: parse_collective_bytes of the optimized HLO."""
+    compiled = jitted_fn.lower(*example_args).compile()
+    return parse_collective_bytes(compiled.as_text())
