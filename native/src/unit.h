@@ -29,6 +29,17 @@ class Unit {
   virtual void Run(const float* in, float* out, int batch,
                    const Shape& input_shape) const = 0;
 
+  // Multi-input variants for DAG nodes (InputJoiner et al.); the
+  // defaults delegate to the single-input methods.
+  virtual Shape OutputShapeMulti(const std::vector<Shape>& ins) const {
+    return OutputShape(ins.at(0));
+  }
+  virtual void RunMulti(const std::vector<const float*>& ins,
+                        const std::vector<Shape>& in_shapes, float* out,
+                        int batch) const {
+    Run(ins.at(0), out, batch, in_shapes.at(0));
+  }
+
   const std::string& name() const { return name_; }
   void set_name(const std::string& n) { name_ = n; }
 

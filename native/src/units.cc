@@ -270,6 +270,43 @@ class ActivationUnit : public Unit {
   Act act_;
 };
 
+// ------------------------------------------------------------ input joiner
+
+// Concatenates N flattened inputs along the feature axis (reference
+// veles/input_joiner.py:49 role; DAG multi-input node).
+class JoinUnit : public Unit {
+ public:
+  void Setup(const JsonValue&, std::map<std::string, NpyArray>) override {}
+
+  Shape OutputShape(const Shape& s) const override { return s; }
+
+  Shape OutputShapeMulti(const std::vector<Shape>& ins) const override {
+    int64_t total = 0;
+    for (const auto& s : ins) total += NumElements(s);
+    return {total};
+  }
+
+  void Run(const float* in, float* out, int batch,
+           const Shape& s) const override {
+    std::memcpy(out, in, sizeof(float) * NumElements(s) * batch);
+  }
+
+  void RunMulti(const std::vector<const float*>& ins,
+                const std::vector<Shape>& in_shapes, float* out,
+                int batch) const override {
+    int64_t out_sample = 0;
+    for (const auto& s : in_shapes) out_sample += NumElements(s);
+    for (int b = 0; b < batch; ++b) {
+      float* dst = out + b * out_sample;
+      for (size_t k = 0; k < ins.size(); ++k) {
+        int64_t n = NumElements(in_shapes[k]);
+        std::memcpy(dst, ins[k] + b * n, sizeof(float) * n);
+        dst += n;
+      }
+    }
+  }
+};
+
 }  // namespace
 
 UnitFactory& UnitFactory::Instance() {
@@ -339,6 +376,10 @@ void RegisterStandardUnits() {
              act_unit(Act::kStrictRelu));
   f.Register("5a51b268-0034-4000-8000-76656c6573aa",
              act_unit(Act::kSigmoid));
+  f.Register("5a51b268-0041-4000-8000-76656c6573aa",
+             []() -> std::unique_ptr<Unit> {
+               return std::make_unique<JoinUnit>();
+             });
 }
 
 }  // namespace veles_native

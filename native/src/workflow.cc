@@ -1,6 +1,8 @@
 #include "workflow.h"
 
+#include <algorithm>
 #include <cstring>
+#include <map>
 
 #include "engine.h"
 #include "memory_optimizer.h"
@@ -20,6 +22,15 @@ NativeWorkflow::NativeWorkflow(const std::string& path) {
     for (const auto& d : contents["input_shape"].array)
       input_shape_.push_back(d.AsInt());
 
+  // pass 1: create every unit (flat; the factory resolves classes by
+  // stable UUID), record names and declared input links
+  struct Raw {
+    std::unique_ptr<Unit> unit;
+    std::vector<std::string> input_names;
+  };
+  std::vector<Raw> raw;
+  std::map<std::string, int> by_name;
+  int idx = 0;
   for (const auto& uj : contents["units"].array) {
     auto unit = UnitFactory::Instance().Create(uj["uuid"].str);
     unit->set_name(uj["class"].str);
@@ -28,35 +39,134 @@ NativeWorkflow::NativeWorkflow(const std::string& path) {
       for (const auto& kv : uj["arrays"].object)
         arrays[kv.first] = LoadNpy(tar.Get(kv.second.str));
     unit->Setup(uj["properties"], std::move(arrays));
-    units_.push_back(std::move(unit));
+    Raw r;
+    r.unit = std::move(unit);
+    if (uj.Has("inputs"))
+      for (const auto& name : uj["inputs"].array)
+        r.input_names.push_back(name.str);
+    std::string name = uj.Has("name") ? uj["name"].str
+                                      : std::to_string(idx);
+    if (by_name.count(name))
+      throw Error("duplicate unit name " + name);
+    by_name[name] = idx++;
+    raw.push_back(std::move(r));
   }
-  if (units_.empty()) throw Error("package has no units");
+  if (raw.empty()) throw Error("package has no units");
 
-  // propagate shapes through the chain
-  stage_shapes_.push_back(input_shape_);
-  Shape cur = input_shape_;
-  for (const auto& unit : units_) {
-    cur = unit->OutputShape(cur);
-    stage_shapes_.push_back(cur);
+  // pass 2: resolve links.  Format 1 (no "inputs") = linear chain.
+  int n = static_cast<int>(raw.size());
+  std::vector<std::vector<int>> inputs(n);
+  for (int i = 0; i < n; ++i) {
+    if (raw[i].input_names.empty()) {
+      inputs[i] = {i == 0 ? -1 : i - 1};
+      continue;
+    }
+    for (const auto& name : raw[i].input_names) {
+      if (name == "__input__") {
+        inputs[i].push_back(-1);
+      } else {
+        auto it = by_name.find(name);
+        if (it == by_name.end())
+          throw Error("unit input references unknown unit " + name);
+        inputs[i].push_back(it->second);
+      }
+    }
+  }
+
+  // pass 3: topological order (iterative DFS) so shapes/buffers
+  // propagate in dependency order whatever the package's unit order
+  // was (reference workflow_loader.cc:73-120 behavior)
+  std::vector<int> order, state(n, 0);  // 0 new, 1 visiting, 2 done
+  std::vector<int> stack;
+  for (int start = 0; start < n; ++start) {
+    if (state[start]) continue;
+    stack.push_back(start);
+    while (!stack.empty()) {
+      int u = stack.back();
+      if (state[u] == 0) {
+        state[u] = 1;
+        for (int producer : inputs[u]) {
+          if (producer < 0) continue;
+          if (state[producer] == 1)
+            throw Error("cycle in unit graph at " +
+                        raw[u].unit->name());
+          if (state[producer] == 0) stack.push_back(producer);
+        }
+      } else {
+        stack.pop_back();
+        if (state[u] == 1) {
+          state[u] = 2;
+          order.push_back(u);
+        }
+      }
+    }
+  }
+
+  // emit nodes in topo order; remap link indices
+  std::vector<int> pos(n, -1);
+  for (size_t p = 0; p < order.size(); ++p)
+    pos[order[p]] = static_cast<int>(p);
+  nodes_.resize(n);
+  for (int i = 0; i < n; ++i) {
+    Node& node = nodes_[pos[i]];
+    node.unit = std::move(raw[i].unit);
+    for (int producer : inputs[i])
+      node.inputs.push_back(producer < 0 ? -1 : pos[producer]);
+  }
+
+  // the graph output: exactly one node nobody consumes
+  std::vector<bool> consumed(n, false);
+  for (const auto& node : nodes_)
+    for (int producer : node.inputs)
+      if (producer >= 0) consumed[producer] = true;
+  for (int i = 0; i < n; ++i) {
+    if (consumed[i]) continue;
+    if (output_node_ >= 0)
+      throw Error("graph has multiple outputs (" +
+                  nodes_[output_node_].unit->name() + ", " +
+                  nodes_[i].unit->name() + ")");
+    output_node_ = i;
+  }
+  if (output_node_ < 0) throw Error("graph has no output node");
+
+  BuildShapes();
+}
+
+void NativeWorkflow::BuildShapes() {
+  for (auto& node : nodes_) {
+    std::vector<Shape> in_shapes;
+    for (int producer : node.inputs)
+      in_shapes.push_back(producer < 0 ? input_shape_
+                                       : nodes_[producer].out_shape);
+    node.out_shape = node.unit->OutputShapeMulti(in_shapes);
+  }
+  // liveness: a node's buffer must survive until its last consumer
+  for (size_t i = 0; i < nodes_.size(); ++i) {
+    nodes_[i].last_consumer = static_cast<int>(i);
+    for (size_t j = i + 1; j < nodes_.size(); ++j)
+      for (int producer : nodes_[j].inputs)
+        if (producer == static_cast<int>(i))
+          nodes_[i].last_consumer = static_cast<int>(j);
   }
 }
 
 int64_t NativeWorkflow::output_size() const {
-  return NumElements(stage_shapes_.back());
+  return NumElements(nodes_[output_node_].out_shape);
 }
 
 void NativeWorkflow::Initialize(int batch) {
   if (planned_batch_ == batch) return;
-  // One buffer per stage output; stage i's output is produced at step i
-  // and last read at step i+1 (linear inference chain).  The planner
-  // lets non-adjacent buffers share arena bytes, which is the whole
-  // point of the reference's strip packing.
+  // one buffer per node output, live [produce step, last consumer
+  // step]; the strip-packing planner overlaps disjoint lifetimes —
+  // the reference's memory_optimizer fed with REAL intervals from the
+  // DAG instead of the linear-chain i/i+1 approximation
   std::vector<BufferRequest> requests;
-  int n = static_cast<int>(units_.size());
+  int n = static_cast<int>(nodes_.size());
   for (int i = 0; i < n; ++i) {
     int64_t bytes =
-        NumElements(stage_shapes_[i + 1]) * batch * sizeof(float);
-    requests.push_back({bytes, i, std::min(i + 1, n - 1)});
+        NumElements(nodes_[i].out_shape) * batch * sizeof(float);
+    if (i == output_node_) bytes = 0;  // written straight to out
+    requests.push_back({bytes, i, nodes_[i].last_consumer});
   }
   auto placements = PlanArena(requests, &arena_size_);
   offsets_.clear();
@@ -68,22 +178,34 @@ void NativeWorkflow::Initialize(int batch) {
 void NativeWorkflow::Run(const float* in, float* out, int batch) {
   Initialize(batch);
   if (!engine_) engine_ = std::make_unique<Engine>();
-  const float* cur = in;
-  int n = static_cast<int>(units_.size());
+  int n = static_cast<int>(nodes_.size());
   for (int i = 0; i < n; ++i) {
+    const Node& node = nodes_[i];
     float* dst =
-        (i == n - 1) ? out
-                     : reinterpret_cast<float*>(arena_.data() + offsets_[i]);
-    const Unit* unit = units_[i].get();
-    const Shape& in_shape = stage_shapes_[i];
-    int64_t in_sample = NumElements(in_shape);
-    int64_t out_sample = NumElements(stage_shapes_[i + 1]);
+        (i == output_node_)
+            ? out
+            : reinterpret_cast<float*>(arena_.data() + offsets_[i]);
+    std::vector<const float*> ins;
+    std::vector<Shape> in_shapes;
+    std::vector<int64_t> in_samples;
+    for (int producer : node.inputs) {
+      ins.push_back(producer < 0
+                        ? in
+                        : reinterpret_cast<const float*>(
+                              arena_.data() + offsets_[producer]));
+      in_shapes.push_back(producer < 0 ? input_shape_
+                                       : nodes_[producer].out_shape);
+      in_samples.push_back(NumElements(in_shapes.back()));
+    }
+    int64_t out_sample = NumElements(node.out_shape);
     // batch rows are independent: shard them over the engine workers
     engine_->ParallelFor(batch, [&](int start, int count) {
-      unit->Run(cur + start * in_sample, dst + start * out_sample, count,
-                in_shape);
+      std::vector<const float*> slice(ins);
+      for (size_t k = 0; k < slice.size(); ++k)
+        slice[k] += start * in_samples[k];
+      node.unit->RunMulti(slice, in_shapes,
+                          dst + start * out_sample, count);
     });
-    cur = dst;
   }
 }
 

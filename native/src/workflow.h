@@ -1,7 +1,7 @@
 // Package loader + arena-planned inference runner (reference
-// libVeles workflow_loader.cc:41, workflow.cc:73-158 roles, fresh
-// implementation for the tar/contents.json package of
-// veles_tpu/package.py).
+// libVeles workflow_loader.cc:41,73-120 roles — general DAG with
+// dependency-ordered construction — fresh implementation for the
+// tar/contents.json package of veles_tpu/package.py).
 #pragma once
 
 #include <memory>
@@ -15,28 +15,39 @@ namespace veles_native {
 
 class NativeWorkflow {
  public:
-  // Loads a package tar; builds units via the UUID factory.
+  // Loads a package tar; builds units via the UUID factory.  Format 2
+  // packages carry explicit unit names + input links (general DAG);
+  // format 1 packages are treated as a linear chain.
   explicit NativeWorkflow(const std::string& path);
   ~NativeWorkflow();
 
   // Plans the arena for `batch` samples (idempotent per batch size).
   void Initialize(int batch);
 
-  // Runs the chain; in has batch*input_size floats, out receives
+  // Runs the graph; in has batch*input_size floats, out receives
   // batch*output_size.
   void Run(const float* in, float* out, int batch);
 
   int64_t input_size() const { return NumElements(input_shape_); }
   int64_t output_size() const;
   int64_t arena_size() const { return arena_size_; }
-  size_t unit_count() const { return units_.size(); }
+  size_t unit_count() const { return nodes_.size(); }
   const Shape& input_shape() const { return input_shape_; }
 
  private:
+  struct Node {
+    std::unique_ptr<Unit> unit;
+    std::vector<int> inputs;  // producer node index; -1 = graph input
+    Shape out_shape;          // sample shape (no batch)
+    int last_consumer = -1;   // topo position of last reader
+  };
+
+  void BuildShapes();
+
   std::unique_ptr<class Engine> engine_;
-  std::vector<std::unique_ptr<Unit>> units_;
-  std::vector<Shape> stage_shapes_;   // per-stage sample shapes
-  std::vector<int64_t> offsets_;      // per-stage output offsets
+  std::vector<Node> nodes_;       // in topological (execution) order
+  int output_node_ = -1;
+  std::vector<int64_t> offsets_;  // per-node output offset in arena
   std::vector<char> arena_;
   int64_t arena_size_ = 0;
   int planned_batch_ = -1;

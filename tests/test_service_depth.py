@@ -1,0 +1,190 @@
+"""Round-2 service depth: PDF publishing, forge upload auth, sqlite
+snapshot sink, WebHDFS loader (in-process fake namenode), audio
+loader on real WAV files."""
+
+import http.server
+import json
+import sqlite3
+import threading
+import wave
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.prng import RandomGenerator
+
+
+# ---------------------------------------------------------------- pdf
+
+
+def test_pdf_publishing_backend(tmp_path, cpu_device):
+    from tests.test_native import _train_mlp
+    from veles_tpu.publishing import PDFBackend, Publisher
+
+    sw = _train_mlp(cpu_device, epochs=1)
+    publisher = Publisher(sw, backends=[PDFBackend(str(tmp_path))])
+    publisher.run()
+    pdf = tmp_path / "report.pdf"
+    assert pdf.exists()
+    head = pdf.read_bytes()[:5]
+    assert head == b"%PDF-"
+    assert pdf.stat().st_size > 1000
+
+
+# -------------------------------------------------------------- forge
+
+
+def test_forge_upload_token_auth(tmp_path):
+    import urllib.error
+
+    from veles_tpu.forge import ForgeServer, list_packages, upload
+
+    server = ForgeServer(str(tmp_path / "store"), upload_token="tok123")
+    server.start_background()
+    url = "http://127.0.0.1:%d" % server.port
+    pkg = tmp_path / "p.tar"
+    pkg.write_bytes(b"payload")
+    try:
+        # no token -> 401, nothing stored
+        with pytest.raises(urllib.error.HTTPError) as err:
+            upload(url, "pkg", "1.0.0", str(pkg), token="")
+        assert err.value.code == 401
+        # wrong token -> 401
+        with pytest.raises(urllib.error.HTTPError) as err:
+            upload(url, "pkg", "1.0.0", str(pkg), token="nope")
+        assert err.value.code == 401
+        assert list_packages(url) == []
+        # right token -> stored
+        upload(url, "pkg", "1.0.0", str(pkg), token="tok123")
+        assert len(list_packages(url)) == 1
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------- snapshot db sink
+
+
+def test_snapshot_sqlite_sink(tmp_path, cpu_device):
+    from tests.test_native import _train_mlp
+    from veles_tpu.snapshotter import Snapshotter
+
+    sw = _train_mlp(cpu_device, epochs=1)
+    db = str(tmp_path / "snapshots.sqlite")
+    snap = Snapshotter(sw, directory=str(tmp_path), prefix="db",
+                       interval=1, time_interval=0, db_path=db)
+    snap.run()
+    snap.run()
+    rows = sqlite3.connect(db).execute(
+        "SELECT prefix, workflow, destination, bytes, best_metric "
+        "FROM snapshots").fetchall()
+    assert len(rows) == 2
+    prefix, workflow_name, destination, nbytes, metric = rows[0]
+    assert prefix == "db" and "StandardWorkflow" in workflow_name
+    assert destination.startswith(str(tmp_path))
+    assert nbytes > 0
+    assert metric is not None
+
+
+# ---------------------------------------------------------------- hdfs
+
+
+class _FakeWebHdfs(http.server.BaseHTTPRequestHandler):
+    """Speaks just enough WebHDFS v1 for the loader."""
+
+    files = {
+        "/data/a.txt": b"0.1 0.2 0\n0.3 0.4 1\n",
+        "/data/b.txt": b"0.5 0.6 2\n0.7 0.8 0\n0.9 1.0 1\n",
+    }
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        from urllib.parse import parse_qs, urlparse
+        parsed = urlparse(self.path)
+        op = parse_qs(parsed.query).get("op", [""])[0]
+        path = parsed.path[len("/webhdfs/v1"):]
+        if op == "LISTSTATUS":
+            statuses = [
+                {"pathSuffix": name.rsplit("/", 1)[1], "type": "FILE",
+                 "length": len(data)}
+                for name, data in sorted(self.files.items())
+                if name.startswith(path + "/")]
+            body = json.dumps(
+                {"FileStatuses": {"FileStatus": statuses}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+        elif op == "OPEN" and path in self.files:
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(self.files[path])
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+
+def test_hdfs_text_loader(cpu_device):
+    from veles_tpu.loader import HdfsTextLoader
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), _FakeWebHdfs)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        wf = DummyWorkflow()
+        loader = HdfsTextLoader(
+            wf.workflow,
+            hdfs_url="http://127.0.0.1:%d" % httpd.server_port,
+            hdfs_path="/data", suffix=".txt", validation_ratio=0.4,
+            minibatch_size=2, prng=RandomGenerator("hdfs", seed=1))
+        loader.initialize(device=cpu_device)
+        assert loader.class_lengths[1] == 2   # 40% of 5
+        assert loader.class_lengths[2] == 3
+        assert loader.shape == (2,)
+        loader.original_data.map_read()
+        assert loader.original_data.mem.shape == (5, 2)
+        assert sorted(loader.labels_mapping) == [0, 1, 2]
+    finally:
+        httpd.shutdown()
+
+
+# --------------------------------------------------------------- audio
+
+
+def _write_wav(path, freq, rate=8000, seconds=0.5):
+    t = numpy.arange(int(rate * seconds)) / rate
+    tone = (numpy.sin(2 * numpy.pi * freq * t) * 0.5 *
+            32767).astype(numpy.int16)
+    with wave.open(str(path), "wb") as wav:
+        wav.setnchannels(1)
+        wav.setsampwidth(2)
+        wav.setframerate(rate)
+        wav.writeframes(tone.tobytes())
+
+
+def test_audio_loader_real_wavs(tmp_path, cpu_device):
+    from veles_tpu.loader import AudioFileLoader
+    from veles_tpu.loader.audio import read_audio
+
+    for cls, freq in (("low", 200), ("high", 1200)):
+        cdir = tmp_path / "train" / cls
+        cdir.mkdir(parents=True)
+        for i in range(2):
+            _write_wav(cdir / ("t%d.wav" % i), freq + i * 10)
+
+    data, rate = read_audio(
+        str(tmp_path / "train" / "low" / "t0.wav"))
+    assert rate == 8000 and abs(float(numpy.abs(data).max()) - 0.5) < 0.01
+
+    wf = DummyWorkflow()
+    loader = AudioFileLoader(
+        wf.workflow, train_dir=str(tmp_path / "train"),
+        window_frames=1024, minibatch_size=4,
+        prng=RandomGenerator("audio", seed=1))
+    loader.initialize(device=cpu_device)
+    # 4000 frames per file, stride 1024 -> 3 windows * 4 files
+    assert loader.class_lengths[2] == 12
+    assert loader.shape == (1024,)
+    assert sorted(loader.labels_mapping) == ["high", "low"]

@@ -30,3 +30,60 @@ def run(workflow_class, config=None, **kwargs):
     """Programmatic entry point (reference: veles/__init__.py:142)."""
     from veles_tpu.__main__ import Main
     return Main().run_workflow(workflow_class, config, **kwargs)
+
+
+def load_plugins(paths=None):
+    """Discover and import plugin packages (reference
+    veles/__init__.py:294-306: packages shipping a ``.veles`` marker
+    register their units on import via the UnitRegistry metaclass).
+
+    A plugin is any importable top-level package whose directory
+    contains a ``.veles_tpu`` marker file.  Returns the imported
+    modules.  Scans ``paths`` (default sys.path) once per process.
+    """
+    import importlib
+    import os
+    import sys
+
+    if load_plugins._loaded is not None and paths is None:
+        return load_plugins._loaded
+    found = []
+    for entry in (paths if paths is not None else sys.path):
+        try:
+            names = os.listdir(entry or ".")
+        except OSError:
+            continue
+        for name in names:
+            pkg_dir = os.path.join(entry or ".", name)
+            if not os.path.exists(os.path.join(pkg_dir, ".veles_tpu")):
+                continue
+            try:
+                found.append(importlib.import_module(name))
+            except Exception as exc:
+                import logging
+                logging.getLogger("veles_tpu").warning(
+                    "plugin %s failed to import: %s", name, exc)
+    if paths is None:
+        load_plugins._loaded = found
+    return found
+
+
+load_plugins._loaded = None
+
+
+def _make_module_callable():
+    """``import veles_tpu; veles_tpu(MyWorkflow, config)`` — the
+    reference's callable-module magic (veles/__init__.py:126)."""
+    import sys
+    import types
+
+    mod = sys.modules[__name__]
+
+    class _CallableModule(types.ModuleType):
+        def __call__(self, workflow_class, config=None, **kwargs):
+            return run(workflow_class, config, **kwargs)
+
+    mod.__class__ = _CallableModule
+
+
+_make_module_callable()

@@ -144,11 +144,15 @@ class Main(object):
             root.common.engine.backend = args.device
         if args.result_file:
             root.common.result_file = args.result_file
+        from veles_tpu.cmdline import apply_parsed_args
+        apply_parsed_args(args)
         if args.sync_run:
             root.common.sync_run = True
         if not args.workflow:
             parser.print_help()
             return self.EXIT_FAILURE
+        import veles_tpu
+        veles_tpu.load_plugins()
         self._apply_config(args.config, overrides)
         module = self._load_workflow_module(args.workflow)
         if overrides:

@@ -14,6 +14,8 @@ import os
 import random
 import threading
 
+from veles_tpu.cmdline import CommandLineArgumentsRegistry
+from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.network_common import (
     ProtocolError, default_secret, pack_payload, parse_address,
@@ -22,19 +24,52 @@ from veles_tpu.network_common import (
 __all__ = ["Client"]
 
 
-class Client(Logger):
-    def __init__(self, address, workflow, launcher=None, codec="none",
-                 async_slave=False, reconnect_limit=5,
-                 death_probability=0.0, secret=None):
+class Client(Logger, metaclass=CommandLineArgumentsRegistry):
+
+    @classmethod
+    def init_parser(cls, parser):
+        parser.add_argument(
+            "--async-slave", action="store_true", default=None,
+            help="pipeline: request the next job while the previous "
+                 "update is in flight")
+        parser.add_argument(
+            "--reconnect-limit", type=int, default=None,
+            help="reconnection attempt budget")
+        parser.add_argument(
+            "--death-probability", type=float, default=None,
+            help="chaos testing: per-job probability of simulated "
+                 "sudden death")
+        return parser
+
+    @classmethod
+    def apply_args(cls, args):
+        cfg = {}
+        for flag in ("async_slave", "reconnect_limit",
+                     "death_probability"):
+            value = getattr(args, flag, None)
+            if value is not None:
+                cfg[flag] = value
+        root.common.network.update(cfg)
+
+    def __init__(self, address, workflow, launcher=None, codec=None,
+                 async_slave=None, reconnect_limit=None,
+                 death_probability=None, secret=None):
         super(Client, self).__init__()
+        net = root.common.network
         self.host, self.port = parse_address(address,
                                              default_host="127.0.0.1")
         self.workflow = workflow
         self.launcher = launcher
-        self.codec = codec
-        self.async_slave = async_slave
-        self.reconnect_limit = reconnect_limit
-        self.death_probability = death_probability
+        self.codec = codec if codec is not None else net.get(
+            "codec", "none")
+        self.async_slave = async_slave if async_slave is not None \
+            else net.get("async_slave", False)
+        self.reconnect_limit = reconnect_limit \
+            if reconnect_limit is not None \
+            else net.get("reconnect_limit", 5)
+        self.death_probability = death_probability \
+            if death_probability is not None \
+            else net.get("death_probability", 0.0)
         self.secret = secret if secret is not None else default_secret()
         self.sid = None
         self.jobs_done = 0

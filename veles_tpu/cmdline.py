@@ -5,12 +5,16 @@ aggregation (reference: veles/cmdline.py:61,86).  Any class whose metaclass
 is :class:`CommandLineArgumentsRegistry` (or that subclasses
 :class:`CommandLineBase`) may define a classmethod ``init_parser(parser)``
 adding its own flags; :func:`build_parser` folds every registered class's
-flags into one parser for the CLI.
+flags into one parser for the CLI.  A contributor may also define a
+classmethod ``apply_args(args)`` — :func:`apply_parsed_args` fans the
+parsed namespace back out so each class can install its settings
+(usually into the ``root`` config tree its constructor consults).
 """
 
 import argparse
 
-__all__ = ["CommandLineArgumentsRegistry", "CommandLineBase", "build_parser"]
+__all__ = ["CommandLineArgumentsRegistry", "CommandLineBase",
+           "build_parser", "apply_parsed_args"]
 
 
 class CommandLineArgumentsRegistry(type):
@@ -21,7 +25,7 @@ class CommandLineArgumentsRegistry(type):
     def __init__(cls, name, bases, namespace):
         super(CommandLineArgumentsRegistry, cls).__init__(
             name, bases, namespace)
-        if "init_parser" in namespace:
+        if "init_parser" in namespace or "apply_args" in namespace:
             CommandLineArgumentsRegistry.classes.append(cls)
 
 
@@ -33,8 +37,19 @@ class CommandLineBase(object, metaclass=CommandLineArgumentsRegistry):
         return parser
 
 
+def _import_standard_contributors():
+    """Registration happens at class creation; pull in the framework
+    modules that contribute flags so the CLI is complete regardless of
+    what the workflow file imports."""
+    import veles_tpu.client  # noqa: F401
+    import veles_tpu.launcher  # noqa: F401
+    import veles_tpu.server  # noqa: F401
+    import veles_tpu.snapshotter  # noqa: F401
+
+
 def build_parser(**kwargs):
     """Build one parser from every registered contributor."""
+    _import_standard_contributors()
     parser = argparse.ArgumentParser(
         prog="veles_tpu",
         description="VELES-TPU: a TPU-native distributed deep learning "
@@ -47,3 +62,16 @@ def build_parser(**kwargs):
         seen.add(init)
         init.__get__(None, cls)(parser)
     return parser
+
+
+def apply_parsed_args(args):
+    """Fan the parsed namespace back out to every contributor that
+    defines ``apply_args`` (constructors then read the settings from
+    the config tree)."""
+    seen = set()
+    for cls in CommandLineArgumentsRegistry.classes:
+        apply = cls.__dict__.get("apply_args")
+        if apply is None or apply in seen:
+            continue
+        seen.add(apply)
+        apply.__get__(None, cls)(args)

@@ -35,15 +35,22 @@ def fetch(base_url, name, destination, version="latest"):
     return destination, headers.get("X-Package-Version")
 
 
-def upload(base_url, name, version, package_path, metadata=None):
+def upload(base_url, name, version, package_path, metadata=None,
+           token=None):
+    import os
     with open(package_path, "rb") as fin:
         payload = fin.read()
     query = urllib.parse.urlencode({
         "name": name, "version": version,
         "metadata": json.dumps(metadata or {})})
+    headers = {"Content-Type": "application/octet-stream"}
+    token = token if token is not None else os.environ.get(
+        "VELES_FORGE_TOKEN")
+    if token:
+        headers["Authorization"] = "Bearer %s" % token
     req = urllib.request.Request(
         base_url.rstrip("/") + "/upload?" + query, data=payload,
-        headers={"Content-Type": "application/octet-stream"})
+        headers=headers)
     with urllib.request.urlopen(req, timeout=60) as resp:
         return json.loads(resp.read())
 

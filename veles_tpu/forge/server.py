@@ -34,11 +34,18 @@ def _safe_component(value, what):
 
 
 class ForgeServer(Logger):
-    def __init__(self, root_dir, port=0):
+    """``upload_token``: when set, POST /upload requires
+    ``Authorization: Bearer <token>`` (the reference's forge used
+    email-confirmed tokens, forge_server.py; a shared bearer token is
+    this build's equivalent).  Reads default from $VELES_FORGE_TOKEN."""
+
+    def __init__(self, root_dir, port=0, upload_token=None):
         super(ForgeServer, self).__init__()
         self.root_dir = root_dir
         os.makedirs(root_dir, exist_ok=True)
         self.port = port
+        self.upload_token = (upload_token if upload_token is not None
+                             else os.environ.get("VELES_FORGE_TOKEN"))
         self._loop = None
         self._thread = None
 
@@ -150,6 +157,14 @@ class ForgeServer(Logger):
 
         class UploadHandler(tornado.web.RequestHandler):
             def post(self):
+                if forge.upload_token:
+                    import hmac as hmac_mod
+                    auth = self.request.headers.get("Authorization", "")
+                    want = "Bearer %s" % forge.upload_token
+                    if not hmac_mod.compare_digest(auth, want):
+                        self.set_status(401)
+                        self.write({"error": "upload token required"})
+                        return
                 name = self.get_argument("name")
                 version = self.get_argument("version")
                 meta_json = self.get_argument("metadata", "{}")

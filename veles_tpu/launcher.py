@@ -29,8 +29,12 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
 
     def __init__(self, interactive=False, **kwargs):
         super(Launcher, self).__init__(**kwargs)
-        self.master_address = kwargs.get("master_address", "")
-        self.listen_address = kwargs.get("listen_address", "")
+        from veles_tpu.config import root
+        cfg = root.common.launcher
+        self.master_address = kwargs.get(
+            "master_address", cfg.get("master_address", ""))
+        self.listen_address = kwargs.get(
+            "listen_address", cfg.get("listen_address", ""))
         self.matplotlib_backend = kwargs.get("matplotlib_backend", "")
         self.interactive = interactive
         self._workflow = None
@@ -50,6 +54,14 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "-m", "--master-address", default="",
             help="run as slave of the given master host:port")
         return parser
+
+    @classmethod
+    def apply_args(cls, args):
+        from veles_tpu.config import root
+        root.common.launcher.update({
+            "listen_address": getattr(args, "listen_address", ""),
+            "master_address": getattr(args, "master_address", ""),
+        })
 
     # -- workflow ownership (Unit.workflow protocol) -----------------------
 

@@ -15,10 +15,11 @@ import os
 import numpy
 
 from veles_tpu.loader.base import Loader, LoaderError, TEST, VALID, TRAIN
-from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
 
 __all__ = ["ImageAugmentation", "FullBatchImageLoader",
-           "FileImageLoader", "scan_image_tree"]
+           "FileImageLoader", "FullBatchImageLoaderMSE",
+           "FileImageLoaderMSE", "scan_image_tree"]
 
 IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".pgm",
                     ".tif", ".tiff", ".webp")
@@ -84,12 +85,41 @@ def scan_image_tree(root_dir):
     return samples
 
 
+def distortion_stages(mirror, rotations):
+    """The reference's deterministic distortion composition
+    (fullbatch_image.py:63-80 DistortionIterator): every (mirror,
+    rotation) combination, materialized."""
+    stages = []
+    for rot in rotations:
+        stages.append((False, rot))
+        if mirror is True or mirror == "always":
+            stages.append((True, rot))
+    return stages
+
+
+def distort(img, mirror_state, rotation):
+    """Apply one deterministic distortion stage."""
+    import cv2
+    if rotation:
+        h, w = img.shape[:2]
+        mat = cv2.getRotationMatrix2D((w / 2, h / 2), rotation, 1.0)
+        img = cv2.warpAffine(img, mat, (w, h))
+        if img.ndim == 2:
+            img = img[..., None]
+    if mirror_state:
+        img = img[:, ::-1]
+    return numpy.ascontiguousarray(img)
+
+
 class FullBatchImageLoader(FullBatchLoader):
     """Loads explicit (path, label) lists per split into one device
     batch (reference fullbatch_image.py:56-266).
 
     kwargs: test_paths / validation_paths / train_paths: lists of
-    (path, label); augmentation: ImageAugmentation; grayscale: bool.
+    (path, label); augmentation: ImageAugmentation; grayscale: bool;
+    distortion composition via mirror=True + rotations=(0, 15, -15):
+    every TRAIN sample is materialized once per (mirror, rotation)
+    combination (samples_inflation, reference DistortionIterator).
     """
 
     def __init__(self, workflow, **kwargs):
@@ -99,6 +129,13 @@ class FullBatchImageLoader(FullBatchLoader):
                             kwargs.get("train_paths", ()))
         self.augmentation = kwargs.get("augmentation")
         self.grayscale = kwargs.get("grayscale", False)
+        self.mirror = kwargs.get("mirror", False)
+        self.rotations = tuple(kwargs.get("rotations", (0,)))
+
+    @property
+    def samples_inflation(self):
+        """How many distorted copies each TRAIN sample becomes."""
+        return len(distortion_stages(self.mirror, self.rotations))
 
     def _read_image(self, path):
         import cv2
@@ -113,19 +150,38 @@ class FullBatchImageLoader(FullBatchLoader):
             img = img[..., None]
         return img
 
+    def _expanded_splits(self):
+        """(path, label, mirror_state, rotation) rows per split;
+        TRAIN inflated by the distortion composition."""
+        stages = distortion_stages(self.mirror, self.rotations)
+        out = []
+        for cls, split in enumerate(self.split_paths):
+            rows = []
+            for path, label in split:
+                if cls == TRAIN and len(stages) > 1:
+                    for mirror_state, rot in stages:
+                        rows.append((path, label, mirror_state, rot))
+                else:
+                    rows.append((path, label, False, 0))
+            out.append(rows)
+        return out
+
     def load_data(self):
-        for i, split in enumerate(self.split_paths):
-            self.class_lengths[i] = len(split)
+        splits = self._expanded_splits()
+        for i, rows in enumerate(splits):
+            self.class_lengths[i] = len(rows)
         self._calc_class_end_offsets()
-        flat = [pair for split in self.split_paths for pair in split]
+        flat = [row for rows in splits for row in rows]
         first = self._read_image(flat[0][0])
         self.create_originals(first.shape)
-        for i, (path, label) in enumerate(flat):
+        for i, (path, label, mirror_state, rot) in enumerate(flat):
             img = self._read_image(path)
             if img.shape != first.shape:
                 raise LoaderError(
                     "image %s shape %s != %s (use augmentation.scale)" %
                     (path, img.shape, first.shape))
+            if mirror_state or rot:
+                img = distort(img, mirror_state, rot)
             self.original_data.mem[i] = img.astype(self.dtype) / 255.0
             self.original_labels[i] = label
 
@@ -141,3 +197,77 @@ class FileImageLoader(FullBatchImageLoader):
         kwargs["test_paths"], kwargs["validation_paths"], \
             kwargs["train_paths"] = paths
         super(FileImageLoader, self).__init__(workflow, **kwargs)
+
+
+class FullBatchImageLoaderMSE(FullBatchImageLoader, FullBatchLoaderMSE):
+    """(input image, target image) pairs for MSE workflows (reference
+    image_mse.py:47-158 + fullbatch_image.py:200-222 class_targets).
+
+    Target sources, either of:
+    - ``target_paths``: one target image path per sample, ordered like
+      test_paths + validation_paths + train_paths (label-less MSE);
+    - ``class_target_paths``: {label: path} — one target image per
+      class; each sample's target is its class's image (the
+      reference's ``class_targets`` mapping).
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(FullBatchImageLoaderMSE, self).__init__(workflow, **kwargs)
+        self.target_paths = list(kwargs.get("target_paths", ()))
+        self.class_target_paths = dict(
+            kwargs.get("class_target_paths", {}))
+        if bool(self.target_paths) == bool(self.class_target_paths):
+            raise LoaderError(
+                "provide exactly one of target_paths / "
+                "class_target_paths")
+
+    def load_data(self):
+        super(FullBatchImageLoaderMSE, self).load_data()
+        if self.class_target_paths:
+            targets_by_label = {
+                label: self._read_image(path).astype(self.dtype) / 255.0
+                for label, path in self.class_target_paths.items()}
+            self.original_targets = numpy.stack(
+                [targets_by_label[label]
+                 for label in self.original_labels])
+            return
+        # per-sample targets follow the same distortion composition as
+        # the inputs so pairs stay aligned
+        splits = self._expanded_splits()
+        flat_inputs = [row for rows in splits for row in rows]
+        if len(self.target_paths) != sum(
+                len(s) for s in self.split_paths):
+            raise LoaderError(
+                "%d target_paths for %d source images" %
+                (len(self.target_paths),
+                 sum(len(s) for s in self.split_paths)))
+        target_by_source = {}
+        flat_sources = [pair[0] for split in self.split_paths
+                        for pair in split]
+        for src, tgt in zip(flat_sources, self.target_paths):
+            target_by_source[src] = tgt
+        targets = []
+        for path, _label, mirror_state, rot in flat_inputs:
+            img = self._read_image(target_by_source[path])
+            if mirror_state or rot:
+                img = distort(img, mirror_state, rot)
+            targets.append(img.astype(self.dtype) / 255.0)
+        self.original_targets = numpy.stack(targets)
+
+
+class FileImageLoaderMSE(FullBatchImageLoaderMSE):
+    """Directory-scanning MSE variant (reference image_mse.py:129-158):
+    target_dir holds one image per source basename."""
+
+    def __init__(self, workflow, **kwargs):
+        dirs = [kwargs.get("test_dir"), kwargs.get("validation_dir"),
+                kwargs.get("train_dir")]
+        paths = tuple(scan_image_tree(d) if d else () for d in dirs)
+        kwargs["test_paths"], kwargs["validation_paths"], \
+            kwargs["train_paths"] = paths
+        target_dir = kwargs.get("target_dir")
+        if target_dir and "target_paths" not in kwargs:
+            kwargs["target_paths"] = [
+                os.path.join(target_dir, os.path.basename(p))
+                for split in paths for (p, _label) in split]
+        super(FileImageLoaderMSE, self).__init__(workflow, **kwargs)

@@ -47,6 +47,7 @@ UNIT_UUIDS = {
     "ForwardRELU":      "5a51b268-0032-4000-8000-76656c6573aa",
     "ForwardStrictRELU": "5a51b268-0033-4000-8000-76656c6573aa",
     "ForwardSigmoid":   "5a51b268-0034-4000-8000-76656c6573aa",
+    "InputJoiner":      "5a51b268-0041-4000-8000-76656c6573aa",
 }
 
 
@@ -70,22 +71,63 @@ def _unit_properties(fwd):
     return props
 
 
-def export_workflow(workflow, path, precision="float32"):
-    """Write the inference package; returns the path."""
+def _resolve_inputs(fwd, producer_by_array, loader):
+    """Producer names for a unit, matched by Array object identity.
+
+    Multi-input units expose ``inputs`` (a list of Arrays, e.g.
+    InputJoiner); everything else exposes ``input``."""
+    arrays = getattr(fwd, "inputs", None)
+    if not arrays:
+        arrays = [getattr(fwd, "input", None)]
+    names = []
+    for arr in arrays:
+        if arr is None:
+            continue
+        key = id(arr)
+        if key in producer_by_array:
+            names.append(producer_by_array[key])
+        elif loader is not None and arr is loader.minibatch_data:
+            names.append("__input__")
+        else:
+            raise ValueError(
+                "cannot resolve the producer of %s.input; the source "
+                "must be another exported unit's output or the "
+                "loader's minibatch_data" % type(fwd).__name__)
+    return names
+
+
+def export_workflow(workflow, path, precision="float32", units=None):
+    """Write the inference package; returns the path.
+
+    ``units``: explicit unit list for non-linear graphs (defaults to
+    ``workflow.forwards``).  Links are recorded per unit (format 2) so
+    the native runtime rebuilds the general DAG (reference
+    workflow_loader.cc:73-120)."""
     from veles_tpu.models.dropout import DropoutForward
 
-    forwards = [f for f in workflow.forwards
+    loader = getattr(workflow, "loader", None)
+    candidates = list(units if units is not None else workflow.forwards)
+    forwards = [f for f in candidates
                 if not isinstance(f, DropoutForward)]
-    units = []
+    # outputs of dropped dropout units: consumers of these fall back to
+    # the dropout's own producer chain (identity at inference)
+    dropped_outputs = {
+        id(f.output) for f in candidates
+        if isinstance(f, DropoutForward) and f.output is not None}
+    out_units = []
     files = {}
     counter = 0
-    for fwd in forwards:
+    producer_by_array = {}
+    names = []
+    for i, fwd in enumerate(forwards):
         cls_name = type(fwd).__name__
         uuid = UNIT_UUIDS.get(cls_name)
         if uuid is None:
             raise ValueError(
                 "%s has no stable UUID; extend UNIT_UUIDS + the native "
                 "factory" % cls_name)
+        name = "u%03d_%s" % (i, cls_name)
+        names.append(name)
         arrays = {}
         for aname in ("weights", "bias"):
             arr = getattr(fwd, aname, None)
@@ -95,18 +137,36 @@ def export_workflow(workflow, path, precision="float32"):
                 files[fname] = _npy_bytes(arr.mem, precision)
                 arrays[aname] = fname
                 counter += 1
-        units.append({
-            "uuid": uuid, "class": cls_name,
+        out_units.append({
+            "uuid": uuid, "class": cls_name, "name": name,
             "properties": _unit_properties(fwd),
             "arrays": arrays,
         })
+        output = getattr(fwd, "output", None)
+        if output is not None:
+            producer_by_array[id(output)] = name
 
-    loader = getattr(workflow, "loader", None)
+    # link pass (dropout units were dropped: look through them by
+    # resolving against the kept producers only)
+    for fwd, unit_json in zip(forwards, out_units):
+        prev_index = out_units.index(unit_json) - 1
+        try:
+            unit_json["inputs"] = _resolve_inputs(
+                fwd, producer_by_array, loader)
+        except ValueError:
+            # a dropped dropout sat between this unit and its real
+            # producer: fall back to the previous kept unit
+            if prev_index < 0:
+                unit_json["inputs"] = ["__input__"]
+            else:
+                unit_json["inputs"] = [names[prev_index]]
+
+    units = out_units
     input_shape = (list(loader.minibatch_data.shape[1:])
                    if loader is not None and loader.minibatch_data
                    else None)
     contents = {
-        "format": 1,
+        "format": 2,
         "workflow": type(workflow).__name__,
         "checksum": workflow.checksum,
         "precision": precision,

@@ -4,7 +4,7 @@
 import json
 import os
 
-__all__ = ["MarkdownBackend", "HTMLBackend"]
+__all__ = ["MarkdownBackend", "HTMLBackend", "PDFBackend"]
 
 
 class BackendBase(object):
@@ -80,4 +80,73 @@ class HTMLBackend(BackendBase):
         path = os.path.join(self.output_dir, "report.html")
         with open(path, "w") as fout:
             fout.write(html)
+        return path
+
+
+class PDFBackend(BackendBase):
+    """PDF report via matplotlib's PdfPages (the reference rendered
+    PDF through its jinja/confluence stack; matplotlib is already this
+    framework's plotting engine)."""
+
+    def render(self, info):
+        import matplotlib
+        matplotlib.use("Agg", force=False)
+        from matplotlib.backends.backend_pdf import PdfPages
+        import matplotlib.pyplot as plt
+
+        os.makedirs(self.output_dir, exist_ok=True)
+        path = os.path.join(self.output_dir, "report.pdf")
+        with PdfPages(path) as pdf:
+            fig = plt.figure(figsize=(8.27, 11.69))  # A4
+            fig.text(0.5, 0.95, "Training report: %s" % info["name"],
+                     ha="center", size=16, weight="bold")
+            fig.text(0.1, 0.90, "date: %s" % info["date"], size=10)
+            fig.text(0.1, 0.88, "checksum: %s" % info["checksum"],
+                     size=8, family="monospace")
+            fig.text(0.1, 0.86, "epochs: %s" % info["epochs"], size=10)
+
+            ax = fig.add_axes([0.1, 0.62, 0.8, 0.20])
+            ax.axis("off")
+            rows = [[split, str(info["metrics"].get(split))]
+                    for split in ("test", "validation", "train", "best")]
+            table = ax.table(cellText=rows,
+                             colLabels=["split", "metric"],
+                             loc="center")
+            table.scale(1, 1.4)
+            ax.set_title("Metrics")
+
+            ax2 = fig.add_axes([0.1, 0.40, 0.8, 0.16])
+            ax2.axis("off")
+            rows2 = [[split, str(info["dataset"].get(split))]
+                     for split in ("test", "validation", "train")]
+            ax2.table(cellText=rows2,
+                      colLabels=["split", "samples"], loc="center")
+            ax2.set_title("Dataset")
+
+            units = info["units"][:20]
+            if units:
+                ax3 = fig.add_axes([0.1, 0.05, 0.8, 0.30])
+                ax3.axis("off")
+                rows3 = [[u["name"], str(u["runs"]),
+                          "%.4f" % u["time"]] for u in units]
+                ax3.table(cellText=rows3,
+                          colLabels=["unit", "runs", "seconds"],
+                          loc="center")
+                ax3.set_title("Unit run times")
+            pdf.savefig(fig)
+            plt.close(fig)
+
+            plots_dir = info.get("plots_dir")
+            if plots_dir and os.path.isdir(plots_dir):
+                for fname in sorted(os.listdir(plots_dir)):
+                    if not fname.endswith(".png"):
+                        continue
+                    img = plt.imread(os.path.join(plots_dir, fname))
+                    fig = plt.figure(figsize=(8.27, 11.69))
+                    ax = fig.add_axes([0.05, 0.05, 0.9, 0.9])
+                    ax.imshow(img)
+                    ax.axis("off")
+                    ax.set_title(fname)
+                    pdf.savefig(fig)
+                    plt.close(fig)
         return path

@@ -24,6 +24,8 @@ import threading
 import time
 from collections import deque
 
+from veles_tpu.cmdline import CommandLineArgumentsRegistry
+from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.network_common import (
     ProtocolError, default_secret, new_id, pack_payload, parse_address,
@@ -55,17 +57,40 @@ class _SlaveConn(object):
         self.parked = False
 
 
-class Server(Logger):
+class Server(Logger, metaclass=CommandLineArgumentsRegistry):
     """Serve a workflow's jobs to connecting slaves."""
 
-    def __init__(self, address, workflow, launcher=None, codec="none",
-                 job_timeout=60.0, respawn_hook=None, secret=None):
+    @classmethod
+    def init_parser(cls, parser):
+        parser.add_argument(
+            "--job-timeout", type=float, default=None,
+            help="base seconds before a slave's job is considered "
+                 "stuck (the adaptive threshold never drops below it)")
+        parser.add_argument(
+            "--codec", default=None, choices=("none", "gzip"),
+            help="wire payload codec")
+        return parser
+
+    @classmethod
+    def apply_args(cls, args):
+        cfg = {}
+        if getattr(args, "job_timeout", None) is not None:
+            cfg["job_timeout"] = args.job_timeout
+        if getattr(args, "codec", None) is not None:
+            cfg["codec"] = args.codec
+        root.common.network.update(cfg)
+
+    def __init__(self, address, workflow, launcher=None, codec=None,
+                 job_timeout=None, respawn_hook=None, secret=None):
         super(Server, self).__init__()
+        net = root.common.network
         self.host, self.port = parse_address(address)
         self.workflow = workflow
         self.launcher = launcher
-        self.codec = codec
-        self.job_timeout = job_timeout
+        self.codec = codec if codec is not None else net.get(
+            "codec", "none")
+        self.job_timeout = job_timeout if job_timeout is not None \
+            else net.get("job_timeout", 60.0)
         self.respawn_hook = respawn_hook
         self.secret = secret if secret is not None else default_secret()
         self.blacklist = set()

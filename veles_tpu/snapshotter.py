@@ -99,13 +99,58 @@ class SnapshotterBase(Unit):
 
     hide_from_registry = True
 
+    @classmethod
+    def init_parser(cls, parser):
+        parser.add_argument(
+            "--snapshot-dir", default=None,
+            help="snapshot output directory")
+        parser.add_argument(
+            "--snapshot-interval", type=int, default=None,
+            help="snapshot every N improvements")
+        parser.add_argument(
+            "--snapshot-time-interval", type=float, default=None,
+            help="minimum seconds between snapshots")
+        parser.add_argument(
+            "--snapshot-compress", default=None,
+            choices=("", "gz", "bz2", "xz"),
+            help="snapshot compression codec")
+        parser.add_argument(
+            "--disable-snapshotting", action="store_true")
+        parser.add_argument(
+            "--snapshot-db", default=None,
+            help="sqlite file recording snapshot history (the "
+                 "reference's ODBC sink analog)")
+        return parser
+
+    @classmethod
+    def apply_args(cls, args):
+        cfg = {}
+        if getattr(args, "snapshot_dir", None):
+            cfg["dir"] = args.snapshot_dir
+        if getattr(args, "snapshot_interval", None) is not None:
+            cfg["interval"] = args.snapshot_interval
+        if getattr(args, "snapshot_time_interval", None) is not None:
+            cfg["time_interval"] = args.snapshot_time_interval
+        if getattr(args, "snapshot_compress", None) is not None:
+            cfg["compression"] = args.snapshot_compress
+        if getattr(args, "snapshot_db", None):
+            cfg["db"] = args.snapshot_db
+        root.common.snapshot.update(cfg)
+        if getattr(args, "disable_snapshotting", False):
+            root.common.disable.update({"snapshotting": True})
+
     def __init__(self, workflow, **kwargs):
+        cfg = root.common.snapshot
         self.prefix = kwargs.pop("prefix", "wf")
         self.directory = kwargs.pop(
-            "directory", root.common.dirs.get("snapshots", "/tmp"))
-        self.compression = kwargs.pop("compression", "gz")
-        self.interval = kwargs.pop("interval", 1)
-        self.time_interval = kwargs.pop("time_interval", 15)
+            "directory", cfg.get("dir") or
+            root.common.dirs.get("snapshots", "/tmp"))
+        self.compression = kwargs.pop(
+            "compression", cfg.get("compression", "gz"))
+        self.interval = kwargs.pop("interval", cfg.get("interval", 1))
+        self.time_interval = kwargs.pop(
+            "time_interval", cfg.get("time_interval", 15))
+        self._db_path = kwargs.pop("db_path", cfg.get("db"))
         super(SnapshotterBase, self).__init__(workflow, **kwargs)
         self.skip = Bool(False)
         self.suffix = None
@@ -136,6 +181,35 @@ class SnapshotterBase(Unit):
 
     def export(self):  # pragma: no cover - overridden
         raise NotImplementedError
+
+    def _record_in_db(self, destination, nbytes):
+        """Append a row to the snapshot database (the reference's ODBC
+        sink, snapshotter.py:428-518; sqlite here).  Enabled via
+        ``db_path=`` kwarg or root.common.snapshot.db."""
+        db_path = self._db_path
+        if not db_path:
+            return
+        import sqlite3
+        decision = getattr(self.workflow, "decision", None)
+        metric = getattr(decision, "best_metric", None)
+        epoch = getattr(decision, "epoch_number", None)
+        with sqlite3.connect(db_path) as conn:
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS snapshots ("
+                "  id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                "  timestamp TEXT NOT NULL,"
+                "  prefix TEXT, workflow TEXT, checksum TEXT,"
+                "  destination TEXT, bytes INTEGER,"
+                "  epoch INTEGER, best_metric REAL)")
+            conn.execute(
+                "INSERT INTO snapshots (timestamp, prefix, workflow, "
+                "checksum, destination, bytes, epoch, best_metric) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+                (time.strftime("%Y-%m-%d %H:%M:%S"), self.prefix,
+                 type(self.workflow).__name__,
+                 getattr(self.workflow, "checksum", None),
+                 destination, nbytes, epoch,
+                 float(metric) if metric is not None else None))
 
     def _destination(self):
         suffix = self.suffix or time.strftime("%Y%m%d_%H%M%S")
@@ -178,6 +252,7 @@ class Snapshotter(SnapshotterBase):
         with writer(self.destination) as fout:
             fout.write(payload)
         self._update_current_link()
+        self._record_in_db(self.destination, len(payload))
         self.info("snapshot -> %s (%.1f MB, %.2f s)", self.destination,
                   len(payload) / 1e6, time.time() - start)
 

@@ -44,6 +44,11 @@ class UnitRegistry(type):
         for base in bases:
             kwattrs |= getattr(base, "KWATTRS", set())
         cls.KWATTRS = kwattrs
+        # Units contributing CLI flags join the argparse registry (the
+        # reference combined both metaclasses, cmdline.py:61-84)
+        if "init_parser" in namespace or "apply_args" in namespace:
+            from veles_tpu.cmdline import CommandLineArgumentsRegistry
+            CommandLineArgumentsRegistry.classes.append(cls)
 
 
 def nothing(*args, **kwargs):
