@@ -204,3 +204,71 @@ def test_pause_resume(cpu_device):
     server._done.wait(10)
     assert client.jobs_done > 0
     assert bool(master.decision.complete)
+
+
+def test_all_codecs_roundtrip():
+    from veles_tpu.network_common import (
+        available_codecs, pack_payload, unpack_payload)
+    obj = {"x": numpy.arange(2000, dtype=numpy.float32), "s": "веles"}
+    codecs = available_codecs()
+    assert {"none", "gzip", "bz2", "xz"} <= set(codecs)
+    for codec in codecs:
+        back = unpack_payload(pack_payload(obj, codec), codec)
+        numpy.testing.assert_array_equal(back["x"], obj["x"])
+        assert back["s"] == obj["s"]
+    with pytest.raises(ValueError):
+        pack_payload(obj, "brotli")
+
+
+def test_shm_channel_slots():
+    """Two-slot alternating shared-memory channel (SharedIO analog,
+    reference txzmq/sharedio.py:44)."""
+    from veles_tpu.network_common import ProtocolError, ShmChannel
+    chan = ShmChannel.create(1 << 12)
+    try:
+        peer = ShmChannel.attach(chan.name)
+        try:
+            a = chan.write(b"first")
+            b = chan.write(b"second")
+            assert a[0] != b[0], "slots must alternate"
+            assert peer.read(*a) == b"first"
+            assert peer.read(*b) == b"second"
+            # a third write lands back in the first slot
+            c = chan.write(b"third")
+            assert c[0] == a[0]
+            assert peer.read(*c) == b"third"
+            # oversized payloads fall back to inline (None)
+            assert chan.write(b"x" * (1 << 12)) is None
+            with pytest.raises(ProtocolError):
+                peer.read(1 << 11, 1 << 12)
+        finally:
+            peer.close()
+    finally:
+        chan.close()
+
+
+def test_shm_bypass_engaged_same_host(cpu_device):
+    """Same-machine master+slave: payloads ride shared memory (the
+    frame carries only descriptors), both directions, run completes."""
+    master = _build("master", "net_m9", cpu_device, max_epochs=2)
+    slave = _build("slave", "net_s9", cpu_device, max_epochs=2)
+    server, _ = _start_server(master)
+    client = Client("127.0.0.1:%d" % server.port, slave)
+    client.run()
+    server._done.wait(10)
+    assert client.jobs_done > 0
+    assert bool(master.decision.complete)
+    assert server.shm_sends > 0, "job payloads should ride shm"
+    assert client.shm_sends > 0, "update payloads should ride shm"
+
+
+def test_shm_bypass_disabled(cpu_device):
+    master = _build("master", "net_m10", cpu_device, max_epochs=2)
+    slave = _build("slave", "net_s10", cpu_device, max_epochs=2)
+    server, _ = _start_server(master, use_shm=False)
+    client = Client("127.0.0.1:%d" % server.port, slave)
+    client.run()
+    server._done.wait(10)
+    assert client.jobs_done > 0
+    assert server.shm_sends == 0
+    assert client.shm_sends == 0

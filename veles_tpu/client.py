@@ -18,8 +18,8 @@ from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.network_common import (
-    ProtocolError, default_secret, pack_payload, parse_address,
-    read_frame, unpack_payload, write_frame)
+    ProtocolError, ShmChannel, default_secret, machine_id, pack_payload,
+    parse_address, read_frame, unpack_payload, write_frame)
 
 __all__ = ["Client"]
 
@@ -74,10 +74,13 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
         self.sid = None
         self.jobs_done = 0
         self.reject_reason = None
+        self.shm_sends = 0
         self._stopping = False
         self._paused = False
         self._pending_update = None
         self._loop = None
+        self._shm_in = None         # master -> slave payload channel
+        self._shm_out = None        # slave -> master payload channel
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -147,6 +150,7 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                 "checksum": self.workflow.checksum,
                 "power": self.computing_power,
                 "mid": "%s:%d" % (os.uname().nodename, os.getpid()),
+                "machine": machine_id(),
                 "pid": os.getpid()})
             msg, payload = await self._recv(reader)
             if msg.get("type") == "reject":
@@ -156,6 +160,14 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                 return
             assert msg.get("type") == "handshake_ack"
             self.sid = msg["id"]
+            if "shm" in msg:
+                try:
+                    self._shm_in = ShmChannel.attach(msg["shm"]["m2s"])
+                    self._shm_out = ShmChannel.attach(msg["shm"]["s2m"])
+                    self.info("shm payload bypass engaged")
+                except Exception:
+                    self.exception("shm attach failed; staying on socket")
+                    self._close_shm()
             initial = unpack_payload(payload, msg.get("codec", "none"))
             if initial:
                 await self._in_thread(
@@ -163,6 +175,7 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             self.info("connected as %s", self.sid[:8])
             await self._job_loop(reader, writer)
         finally:
+            self._close_shm()
             writer.close()
 
     async def _job_loop(self, reader, writer):
@@ -226,15 +239,33 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
     _NO_PAYLOAD = object()
 
     def _send(self, writer, msg, payload=_NO_PAYLOAD):
-        raw = (pack_payload(payload, self.codec)
-               if payload is not Client._NO_PAYLOAD else b"")
+        if payload is not Client._NO_PAYLOAD:
+            raw = pack_payload(payload, self.codec)
+            if self._shm_out is not None:
+                desc = self._shm_out.write(raw)
+                if desc is not None:
+                    msg = dict(msg, shm=list(desc))
+                    self.shm_sends += 1
+                    raw = b""
+        else:
+            raw = b""
         write_frame(writer, msg, raw, self.secret)
 
     async def _recv(self, reader):
         try:
-            return await read_frame(reader, self.secret)
+            msg, payload = await read_frame(reader, self.secret)
         except asyncio.IncompleteReadError:
             raise ConnectionResetError("EOF from master")
+        if self._shm_in is not None and "shm" in msg:
+            off, length = msg["shm"]
+            payload = self._shm_in.read(off, length)
+        return msg, payload
+
+    def _close_shm(self):
+        for chan in (self._shm_in, self._shm_out):
+            if chan is not None:
+                chan.close()
+        self._shm_in = self._shm_out = None
 
     async def _in_thread(self, fn, *args):
         return await self._loop.run_in_executor(None, fn, *args)

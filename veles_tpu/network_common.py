@@ -12,7 +12,11 @@ small deltas.
 Framing: length-prefixed binary frames, ``!IIB`` (header_len,
 payload_len, mac_len) + JSON header + raw pickled payload + optional
 HMAC-SHA256 over header||payload.  No base64 inflation; payloads ride
-as raw bytes next to a small JSON control header.
+as raw bytes next to a small JSON control header.  Payload codecs:
+none/gzip/bz2/xz (+snappy when installed) — reference parity with
+txzmq/connection.py:140-143.  Same-host peers bypass the socket for
+payload bytes entirely via ``ShmChannel`` shared memory (the
+reference's SharedIO, txzmq/sharedio.py:44).
 
 Trust boundary: payloads are pickled objects, so a peer that can speak
 the protocol can execute code.  Protections, in order: (1) the default
@@ -23,18 +27,26 @@ authenticated with HMAC-SHA256 and unauthenticated frames are rejected
 *before* any unpickling.  Multi-host deployments must set a secret.
 """
 
+import bz2
 import gzip
 import hashlib
 import hmac
 import json
+import lzma
 import os
 import pickle
 import struct
 import uuid
 
+try:  # optional, reference codec parity (txzmq/connection.py:140)
+    import snappy as _snappy
+except ImportError:
+    _snappy = None
+
 __all__ = ["pack_payload", "unpack_payload", "read_frame", "write_frame",
            "parse_address", "new_id", "default_secret", "ProtocolError",
-           "encode_payload", "decode_payload"]
+           "encode_payload", "decode_payload", "available_codecs",
+           "ShmChannel", "machine_id"]
 
 _FRAME = struct.Struct("!IIB")
 _MAC_LEN = hashlib.sha256().digest_size
@@ -53,19 +65,37 @@ def default_secret():
     return sec.encode() if sec else None
 
 
+# Codec set mirrors the reference's streaming-pickle framing options
+# none/gzip/snappy/xz (txzmq/connection.py:140-143); bz2 added for
+# snapshot parity, snappy gated on availability.
+_COMPRESS = {
+    "none": (lambda raw: raw, lambda raw: raw),
+    "gzip": (lambda raw: gzip.compress(raw, 1), gzip.decompress),
+    "bz2": (lambda raw: bz2.compress(raw, 1), bz2.decompress),
+    "xz": (lambda raw: lzma.compress(raw, preset=1), lzma.decompress),
+}
+if _snappy is not None:
+    _COMPRESS["snappy"] = (_snappy.compress, _snappy.decompress)
+
+
+def available_codecs():
+    return tuple(_COMPRESS)
+
+
 def pack_payload(obj, codec="none"):
-    raw = pickle.dumps(obj, protocol=4)
-    if codec == "gzip":
-        raw = gzip.compress(raw, 1)
-    elif codec != "none":
+    try:
+        compress = _COMPRESS[codec][0]
+    except KeyError:
         raise ValueError("unknown codec %r" % codec)
-    return raw
+    return compress(pickle.dumps(obj, protocol=4))
 
 
 def unpack_payload(raw, codec="none"):
-    if codec == "gzip":
-        raw = gzip.decompress(raw)
-    return pickle.loads(raw)
+    try:
+        decompress = _COMPRESS[codec][1]
+    except KeyError:
+        raise ValueError("unknown codec %r" % codec)
+    return pickle.loads(decompress(raw))
 
 
 def write_frame(writer, msg, payload=b"", secret=None):
@@ -107,6 +137,75 @@ def parse_address(address, default_host="127.0.0.1"):
 
 def new_id():
     return str(uuid.uuid4())
+
+
+def machine_id():
+    """Stable per-host identifier used for same-machine detection
+    (the reference's ``mid``, network_common.py)."""
+    return "%x-%s" % (uuid.getnode(), os.uname().nodename)
+
+
+class ShmChannel(object):
+    """One-directional shared-memory payload channel.
+
+    TPU-native counterpart of the reference's ``SharedIO`` posix-ipc
+    ring (txzmq/sharedio.py:44-105; engaged for same-machine
+    master<->slave at server.py:144-167, client.py:140-159): when the
+    handshake detects both peers on one host, payload bytes ride a
+    shared-memory segment instead of the socket, and the frame header
+    carries only ``{"shm": [offset, length]}``.
+
+    The control protocol is strict request-reply per connection, so at
+    most one payload per direction is unconsumed at any time; a two-slot
+    alternating layout removes even that reasoning burden (the writer
+    never touches the slot the reader may still be consuming).
+
+    Trust note: shm payloads are not covered by the frame HMAC — the
+    segment is same-host, named by a random UUID, and created with
+    owner-only permissions, so the OS user boundary is the protection.
+    """
+
+    def __init__(self, shm, created):
+        self._shm = shm
+        self._created = created
+        self._slot = 0
+        self.name = shm.name
+        self.slot_size = shm.size // 2
+
+    @classmethod
+    def create(cls, size):
+        from multiprocessing import shared_memory
+        shm = shared_memory.SharedMemory(
+            create=True, size=max(int(size), 2), name=None)
+        return cls(shm, created=True)
+
+    @classmethod
+    def attach(cls, name):
+        from multiprocessing import shared_memory
+        return cls(shared_memory.SharedMemory(name=name), created=False)
+
+    def write(self, raw):
+        """Write bytes into the next slot -> (offset, length), or None
+        when the payload does not fit (caller falls back to inline)."""
+        if len(raw) > self.slot_size:
+            return None
+        offset = self._slot * self.slot_size
+        self._slot ^= 1
+        self._shm.buf[offset:offset + len(raw)] = raw
+        return offset, len(raw)
+
+    def read(self, offset, length):
+        if offset < 0 or length < 0 or offset + length > self._shm.size:
+            raise ProtocolError("shm descriptor out of bounds")
+        return bytes(self._shm.buf[offset:offset + length])
+
+    def close(self):
+        try:
+            self._shm.close()
+            if self._created:
+                self._shm.unlink()
+        except Exception:
+            pass
 
 
 # -- legacy dict codec (kept for tooling/tests that round-trip payloads) --

@@ -28,8 +28,9 @@ from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.network_common import (
-    ProtocolError, default_secret, new_id, pack_payload, parse_address,
-    read_frame, unpack_payload, write_frame)
+    ProtocolError, ShmChannel, available_codecs, default_secret,
+    machine_id, new_id, pack_payload, parse_address, read_frame,
+    unpack_payload, write_frame)
 
 __all__ = ["Server", "SlaveDescription"]
 
@@ -55,6 +56,14 @@ class _SlaveConn(object):
         self.jobs_out = {}          # job_id -> dispatch timestamp
         self.job_times = deque(maxlen=50)
         self.parked = False
+        self.shm_out = None         # master -> slave payload channel
+        self.shm_in = None          # slave -> master payload channel
+
+    def close_shm(self):
+        for chan in (self.shm_out, self.shm_in):
+            if chan is not None:
+                chan.close()
+        self.shm_out = self.shm_in = None
 
 
 class Server(Logger, metaclass=CommandLineArgumentsRegistry):
@@ -67,8 +76,11 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             help="base seconds before a slave's job is considered "
                  "stuck (the adaptive threshold never drops below it)")
         parser.add_argument(
-            "--codec", default=None, choices=("none", "gzip"),
+            "--codec", default=None, choices=available_codecs(),
             help="wire payload codec")
+        parser.add_argument(
+            "--no-shm", action="store_true", default=None,
+            help="disable the same-host shared-memory payload bypass")
         return parser
 
     @classmethod
@@ -78,10 +90,13 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             cfg["job_timeout"] = args.job_timeout
         if getattr(args, "codec", None) is not None:
             cfg["codec"] = args.codec
+        if getattr(args, "no_shm", None):
+            cfg["shm"] = False
         root.common.network.update(cfg)
 
     def __init__(self, address, workflow, launcher=None, codec=None,
-                 job_timeout=None, respawn_hook=None, secret=None):
+                 job_timeout=None, respawn_hook=None, secret=None,
+                 use_shm=None, shm_size=None):
         super(Server, self).__init__()
         net = root.common.network
         self.host, self.port = parse_address(address)
@@ -89,6 +104,11 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         self.launcher = launcher
         self.codec = codec if codec is not None else net.get(
             "codec", "none")
+        self.use_shm = use_shm if use_shm is not None else net.get(
+            "shm", True)
+        self.shm_size = shm_size if shm_size is not None else net.get(
+            "shm_size", 1 << 24)
+        self.shm_sends = 0
         self.job_timeout = job_timeout if job_timeout is not None \
             else net.get("job_timeout", 60.0)
         self.respawn_hook = respawn_hook
@@ -176,6 +196,8 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             self._finishing = True
             watchdog.cancel()
             self._broadcast_stop()
+            for conn in list(self.slaves.values()):
+                conn.close_shm()
             self._server.close()
             await self._server.wait_closed()
             self._done.set()
@@ -185,6 +207,10 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         try:
             while True:
                 msg, payload = await read_frame(reader, self.secret)
+                if conn is not None and conn.shm_in is not None \
+                        and "shm" in msg:
+                    off, length = msg["shm"]
+                    payload = conn.shm_in.read(off, length)
                 conn = await self._dispatch(
                     msg, payload, conn, reader, writer)
                 if conn is None and msg.get("type") != "handshake":
@@ -234,11 +260,24 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         slave = SlaveDescription(sid, mid, msg.get("pid", 0),
                                  msg.get("power", 1.0))
         conn = _SlaveConn(slave, reader, writer)
+        ack = {"type": "handshake_ack", "id": sid}
+        if self.use_shm and msg.get("machine") == machine_id():
+            # same host: payloads ride shared memory, not the socket
+            # (reference SharedIO engagement, server.py:144-167)
+            try:
+                conn.shm_out = ShmChannel.create(self.shm_size)
+                conn.shm_in = ShmChannel.create(self.shm_size)
+                ack["shm"] = {"m2s": conn.shm_out.name,
+                              "s2m": conn.shm_in.name}
+                self.info("slave %s is local: shm payload bypass on",
+                          sid[:8])
+            except Exception:
+                self.exception("shm setup failed; staying on socket")
+                conn.close_shm()
         self.slaves[sid] = conn
         initial = await self._in_thread(
             self.workflow.generate_initial_data_for_slave, slave)
-        self._send(writer, {"type": "handshake_ack", "id": sid},
-                   payload=initial)
+        self._send(writer, ack, payload=initial)
         if self._paused:
             self._send(writer, {"type": "pause"})
         self.info("slave %s connected (mid %s)", sid[:8], mid)
@@ -266,7 +305,7 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         conn.jobs_out[job_id] = time.time()
         self.jobs_dispatched += 1
         self._send(conn.writer, {"type": "job", "job_id": job_id},
-                   payload=data)
+                   payload=data, conn=conn)
 
     async def _apply_update(self, conn, msg, payload):
         update = unpack_payload(payload, msg.get("codec", "none"))
@@ -330,6 +369,7 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
     def _drop(self, conn, reason):
         if self.slaves.pop(conn.slave.id, None) is None:
             return
+        conn.close_shm()
         self.info("dropping slave %s (%s)", conn.slave.id[:8], reason)
         try:
             self.workflow.drop_slave(conn.slave)
@@ -352,10 +392,16 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
 
     _NO_PAYLOAD = object()
 
-    def _send(self, writer, msg, payload=_NO_PAYLOAD):
+    def _send(self, writer, msg, payload=_NO_PAYLOAD, conn=None):
         if payload is not Server._NO_PAYLOAD:
             msg = dict(msg, codec=self.codec)
             raw = pack_payload(payload, self.codec)
+            if conn is not None and conn.shm_out is not None:
+                desc = conn.shm_out.write(raw)
+                if desc is not None:
+                    msg["shm"] = list(desc)
+                    self.shm_sends += 1
+                    raw = b""
         else:
             raw = b""
         write_frame(writer, msg, raw, self.secret)
