@@ -1,7 +1,6 @@
 #include "engine.h"
 
 #include <algorithm>
-#include <atomic>
 
 namespace veles_native {
 
@@ -35,31 +34,28 @@ void Engine::WorkerLoop() {
   }
 }
 
-void Engine::ParallelFor(int total,
-                         const std::function<void(int, int)>& fn) {
-  int n = workers();
-  int chunk = (total + n - 1) / n;
-  std::atomic<int> remaining{0};
+void Engine::RunTasks(const std::vector<std::function<void()>>& tasks) {
+  if (tasks.empty()) return;
+  // completion count guarded by done_mutex (not an atomic): the waiter's
+  // predicate can only turn true while a worker holds the mutex, so the
+  // stack-allocated sync objects cannot be destroyed out from under a
+  // worker that is still about to lock them
+  int remaining = static_cast<int>(tasks.size());
   std::mutex done_mutex;
   std::condition_variable done_cv;
 
-  for (int start = 0; start < total; start += chunk) {
-    int count = std::min(chunk, total - start);
-    ++remaining;
-    {
-      std::lock_guard<std::mutex> lock(mutex_);
-      queue_.push([&, start, count] {
-        fn(start, count);
-        if (--remaining == 0) {
-          std::lock_guard<std::mutex> dl(done_mutex);
-          done_cv.notify_all();
-        }
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    for (const auto& task : tasks) {
+      queue_.push([&remaining, &done_mutex, &done_cv, &task] {
+        task();
+        std::lock_guard<std::mutex> dl(done_mutex);
+        if (--remaining == 0) done_cv.notify_all();
       });
     }
-    cv_.notify_one();
   }
+  cv_.notify_all();
   std::unique_lock<std::mutex> lock(done_mutex);
-  done_cv.wait(lock, [&] { return remaining.load() == 0; });
+  done_cv.wait(lock, [&] { return remaining == 0; });
 }
-
 }  // namespace veles_native

@@ -1,7 +1,8 @@
-// Thread-pool engine for batch-parallel inference (the reference's
-// engine.h:43 + thread_pool.h scheduled a unit DAG; an inference chain
-// is linear, so the parallelism that matters is ACROSS batch rows —
-// this engine shards the batch over workers).
+// Thread-pool engine for inference (the reference's engine.h:43 +
+// thread_pool.h scheduled a unit DAG).  Two axes of parallelism:
+// independent units of the same dependency wavefront run concurrently,
+// and each unit's batch rows are sharded across workers — both axes as
+// row-chunked tasks through RunTasks.
 #pragma once
 
 #include <condition_variable>
@@ -18,10 +19,10 @@ class Engine {
   explicit Engine(int workers = 0);
   ~Engine();
 
-  // Runs fn(start, count) over [0, total) split across workers; blocks
-  // until every shard completes.
-  void ParallelFor(int total,
-                   const std::function<void(int, int)>& fn);
+  // Runs every task on the pool; blocks until all complete.  Callers
+  // build the task list themselves: wavefront scheduling emits one
+  // task per (unit, row-chunk) so both parallel axes share the pool.
+  void RunTasks(const std::vector<std::function<void()>>& tasks);
 
   int workers() const { return static_cast<int>(threads_.size()); }
 

@@ -140,13 +140,33 @@ void NativeWorkflow::BuildShapes() {
                                        : nodes_[producer].out_shape);
     node.out_shape = node.unit->OutputShapeMulti(in_shapes);
   }
-  // liveness: a node's buffer must survive until its last consumer
+  // dependency wavefronts: level(i) = 1 + max level over producers.
+  // Nodes sharing a level have no path between them and run
+  // concurrently on the engine (reference engine.h:43 scheduled
+  // children when all parents finished; wavefronts are the static
+  // equivalent for a graph known up front).
+  levels_.clear();
   for (size_t i = 0; i < nodes_.size(); ++i) {
-    nodes_[i].last_consumer = static_cast<int>(i);
+    int lvl = 0;
+    for (int producer : nodes_[i].inputs)
+      if (producer >= 0)
+        lvl = std::max(lvl, nodes_[producer].level + 1);
+    nodes_[i].level = lvl;
+    if (static_cast<size_t>(lvl) >= levels_.size())
+      levels_.resize(lvl + 1);
+    levels_[lvl].push_back(static_cast<int>(i));
+  }
+  // liveness in LEVEL steps, the unit of temporal ordering under
+  // wavefront execution (topo index would be wrong: two same-level
+  // nodes run concurrently whatever their topo positions, so a
+  // buffer must stay live through the whole last-reader level)
+  for (size_t i = 0; i < nodes_.size(); ++i) {
+    nodes_[i].last_use_level = nodes_[i].level;
     for (size_t j = i + 1; j < nodes_.size(); ++j)
       for (int producer : nodes_[j].inputs)
         if (producer == static_cast<int>(i))
-          nodes_[i].last_consumer = static_cast<int>(j);
+          nodes_[i].last_use_level =
+              std::max(nodes_[i].last_use_level, nodes_[j].level);
   }
 }
 
@@ -166,7 +186,7 @@ void NativeWorkflow::Initialize(int batch) {
     int64_t bytes =
         NumElements(nodes_[i].out_shape) * batch * sizeof(float);
     if (i == output_node_) bytes = 0;  // written straight to out
-    requests.push_back({bytes, i, nodes_[i].last_consumer});
+    requests.push_back({bytes, nodes_[i].level, nodes_[i].last_use_level});
   }
   auto placements = PlanArena(requests, &arena_size_);
   offsets_.clear();
@@ -176,36 +196,63 @@ void NativeWorkflow::Initialize(int batch) {
 }
 
 void NativeWorkflow::Run(const float* in, float* out, int batch) {
+  if (batch <= 0) return;  // empty minibatch: nothing to write
   Initialize(batch);
   if (!engine_) engine_ = std::make_unique<Engine>();
   int n = static_cast<int>(nodes_.size());
-  for (int i = 0; i < n; ++i) {
-    const Node& node = nodes_[i];
-    float* dst =
-        (i == output_node_)
-            ? out
-            : reinterpret_cast<float*>(arena_.data() + offsets_[i]);
+
+  // Per-node run context, stable across the deferred wavefront tasks.
+  struct Ctx {
     std::vector<const float*> ins;
     std::vector<Shape> in_shapes;
     std::vector<int64_t> in_samples;
+    float* dst = nullptr;
+    int64_t out_sample = 0;
+  };
+  std::vector<Ctx> ctx(n);
+  for (int i = 0; i < n; ++i) {
+    const Node& node = nodes_[i];
+    Ctx& c = ctx[i];
+    c.dst = (i == output_node_)
+                ? out
+                : reinterpret_cast<float*>(arena_.data() + offsets_[i]);
     for (int producer : node.inputs) {
-      ins.push_back(producer < 0
-                        ? in
-                        : reinterpret_cast<const float*>(
-                              arena_.data() + offsets_[producer]));
-      in_shapes.push_back(producer < 0 ? input_shape_
-                                       : nodes_[producer].out_shape);
-      in_samples.push_back(NumElements(in_shapes.back()));
+      c.ins.push_back(producer < 0
+                          ? in
+                          : reinterpret_cast<const float*>(
+                                arena_.data() + offsets_[producer]));
+      c.in_shapes.push_back(producer < 0 ? input_shape_
+                                         : nodes_[producer].out_shape);
+      c.in_samples.push_back(NumElements(c.in_shapes.back()));
     }
-    int64_t out_sample = NumElements(node.out_shape);
-    // batch rows are independent: shard them over the engine workers
-    engine_->ParallelFor(batch, [&](int start, int count) {
-      std::vector<const float*> slice(ins);
-      for (size_t k = 0; k < slice.size(); ++k)
-        slice[k] += start * in_samples[k];
-      node.unit->RunMulti(slice, in_shapes,
-                          dst + start * out_sample, count);
-    });
+    c.out_sample = NumElements(node.out_shape);
+  }
+
+  // Two parallel axes per wavefront: every node in the level is
+  // independent, and each node's batch rows are independent.  Chunk
+  // rows so a level still fills the pool whatever its width.
+  int workers = engine_->workers();
+  for (const auto& level : levels_) {
+    int width = static_cast<int>(level.size());
+    int chunks_per_node =
+        std::min(batch, std::max(1, (workers + width - 1) / width));
+    int chunk = (batch + chunks_per_node - 1) / chunks_per_node;
+    std::vector<std::function<void()>> tasks;
+    for (int i : level) {
+      const Node& node = nodes_[i];
+      const Ctx& c = ctx[i];
+      for (int start = 0; start < batch; start += chunk) {
+        int count = std::min(chunk, batch - start);
+        tasks.push_back([&node, &c, start, count] {
+          std::vector<const float*> slice(c.ins);
+          for (size_t k = 0; k < slice.size(); ++k)
+            slice[k] += start * c.in_samples[k];
+          node.unit->RunMulti(slice, c.in_shapes,
+                              c.dst + start * c.out_sample, count);
+        });
+      }
+    }
+    engine_->RunTasks(tasks);
   }
 }
 

@@ -39,13 +39,15 @@ class NativeWorkflow {
     std::unique_ptr<Unit> unit;
     std::vector<int> inputs;  // producer node index; -1 = graph input
     Shape out_shape;          // sample shape (no batch)
-    int last_consumer = -1;   // topo position of last reader
+    int level = 0;            // dependency wavefront index
+    int last_use_level = 0;   // level of the last reader
   };
 
   void BuildShapes();
 
   std::unique_ptr<class Engine> engine_;
   std::vector<Node> nodes_;       // in topological (execution) order
+  std::vector<std::vector<int>> levels_;  // dependency wavefronts
   int output_node_ = -1;
   std::vector<int64_t> offsets_;  // per-node output offset in arena
   std::vector<char> arena_;

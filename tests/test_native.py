@@ -237,3 +237,110 @@ def test_native_dag_arena_overlaps_disjoint_lifetimes(tmp_path, native,
     # intervals the head output (written to out) costs nothing and the
     # hidden buffer alone bounds the arena
     assert arena <= 32 * batch * 4 + 4096
+
+
+def test_native_wavefront_wide_graph_batch1(tmp_path, native, cpu_device):
+    """Wavefront scheduling (engine.h RunTasks): four independent
+    branches form one dependency level and run concurrently even at
+    batch=1, where row-sharding alone gives no parallelism.  Repeated
+    runs must be bit-identical (races would show as instability)."""
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.package import export_workflow
+    from veles_tpu.service_units import InputJoiner
+
+    sw = _train_mlp(cpu_device, epochs=1)
+    loader = sw.loader
+
+    branches = []
+    for k in range(4):
+        b = All2AllTanh(sw, output_sample_shape=6 + k,
+                        learning_rate=0.1)
+        b.link_attrs(loader, ("input", "minibatch_data"))
+        b.initialize(device=cpu_device)
+        b.run()
+        branches.append(b)
+
+    joiner = InputJoiner(sw)
+    joiner.link_inputs(*[(b, "output") for b in branches])
+    joiner.initialize(device=cpu_device)
+    joiner.run()
+
+    head = All2AllSoftmax(sw, output_sample_shape=4, learning_rate=0.1)
+    head.link_attrs(joiner, ("input", "output"))
+    head.initialize(device=cpu_device)
+    head.run()
+
+    pkg = str(tmp_path / "wide.tar")
+    export_workflow(sw, pkg, units=branches + [joiner, head])
+
+    loader.minibatch_data.map_read()
+    x1 = numpy.ascontiguousarray(
+        loader.minibatch_data.mem[:1], numpy.float32)
+    head.output.map_read()
+    expected = numpy.asarray(head.output.mem[:1], numpy.float32)
+
+    wf = native.NativeWorkflow(pkg)
+    assert wf.unit_count == 6
+    first = wf.run(x1).reshape(expected.shape)
+    numpy.testing.assert_allclose(first, expected, rtol=1e-5, atol=1e-6)
+    for _ in range(20):
+        again = wf.run(x1).reshape(expected.shape)
+        numpy.testing.assert_array_equal(again, first)
+
+
+def test_native_arena_safe_under_wavefront_order(tmp_path, native,
+                                                 cpu_device):
+    """Adversarial package order A, C, B, join: topo order interleaves
+    the wavefronts (A and B share level 0 but sit at topo positions 0
+    and 2), so a topo-index lifetime would let the planner alias B's
+    buffer over A's while both run concurrently.  Lifetimes are in
+    LEVEL steps precisely so this stays correct."""
+    from veles_tpu.models.all2all import All2AllTanh
+    from veles_tpu.package import export_workflow
+    from veles_tpu.service_units import InputJoiner
+
+    sw = _train_mlp(cpu_device, epochs=1)
+    loader = sw.loader
+
+    def branch(width):
+        b = All2AllTanh(sw, output_sample_shape=width, learning_rate=0.1)
+        b.link_attrs(loader, ("input", "minibatch_data"))
+        b.initialize(device=cpu_device)
+        b.run()
+        return b
+
+    a = branch(8)
+    b = branch(8)  # same size as A: aliasing would be attractive
+    c = All2AllTanh(sw, output_sample_shape=8, learning_rate=0.1)
+    c.link_attrs(a, ("input", "output"))
+    c.initialize(device=cpu_device)
+    c.run()
+    join = InputJoiner(sw)
+    join.link_inputs((c, "output"), (b, "output"))
+    join.initialize(device=cpu_device)
+    join.run()
+
+    pkg = str(tmp_path / "adversarial.tar")
+    export_workflow(sw, pkg, units=[a, c, b, join])
+
+    loader.minibatch_data.map_read()
+    x = numpy.ascontiguousarray(
+        loader.minibatch_data.mem, numpy.float32)
+    join.output.map_read()
+    expected = numpy.asarray(join.output.mem, numpy.float32)
+
+    wf = native.NativeWorkflow(pkg)
+    for _ in range(10):  # repeated: an aliasing race would flake
+        got = wf.run(x).reshape(expected.shape)
+        numpy.testing.assert_allclose(got, expected,
+                                      rtol=1e-5, atol=1e-6)
+
+
+def test_native_empty_batch(tmp_path, native, cpu_device):
+    """batch=0 returns an empty result instead of crashing."""
+    sw = _train_mlp(cpu_device, epochs=1)
+    pkg = str(tmp_path / "empty.tar")
+    sw.package_export(pkg)
+    wf = native.NativeWorkflow(pkg)
+    out = wf.run(numpy.empty((0, wf.input_size), numpy.float32))
+    assert out.size == 0

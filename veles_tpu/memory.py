@@ -104,6 +104,18 @@ class Array(Pickleable):
         self.unmap()
         return self._devmem_
 
+    def device_array(self, device):
+        """devmem, first attaching ``device`` when the Array is still
+        host-only.  Streaming loaders (zmq/restful/interactive feeds)
+        hand consumers unattached host Arrays; consumer units pass
+        their own device here instead of crashing on a None devmem."""
+        with self._lock_:
+            if self._device_ is None and device is not None \
+                    and device.exists and self._mem is not None:
+                self._device_ = device
+                self._state_ = _HOST_DIRTY
+        return self.devmem
+
     def __bool__(self):
         return self._mem is not None and self._mem.size > 0
 

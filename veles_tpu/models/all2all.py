@@ -147,7 +147,8 @@ class All2AllSoftmax(All2All):
                 import jax.numpy as jnp
                 return y, jnp.argmax(y, axis=-1).astype(jnp.int32)
             self._jit_fn_ = jax.jit(fwd)
-        out, max_idx = self._jit_fn_(self.params_dict(), self.input.devmem)
+        out, max_idx = self._jit_fn_(
+                self.params_dict(), self.input.device_array(self.device))
         self.output.set_device_array(out, self.device)
         self.max_idx.set_device_array(max_idx, self.device)
 

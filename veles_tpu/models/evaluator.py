@@ -88,7 +88,8 @@ class EvaluatorSoftmax(EvaluatorBase):
                 self._jit_fn_ = jax.jit(functools.partial(
                     EvaluatorSoftmax.compute, n_classes=n_classes))
             err, n_err, confusion = self._jit_fn_(
-                self.output.devmem, self.labels.devmem,
+                self.output.device_array(self.device),
+                self.labels.device_array(self.device),
                 numpy.float32(self.batch_size))
             self.err_output.set_device_array(err, self.device)
             self.n_err = int(n_err)
@@ -138,7 +139,8 @@ class EvaluatorMSE(EvaluatorBase):
             if self._jit_fn_ is None:
                 self._jit_fn_ = jax.jit(EvaluatorMSE.compute)
             err, mse_sum = self._jit_fn_(
-                self.output.devmem, self.target.devmem,
+                self.output.device_array(self.device),
+                self.target.device_array(self.device),
                 numpy.float32(self.batch_size),
                 self.output.shape[0])
             self.err_output.set_device_array(err, self.device)

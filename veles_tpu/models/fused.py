@@ -77,11 +77,11 @@ class FusedTrainer(Unit):
         if self._step_fn is None:
             self._compile()
         loader = self.sw.loader
-        x = loader.minibatch_data.devmem
+        x = loader.minibatch_data.device_array(self.device)
         if self.loss == "softmax":
-            target = loader.minibatch_labels.devmem
+            target = loader.minibatch_labels.device_array(self.device)
         else:
-            target = loader.minibatch_targets.devmem
+            target = loader.minibatch_targets.device_array(self.device)
         batch_size = numpy.float32(loader.minibatch_size)
 
         if loader.minibatch_class == TRAIN:

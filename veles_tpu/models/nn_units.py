@@ -136,7 +136,8 @@ class ForwardBase(Unit):
         if self._jit_fn_ is None:
             self._jit_fn_ = jax.jit(functools.partial(
                 type(self).apply, **self.static_config()))
-        out = self._jit_fn_(self.params_dict(), self.input.devmem)
+        out = self._jit_fn_(self.params_dict(),
+                            self.input.device_array(self.device))
         self.output.set_device_array(out, self.device)
         if root.common.get("sync_run", False):
             # honest per-unit timings (reference --sync-run,

@@ -5,7 +5,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["interpret_mode", "pad_to", "unpad", "kernel_cast",
+__all__ = ["interpret_mode", "interpret_for", "pad_to", "unpad", "kernel_cast",
            "ceil_mult"]
 
 
@@ -24,6 +24,22 @@ def interpret_mode():
     """True when running on a backend without Mosaic (CPU tests): Pallas
     kernels then execute in interpreter mode, same numerics."""
     return jax.default_backend() == "cpu"
+
+
+def interpret_for(*arrays):
+    """Per-call interpret decision: Pallas needs the interpreter whenever
+    the operand actually lives on CPU, whatever the process default
+    backend is (a TPU host can still run CPU-device workflows).  Tracers
+    carry no placement — fall back to the default-backend rule."""
+    for x in arrays:
+        devices = getattr(x, "devices", None)
+        if devices is None:
+            continue
+        try:
+            return any(d.platform == "cpu" for d in devices())
+        except Exception:
+            continue
+    return interpret_mode()
 
 
 def ceil_mult(value, mult):

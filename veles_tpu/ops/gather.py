@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from veles_tpu.ops.common import interpret_mode, kernel_cast
+from veles_tpu.ops.common import interpret_for, kernel_cast
 
 __all__ = ["gather_minibatch", "gather_labels"]
 
@@ -60,7 +60,7 @@ def gather_minibatch(dataset, indices, out_dtype=None):
         _gather_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((batch, 1, wp), out_dtype),
-        interpret=interpret_mode(),
+        interpret=interpret_for(flat),
     )(indices.astype(jnp.int32), flat)
     return out[:, 0, :width].reshape((batch,) + sample_shape)
 

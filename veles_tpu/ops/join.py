@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from veles_tpu.ops.common import interpret_mode, kernel_cast
+from veles_tpu.ops.common import interpret_for, kernel_cast
 
 __all__ = ["join"]
 
@@ -48,6 +48,6 @@ def join(*arrays, out_dtype=None):
     out = pl.pallas_call(
         _make_join_kernel(widths),
         out_shape=jax.ShapeDtypeStruct((batch, total), out_dtype),
-        interpret=interpret_mode(),
+        interpret=interpret_for(*flats),
     )(*flats)
     return out

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from veles_tpu.ops.common import (ceil_mult, interpret_mode,
+from veles_tpu.ops.common import (ceil_mult, interpret_for,
                                    pad_to, unpad)
 
 __all__ = ["matmul", "matmul_benchmark", "autotune_matmul"]
@@ -132,7 +132,7 @@ def matmul(a, b, precision_level=0, blocks=None, out_dtype=None):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret_mode(),
+        interpret=interpret_for(a, b),
     )(a, b)
     return unpad(out, (m, n))
 

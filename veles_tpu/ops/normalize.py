@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from veles_tpu.ops.common import interpret_mode, kernel_cast, pad_to
+from veles_tpu.ops.common import interpret_for, kernel_cast, pad_to
 
 __all__ = ["mean_disp_normalize"]
 
@@ -46,6 +46,6 @@ def mean_disp_normalize(x, mean, rdisp, out_dtype=jnp.float32, block=256):
         ],
         out_specs=pl.BlockSpec((bm, wp), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((mp, wp), out_dtype),
-        interpret=interpret_mode(),
+        interpret=interpret_for(flat),
     )(flat, mean, rdisp)
     return out[:batch, :width].reshape((batch,) + sample_shape)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from veles_tpu.ops.common import ceil_mult, interpret_mode, pad_to
+from veles_tpu.ops.common import ceil_mult, interpret_for, pad_to
 
 __all__ = ["reduce_rows", "reduce_cols"]
 
@@ -52,7 +52,7 @@ def reduce_cols(x, block=512):
         scratch_shapes=[pltpu.VMEM((1, np_), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
-        interpret=interpret_mode(),
+        interpret=interpret_for(x),
     )(x)
     return out[:, :n]
 
@@ -90,7 +90,7 @@ def reduce_rows(x, block=512):
         scratch_shapes=[pltpu.VMEM((mp, 1), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
-        interpret=interpret_mode(),
+        interpret=interpret_for(x),
     )(x)
     return out[:m]
 
