@@ -21,6 +21,7 @@ Prints ONE JSON line: the required {metric, value, unit, vs_baseline}
 headline plus an "extras" dict carrying the BASELINE metrics.
 """
 
+import functools
 import json
 import os
 import time
@@ -155,9 +156,20 @@ def _train_step_images_per_sec(specs, input_shape, batch, dataset_size,
     state = jax.tree.map(
         lambda leaf: None if leaf is None else jnp.asarray(leaf, dtype),
         state, is_leaf=lambda x: x is None)
+    # device-side duplicate (leaf + 0 forces a fresh buffer): chains
+    # re-seed from this without a host->device upload, which over a
+    # tunneled chip costs more than the whole measured chain
+    dup = jax.jit(lambda s: jax.tree.map(
+        lambda leaf: None if leaf is None else leaf + 0,
+        s, is_leaf=lambda x: x is None))
     step = build_train_step(plans, donate=False)
     key = jax.random.PRNGKey(0) if has_dropout else None
 
+    # ONE dispatch per step: gather + train step fuse into a single XLA
+    # program, and donating the state pytree lets XLA update the (for
+    # AlexNet, hundreds of MB of) parameters in place instead of
+    # double-buffering them
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def one(state, offset):
         idx = jax.lax.dynamic_slice(order, (offset,), (batch,))
         x = gather_minibatch(dataset, idx)
@@ -168,14 +180,16 @@ def _train_step_images_per_sec(specs, input_shape, batch, dataset_size,
         return step(state, x, y, numpy.float32(batch))
 
     # warm both gather and step compilations
-    state2, metrics = one(state, 0)
+    state2, metrics = one(dup(state), 0)
     float(metrics["loss"])
 
     steps_per_epoch = dataset_size // batch
 
     def chain(k):
+        # fresh state copy: the previous chain's buffers were donated
+        s = dup(state)
+        jax.block_until_ready(jax.tree.leaves(s))
         start = time.perf_counter()
-        s = state
         m = None
         for i in range(k):
             s, m = one(s, (i % steps_per_epoch) * batch)

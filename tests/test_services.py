@@ -230,3 +230,56 @@ def test_gather_results(tmp_path):
     path = str(tmp_path / "r.json")
     sw.write_results(path)
     assert "Errors" in _json.load(open(path))
+
+
+def test_web_status_history_events_and_sqlite(tmp_path, trained):
+    """Deepened web status (reference web_status.py:113 Mongo roles):
+    per-session status history + event log, sqlite persistence that
+    survives a server restart, dashboard detail page with sparkline."""
+    from veles_tpu.web_status import StatusReporter, WebStatusServer
+    db = str(tmp_path / "status.sqlite")
+    server = WebStatusServer(db_path=db)
+    server.start_background()
+    try:
+        base = "http://127.0.0.1:%d" % server.port
+        reporter = StatusReporter(base, "sess-h", trained)
+        for _ in range(3):
+            assert reporter.post()["result"] == "ok"
+        assert reporter.post_event("epoch 1 done")["result"] == "ok"
+        with urllib.request.urlopen(base + "/session/sess-h.json") as r:
+            history = json.loads(r.read())
+        assert len(history) == 3
+        with urllib.request.urlopen(base + "/events/sess-h.json") as r:
+            events = json.loads(r.read())
+        assert events and events[0][1] == "epoch 1 done"
+        with urllib.request.urlopen(base + "/session/sess-h") as r:
+            page = r.read().decode()
+        assert "epoch 1 done" in page
+    finally:
+        server.stop()
+
+    # restart on the same sqlite file: sessions + events come back
+    server2 = WebStatusServer(db_path=db)
+    server2.start_background()
+    try:
+        base = "http://127.0.0.1:%d" % server2.port
+        with urllib.request.urlopen(base + "/status.json") as r:
+            sessions = json.loads(r.read())
+        assert [s["id"] for s in sessions] == ["sess-h"]
+        with urllib.request.urlopen(base + "/session/sess-h.json") as r:
+            assert len(json.loads(r.read())) == 3
+        with urllib.request.urlopen(base + "/events/sess-h.json") as r:
+            assert json.loads(r.read())[0][1] == "epoch 1 done"
+    finally:
+        server2.stop()
+
+
+def test_web_status_sparkline_rendering():
+    from veles_tpu.web_status import _metric_history, _sparkline
+    history = [{"metrics": {"err_pct": v}} for v in (9.0, 5.0, 3.0, 2.5)]
+    points = _metric_history(history)
+    assert points == [9.0, 5.0, 3.0, 2.5]
+    svg = _sparkline(points)
+    assert svg.startswith("<svg") and "polyline" in svg
+    assert "2.5" in svg  # last-value direct label
+    assert _sparkline([1.0]) == ""  # too short: no chart

@@ -184,7 +184,10 @@ def matmul_benchmark(size=3001, dtype=jnp.float32, precision_level=0,
 
     slopes = sorted(
         (chain(repeats + 1) - chain(1)) / repeats for _ in range(samples))
-    return max(slopes[samples // 2], 1e-9)
+    mid = samples // 2
+    median = (slopes[mid] if samples % 2
+              else (slopes[mid - 1] + slopes[mid]) / 2.0)
+    return max(median, 1e-9)
 
 
 def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
@@ -206,8 +209,18 @@ def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
     candidates = [(256, 256, 256), (512, 512, 512), (512, 512, 1024),
                   (512, 512, 2048), (256, 256, 1024), (512, 1024, 512),
                   (1024, 512, 512), (256, 512, 1024)]
+    # at small sizes several tiles clamp to the same effective blocks
+    # inside the kernel — benchmark each distinct clamped shape once
+    seen, distinct = set(), []
+    for bm, bn, bk in candidates:
+        clamped = (min(bm, ceil_mult(size, 8)),
+                   min(bn, ceil_mult(size, 128)),
+                   min(bk, ceil_mult(size, 128)))
+        if clamped not in seen:
+            seen.add(clamped)
+            distinct.append((bm, bn, bk))
     best, best_time = None, float("inf")
-    for blocks in candidates:
+    for blocks in distinct:
         try:
             elapsed = matmul_benchmark(
                 size=size, dtype=dtype, precision_level=precision_level,

@@ -2,13 +2,18 @@
 dashboard.
 
 Reference veles/web_status.py:113 (tornado + MongoDB): masters POST
-periodic JSON status (launcher.py:852-885); the dashboard lists every
-known session.  MongoDB is absent from this image, so retention is an
-in-memory ring with optional JSONL persistence — the HTTP surface
-(POST /update, GET /status.json, GET /) is equivalent.
+periodic JSON status (launcher.py:852-885) and structured log events;
+the dashboard lists every known session with per-session history pages.
+MongoDB is absent from this image, so persistence is sqlite (the same
+stand-in the snapshot DB sink uses) — the HTTP surface covers the
+reference roles: POST /update, POST /event, GET /status.json,
+GET /session/<id>.json (status history), GET /events/<id>.json,
+GET / (dashboard) and GET /session/<id> (detail page with metric
+history).
 """
 
 import json
+import sqlite3
 import threading
 import time
 from collections import OrderedDict
@@ -17,21 +22,228 @@ from veles_tpu.logger import Logger
 
 __all__ = ["WebStatusServer", "StatusReporter"]
 
-_PAGE = """<!DOCTYPE html>
-<html><head><title>veles-tpu status</title></head>
-<body><h1>veles-tpu sessions</h1><table border=1 cellpadding=4>
-<tr><th>id</th><th>workflow</th><th>mode</th><th>epoch</th>
-<th>metrics</th><th>slaves</th><th>updated</th></tr>
-%s</table></body></html>"""
+# Single-series sparklines: one categorical hue, text in text tokens,
+# light/dark from the same ramp (no legend needed for one series).
+_STYLE = """
+:root {
+  color-scheme: light;
+  --surface-1: #fcfcfb; --text-primary: #0b0b0b;
+  --text-secondary: #52514e; --grid: #e4e3df; --series-1: #2a78d6;
+}
+@media (prefers-color-scheme: dark) {
+  :root { color-scheme: dark;
+    --surface-1: #1a1a19; --text-primary: #ffffff;
+    --text-secondary: #c3c2b7; --grid: #3a3936; --series-1: #3987e5; }
+}
+body { background: var(--surface-1); color: var(--text-primary);
+       font: 14px system-ui, sans-serif; margin: 24px; }
+h1 { font-size: 18px; } a { color: var(--series-1); }
+table { border-collapse: collapse; }
+th, td { border: 1px solid var(--grid); padding: 4px 10px;
+         text-align: left; }
+th { color: var(--text-secondary); font-weight: 600; }
+.num { font-variant-numeric: tabular-nums; }
+svg.spark polyline { fill: none; stroke: var(--series-1);
+                     stroke-width: 2; }
+svg.spark text { fill: var(--text-secondary); font-size: 10px; }
+"""
+
+_INDEX = """<!DOCTYPE html>
+<html><head><title>veles-tpu status</title><style>%s</style></head>
+<body><h1>veles-tpu sessions</h1>
+<div id="tbl">%s</div>
+<script>
+setInterval(function () {
+  fetch("/table").then(function (r) { return r.text(); })
+    .then(function (t) { document.getElementById("tbl").innerHTML = t; });
+}, 5000);
+</script></body></html>
+"""
+
+_DETAIL = """<!DOCTYPE html>
+<html><head><title>%(sid)s — veles-tpu</title><style>%(style)s</style>
+</head><body><h1>session %(sid)s</h1>
+<p><a href="/">&larr; all sessions</a></p>
+%(spark)s
+<table><tr><th>time</th><th>epoch</th><th>metrics</th><th>slaves</th>
+</tr>%(rows)s</table>
+<h1>events</h1>
+<table><tr><th>time</th><th>event</th></tr>%(events)s</table>
+</body></html>
+"""
+
+
+def _metric_history(history):
+    """Extract a numeric series for ONE metric key — the first numeric
+    key of the earliest post, tracked by name thereafter so a metrics
+    dict that gains keys mid-run can't splice two different series."""
+    def numeric(value):
+        # bool is an int subclass; a {"converged": false} key must not
+        # hijack the series
+        return isinstance(value, (int, float)) \
+            and not isinstance(value, bool)
+
+    key = None
+    for post in history:
+        metrics = post.get("metrics")
+        if isinstance(metrics, dict):
+            for k, value in metrics.items():
+                if numeric(value):
+                    key = k
+                    break
+        if key is not None:
+            break
+    if key is None:
+        return []
+    points = []
+    for post in history:
+        metrics = post.get("metrics")
+        if isinstance(metrics, dict) and numeric(metrics.get(key)):
+            points.append(float(metrics[key]))
+    return points
+
+
+def _sparkline(points, width=220, height=48, label=True):
+    """Inline-SVG sparkline: 2px line, last-value direct label, hover
+    title with the range (single series — no legend)."""
+    if len(points) < 2:
+        return ""
+    lo, hi = min(points), max(points)
+    span = (hi - lo) or 1.0
+    pad = 4
+    w, h = width - 2 * pad - (46 if label else 0), height - 2 * pad
+    coords = " ".join(
+        "%.1f,%.1f" % (pad + w * i / (len(points) - 1),
+                       pad + h * (1.0 - (p - lo) / span))
+        for i, p in enumerate(points))
+    tail = ("<text x='%d' y='%d'>%.4g</text>"
+            % (width - 44, height // 2 + 4, points[-1]) if label else "")
+    return ("<svg class='spark' width='%d' height='%d' role='img'>"
+            "<title>%d points, min %.4g, max %.4g</title>"
+            "<polyline points='%s'/>%s</svg>"
+            % (width, height, len(points), lo, hi, coords, tail))
+
+
+class _Store(object):
+    """Session status + event retention: in-memory ring backed by an
+    optional sqlite file (reference kept these in MongoDB)."""
+
+    def __init__(self, db_path=None, max_sessions=100, max_history=500):
+        self.sessions = OrderedDict()   # sid -> latest post, LRU order
+        self.history = {}               # sid -> [posts]
+        self.events = {}                # sid -> [(ts, text)]
+        self.max_sessions = max_sessions
+        self.max_history = max_history
+        self._lock = threading.Lock()
+        self._conn = None
+        if db_path:
+            self._conn = sqlite3.connect(
+                db_path, check_same_thread=False)
+            db = self._conn
+            with db:
+                db.execute("CREATE TABLE IF NOT EXISTS status ("
+                           "sid TEXT, ts REAL, body TEXT)")
+                db.execute("CREATE TABLE IF NOT EXISTS events ("
+                           "sid TEXT, ts REAL, body TEXT)")
+            # reload the most recently active sessions only, in recency
+            # order so the LRU ring evicts the genuinely oldest first,
+            # bounded per session by max_history
+            recent = list(db.execute(
+                "SELECT sid, MAX(ts) m FROM status GROUP BY sid "
+                "ORDER BY m DESC LIMIT ?", (max_sessions,)))
+            for sid, _ in reversed(recent):
+                posts = [json.loads(body) for (body,) in db.execute(
+                    "SELECT body FROM status WHERE sid = ? "
+                    "ORDER BY ts DESC LIMIT ?", (sid, max_history))]
+                posts.reverse()
+                self.history[sid] = posts
+                self.sessions[sid] = posts[-1]
+                self.events[sid] = self._load_events(db, sid)
+            # sessions that only posted events so far (a reporter may
+            # post_event before its first status) keep their events too
+            for (sid,) in db.execute(
+                    "SELECT DISTINCT sid FROM events"):
+                if sid not in self.events:
+                    self.events[sid] = self._load_events(db, sid)
+
+    def _load_events(self, db, sid):
+        return [
+            (time.strftime("%H:%M:%S", time.localtime(ts)), body)
+            for ts, body in reversed(list(db.execute(
+                "SELECT ts, body FROM events WHERE sid = ? "
+                "ORDER BY ts DESC LIMIT ?", (sid, self.max_history))))]
+
+    def list_sessions(self):
+        with self._lock:
+            return list(self.sessions.values())
+
+    def get_history(self, sid):
+        with self._lock:
+            return list(self.history.get(sid, []))
+
+    def get_events(self, sid):
+        with self._lock:
+            return list(self.events.get(sid, []))
+
+    def close(self):
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+    def _prune(self, db, table, sid):
+        db.execute(
+            "DELETE FROM %s WHERE sid = ? AND ts NOT IN (SELECT ts "
+            "FROM %s WHERE sid = ? ORDER BY ts DESC LIMIT ?)"
+            % (table, table), (sid, sid, self.max_history))
+
+    def record(self, data):
+        data = dict(data)
+        data["updated"] = time.strftime("%H:%M:%S")
+        sid = str(data.get("id", "?"))
+        with self._lock:
+            self.sessions[sid] = data
+            self.sessions.move_to_end(sid)
+            hist = self.history.setdefault(sid, [])
+            hist.append(data)
+            del hist[:-self.max_history]
+            while len(self.sessions) > self.max_sessions:
+                old, _ = self.sessions.popitem(last=False)
+                self.history.pop(old, None)
+                self.events.pop(old, None)
+                if self._conn is not None:
+                    with self._conn as db:
+                        db.execute("DELETE FROM status WHERE sid = ?",
+                                   (old,))
+                        db.execute("DELETE FROM events WHERE sid = ?",
+                                   (old,))
+            if self._conn is not None:
+                with self._conn as db:
+                    db.execute("INSERT INTO status VALUES (?, ?, ?)",
+                               (sid, time.time(), json.dumps(data)))
+                    self._prune(db, "status", sid)
+
+    def record_event(self, sid, text):
+        sid = str(sid)
+        with self._lock:
+            events = self.events.setdefault(sid, [])
+            events.append((time.strftime("%H:%M:%S"), text))
+            del events[:-self.max_history]
+            if self._conn is not None:
+                with self._conn as db:
+                    db.execute("INSERT INTO events VALUES (?, ?, ?)",
+                               (sid, time.time(), text))
+                    self._prune(db, "events", sid)
 
 
 class WebStatusServer(Logger):
-    def __init__(self, port=0, persist_path=None, max_sessions=100):
+    def __init__(self, port=0, persist_path=None, max_sessions=100,
+                 db_path=None):
         super(WebStatusServer, self).__init__()
         import tornado.web
 
-        self.sessions = OrderedDict()
-        self.max_sessions = max_sessions
+        # persist_path kept for backward compatibility: JSONL append
+        self.store = _Store(db_path=db_path, max_sessions=max_sessions)
         self.persist_path = persist_path
         server_self = self
 
@@ -41,49 +253,120 @@ class WebStatusServer(Logger):
                 server_self.record(data)
                 self.write({"result": "ok"})
 
+        class EventHandler(tornado.web.RequestHandler):
+            def post(self):
+                data = json.loads(self.request.body or b"{}")
+                server_self.store.record_event(
+                    data.get("id", "?"), str(data.get("event", "")))
+                self.write({"result": "ok"})
+
         class StatusHandler(tornado.web.RequestHandler):
             def get(self):
                 self.set_header("Content-Type", "application/json")
-                self.write(json.dumps(list(
-                    server_self.sessions.values())))
+                self.write(json.dumps(
+                    server_self.store.list_sessions()))
+
+        class HistoryHandler(tornado.web.RequestHandler):
+            def get(self, sid):
+                self.set_header("Content-Type", "application/json")
+                self.write(json.dumps(
+                    server_self.store.get_history(sid)))
+
+        class EventsHandler(tornado.web.RequestHandler):
+            def get(self, sid):
+                self.set_header("Content-Type", "application/json")
+                self.write(json.dumps(
+                    server_self.store.get_events(sid)))
+
+        class TableHandler(tornado.web.RequestHandler):
+            def get(self):
+                self.write(server_self._table_html())
 
         class PageHandler(tornado.web.RequestHandler):
             def get(self):
-                rows = []
-                for s in server_self.sessions.values():
-                    rows.append(
-                        "<tr>" + "".join(
-                            "<td>%s</td>" % s.get(k, "")
-                            for k in ("id", "workflow", "mode", "epoch",
-                                      "metrics", "slaves", "updated")) +
-                        "</tr>")
-                self.write(_PAGE % "\n".join(rows))
+                self.write(_INDEX % (_STYLE, server_self._table_html()))
+
+        class DetailHandler(tornado.web.RequestHandler):
+            def get(self, sid):
+                import html
+                store = server_self.store
+                history = store.get_history(sid)
+                if not history:
+                    raise tornado.web.HTTPError(404)
+                rows = "".join(
+                    "<tr><td>%s</td><td class='num'>%s</td>"
+                    "<td>%s</td><td class='num'>%s</td></tr>"
+                    % tuple(html.escape(str(v)) for v in (
+                        p.get("updated", ""), p.get("epoch", ""),
+                        json.dumps(p.get("metrics")),
+                        p.get("slaves", "")))
+                    for p in history[-100:])
+                events = "".join(
+                    "<tr><td>%s</td><td>%s</td></tr>"
+                    % (html.escape(str(ts)), html.escape(str(text)))
+                    for ts, text in store.get_events(sid)[-100:])
+                self.write(_DETAIL % {
+                    "sid": tornado.escape.xhtml_escape(sid),
+                    "style": _STYLE,
+                    "spark": _sparkline(
+                        _metric_history(history), width=420, height=64),
+                    "rows": rows, "events": events})
 
         self.app = tornado.web.Application([
             (r"/update", UpdateHandler),
+            (r"/event", EventHandler),
             (r"/status.json", StatusHandler),
+            (r"/session/([^/]+)\.json", HistoryHandler),
+            (r"/events/([^/]+)\.json", EventsHandler),
+            (r"/session/([^/]+)", DetailHandler),
+            (r"/table", TableHandler),
             (r"/", PageHandler),
         ])
         self.port = port
         self._loop = None
         self._thread = None
 
+    @property
+    def sessions(self):
+        return self.store.sessions
+
+    def _table_html(self):
+        import html
+        from urllib.parse import quote
+        rows = []
+        for s in self.store.list_sessions():
+            sid = str(s.get("id", "?"))
+            spark = _sparkline(
+                _metric_history(self.store.get_history(sid)),
+                label=False)
+            cells = "".join(
+                "<td>%s</td>" % html.escape(
+                    json.dumps(s.get(k)) if k == "metrics"
+                    else str(s.get(k, "")))
+                for k in ("workflow", "mode", "epoch", "metrics",
+                          "slaves", "updated"))
+            rows.append(
+                "<tr><td><a href='/session/%s'>%s</a></td>%s<td>%s</td>"
+                "</tr>" % (quote(sid, safe=""),
+                           html.escape(sid), cells, spark))
+        return ("<table><tr><th>id</th><th>workflow</th><th>mode</th>"
+                "<th>epoch</th><th>metrics</th><th>slaves</th>"
+                "<th>updated</th><th>trend</th></tr>%s</table>"
+                % "\n".join(rows))
+
     def record(self, data):
-        data = dict(data)
-        data["updated"] = time.strftime("%H:%M:%S")
-        sid = data.get("id", "?")
-        self.sessions[sid] = data
-        self.sessions.move_to_end(sid)
-        while len(self.sessions) > self.max_sessions:
-            self.sessions.popitem(last=False)
+        stamped = dict(data)
+        stamped["updated"] = time.strftime("%H:%M:%S")
+        self.store.record(stamped)
         if self.persist_path:
             with open(self.persist_path, "a") as fout:
-                fout.write(json.dumps(data) + "\n")
+                fout.write(json.dumps(stamped) + "\n")
 
     def start_background(self):
         import asyncio
 
         import tornado.httpserver
+        import tornado.netutil
 
         started = threading.Event()
 
@@ -99,7 +382,6 @@ class WebStatusServer(Logger):
             started.set()
             loop.run_forever()
 
-        import tornado.netutil
         self._thread = threading.Thread(target=serve, daemon=True)
         self._thread.start()
         started.wait(5)
@@ -109,6 +391,10 @@ class WebStatusServer(Logger):
     def stop(self):
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            # let in-flight handlers drain before closing the DB
+            self._thread.join(timeout=5)
+        self.store.close()
 
 
 class StatusReporter(object):
@@ -133,11 +419,19 @@ class StatusReporter(object):
                 getattr(launcher, "_agent", None), "slaves", {}) or {}),
         }
 
-    def post(self):
+    def _post_json(self, path, payload):
         import urllib.request
         req = urllib.request.Request(
-            self.url + "/update",
-            data=json.dumps(self.snapshot()).encode(),
+            self.url + path, data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=5) as resp:
             return json.loads(resp.read())
+
+    def post(self):
+        return self._post_json("/update", self.snapshot())
+
+    def post_event(self, event):
+        """Forward one structured log event (reference streamed these
+        into MongoDB for the dashboard's event browser)."""
+        return self._post_json(
+            "/event", {"id": self.session_id, "event": event})
