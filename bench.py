@@ -168,9 +168,11 @@ def _train_step_images_per_sec(specs, input_shape, batch, dataset_size,
     # ONE dispatch per step: gather + train step fuse into a single XLA
     # program, and donating the state pytree lets XLA update the (for
     # AlexNet, hundreds of MB of) parameters in place instead of
-    # double-buffering them
+    # double-buffering them.  The dataset/labels/order ride as ARGUMENTS
+    # — closing over them would bake hundreds of MB of constants into
+    # the program, which a remote-compile service has to swallow whole.
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def one(state, offset):
+    def one(state, offset, dataset, labels_all, order):
         idx = jax.lax.dynamic_slice(order, (offset,), (batch,))
         x = gather_minibatch(dataset, idx)
         y = gather_labels(labels_all, idx)
@@ -180,8 +182,9 @@ def _train_step_images_per_sec(specs, input_shape, batch, dataset_size,
         return step(state, x, y, numpy.float32(batch))
 
     # warm both gather and step compilations
-    state2, metrics = one(dup(state), 0)
+    state2, metrics = one(dup(state), 0, dataset, labels_all, order)
     float(metrics["loss"])
+    del state2  # frees a full state-sized buffer set before the chains
 
     steps_per_epoch = dataset_size // batch
 
@@ -192,7 +195,8 @@ def _train_step_images_per_sec(specs, input_shape, batch, dataset_size,
         start = time.perf_counter()
         m = None
         for i in range(k):
-            s, m = one(s, (i % steps_per_epoch) * batch)
+            s, m = one(s, (i % steps_per_epoch) * batch,
+                       dataset, labels_all, order)
         float(m["loss"])
         return time.perf_counter() - start
 

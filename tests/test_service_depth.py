@@ -188,3 +188,111 @@ def test_audio_loader_real_wavs(tmp_path, cpu_device):
     assert loader.class_lengths[2] == 12
     assert loader.shape == (1024,)
     assert sorted(loader.labels_mapping) == ["high", "low"]
+
+
+# --------------------------------------------------------- confluence
+
+
+class _FakeConfluence(http.server.BaseHTTPRequestHandler):
+    """Mock of the three Confluence REST endpoints the backend speaks:
+    content search by title, page create/update, attachment upload."""
+
+    pages = {}        # id -> {title, space, body, version}
+    attachments = {}  # id -> [filenames]
+    next_id = [1000]
+    auth = []         # records Authorization headers seen
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, payload, code=200):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        from urllib.parse import parse_qs, urlparse
+        self.auth.append(self.headers.get("Authorization"))
+        q = parse_qs(urlparse(self.path).query)
+        title = q.get("title", [""])[0]
+        hits = [
+            {"id": pid, "title": p["title"],
+             "version": {"number": p["version"]}}
+            for pid, p in self.pages.items() if p["title"] == title]
+        self._json({"results": hits})
+
+    def do_POST(self):
+        self.auth.append(self.headers.get("Authorization"))
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length)
+        if self.path.endswith("/child/attachment"):
+            pid = self.path.split("/")[-3]
+            fname = raw.split(b'filename="', 1)[1].split(b'"', 1)[0]
+            self.attachments.setdefault(pid, []).append(fname.decode())
+            self._json({"results": [{"title": fname.decode()}]})
+            return
+        payload = json.loads(raw)
+        pid = str(self.next_id[0])
+        self.next_id[0] += 1
+        self.pages[pid] = {
+            "title": payload["title"],
+            "space": payload["space"]["key"],
+            "body": payload["body"]["storage"]["value"],
+            "version": 1}
+        self._json({"id": pid})
+
+    def do_PUT(self):
+        self.auth.append(self.headers.get("Authorization"))
+        length = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(length))
+        pid = self.path.rsplit("/", 1)[1]
+        self.pages[pid].update(
+            body=payload["body"]["storage"]["value"],
+            version=payload["version"]["number"])
+        self._json({"id": pid})
+
+
+def test_confluence_publishing_backend(tmp_path, cpu_device):
+    from tests.test_native import _train_mlp
+    from veles_tpu.publishing import ConfluenceBackend, Publisher
+
+    _FakeConfluence.pages.clear()
+    _FakeConfluence.attachments.clear()
+    server = http.server.ThreadingHTTPServer(
+        ("127.0.0.1", 0), _FakeConfluence)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = "http://127.0.0.1:%d" % server.server_address[1]
+    try:
+        sw = _train_mlp(cpu_device, epochs=1)
+        backend = ConfluenceBackend(base, space="ML", token="sekret")
+        publisher = Publisher(sw, backends=[backend])
+        publisher.run()
+        assert backend.url.startswith(base + "/pages/")
+        pages = list(_FakeConfluence.pages.values())
+        assert len(pages) == 1
+        page = pages[0]
+        assert page["space"] == "ML"
+        assert "<h2>Metrics</h2>" in page["body"]
+        assert "Unit run times" in page["body"]
+        pid = next(iter(_FakeConfluence.pages))
+        assert "workflow.dot" in _FakeConfluence.attachments[pid]
+        assert all(a == "Bearer sekret" for a in _FakeConfluence.auth)
+
+        # same name again: title de-duplicates like the reference
+        backend2 = ConfluenceBackend(base, space="ML", token="sekret")
+        Publisher(sw, backends=[backend2]).run()
+        titles = sorted(p["title"]
+                        for p in _FakeConfluence.pages.values())
+        assert titles[1].endswith("(1)")
+
+        # explicit page: updates in place with a version bump
+        backend3 = ConfluenceBackend(base, space="ML", token="sekret",
+                                     page=page["title"])
+        Publisher(sw, backends=[backend3]).run()
+        assert _FakeConfluence.pages[pid]["version"] == 2
+    finally:
+        server.shutdown()

@@ -283,3 +283,9 @@ def test_web_status_sparkline_rendering():
     assert svg.startswith("<svg") and "polyline" in svg
     assert "2.5" in svg  # last-value direct label
     assert _sparkline([1.0]) == ""  # too short: no chart
+    # list-shaped metrics (StatusReporter ships epoch_metrics as
+    # [test, validation, train]) key by index; bools never hijack
+    lists = [{"metrics": [None, v, v + 1]} for v in (4.0, 2.0)]
+    assert _metric_history(lists) == [4.0, 2.0]
+    bools = [{"metrics": {"done": False, "err": v}} for v in (3.0, 1.0)]
+    assert _metric_history(bools) == [3.0, 1.0]

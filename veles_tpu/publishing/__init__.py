@@ -3,4 +3,4 @@ publisher gathers workflow info + plots; backends render it)."""
 
 from veles_tpu.publishing.publisher import Publisher  # noqa: F401
 from veles_tpu.publishing.backends import (  # noqa: F401
-    MarkdownBackend, HTMLBackend, PDFBackend)
+    ConfluenceBackend, MarkdownBackend, HTMLBackend, PDFBackend)

@@ -83,23 +83,31 @@ def _metric_history(history):
         return isinstance(value, (int, float)) \
             and not isinstance(value, bool)
 
+    def items(metrics):
+        # dict posts keep their keys; list posts (StatusReporter ships
+        # decision.epoch_metrics = [test, validation, train]) key by
+        # index
+        if isinstance(metrics, dict):
+            return list(metrics.items())
+        if isinstance(metrics, (list, tuple)):
+            return list(enumerate(metrics))
+        return []
+
     key = None
     for post in history:
-        metrics = post.get("metrics")
-        if isinstance(metrics, dict):
-            for k, value in metrics.items():
-                if numeric(value):
-                    key = k
-                    break
+        for k, value in items(post.get("metrics")):
+            if numeric(value):
+                key = k
+                break
         if key is not None:
             break
     if key is None:
         return []
     points = []
     for post in history:
-        metrics = post.get("metrics")
-        if isinstance(metrics, dict) and numeric(metrics.get(key)):
-            points.append(float(metrics[key]))
+        for k, value in items(post.get("metrics")):
+            if k == key and numeric(value):
+                points.append(float(value))
     return points
 
 
@@ -222,10 +230,24 @@ class _Store(object):
                     db.execute("INSERT INTO status VALUES (?, ?, ?)",
                                (sid, time.time(), json.dumps(data)))
                     self._prune(db, "status", sid)
+        return data
 
     def record_event(self, sid, text):
         sid = str(sid)
         with self._lock:
+            if sid not in self.events and \
+                    len(self.events) >= 2 * self.max_sessions:
+                # event-only ids (no status post yet) are bounded too:
+                # evict the first sid outside the session ring
+                for old in list(self.events):
+                    if old not in self.sessions:
+                        del self.events[old]
+                        if self._conn is not None:
+                            with self._conn as db:
+                                db.execute(
+                                    "DELETE FROM events WHERE sid = ?",
+                                    (old,))
+                        break
             events = self.events.setdefault(sid, [])
             events.append((time.strftime("%H:%M:%S"), text))
             del events[:-self.max_history]
@@ -355,9 +377,7 @@ class WebStatusServer(Logger):
                 % "\n".join(rows))
 
     def record(self, data):
-        stamped = dict(data)
-        stamped["updated"] = time.strftime("%H:%M:%S")
-        self.store.record(stamped)
+        stamped = self.store.record(data)
         if self.persist_path:
             with open(self.persist_path, "a") as fout:
                 fout.write(json.dumps(stamped) + "\n")
