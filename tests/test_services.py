@@ -289,3 +289,48 @@ def test_web_status_sparkline_rendering():
     assert _metric_history(lists) == [4.0, 2.0]
     bools = [{"metrics": {"done": False, "err": v}} for v in (3.0, 1.0)]
     assert _metric_history(bools) == [3.0, 1.0]
+
+
+def test_launcher_posts_status_periodically(tmp_path):
+    """Launcher wiring (reference launcher.py:852-885): with
+    web_status set, the session posts periodic status while running
+    and a final post after the run ends."""
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from veles_tpu.web_status import WebStatusServer
+    from tests.test_models import BlobsLoader
+
+    server = WebStatusServer()
+    server.start_background()
+    try:
+        launcher = Launcher(
+            web_status="http://127.0.0.1:%d" % server.port,
+            notification_interval=0.2)
+        sw = StandardWorkflow(
+            launcher,
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "learning_rate": 0.1, "gradient_moment": 0.9},
+                {"type": "softmax", "output_sample_shape": 4,
+                 "learning_rate": 0.1, "gradient_moment": 0.9},
+            ],
+            loader_factory=lambda w: BlobsLoader(
+                w, minibatch_size=64,
+                prng=RandomGenerator("wsl", seed=7)),
+            decision_config=dict(max_epochs=3),
+        )
+        launcher.initialize(device=Device(backend="cpu"))
+        launcher.run()
+        sessions = server.store.list_sessions()
+        assert len(sessions) == 1
+        post = sessions[0]
+        assert post["workflow"] == "StandardWorkflow"
+        assert post["epoch"] == 3  # the final post reflects the end state
+        assert post["mode"] == "standalone"
+        # PERIODIC posting, not just the final flush: a ~seconds run at
+        # a 0.2 s interval must leave more than one history entry
+        assert len(server.store.get_history(post["id"])) > 1
+    finally:
+        server.stop()

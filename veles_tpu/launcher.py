@@ -37,12 +37,21 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "listen_address", cfg.get("listen_address", ""))
         self.matplotlib_backend = kwargs.get("matplotlib_backend", "")
         self.interactive = interactive
+        self.web_status_url = kwargs.get(
+            "web_status", cfg.get("web_status", ""))
+        # the cadence knob lives under root.common.web (config.py
+        # defaults block), the same place the reference kept it
+        self.notification_interval = float(kwargs.get(
+            "notification_interval",
+            root.common.web.get("notification_interval", 1)))
         self._workflow = None
         self.device = None
         self.stopped = False
         self.initialized = False
         self._agent = None  # Server or Client when distributed
         self._finished_event = threading.Event()
+        self._reporter_stop = threading.Event()
+        self._reporter_thread = None
         self.start_time = None
 
     @classmethod
@@ -53,6 +62,10 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         parser.add_argument(
             "-m", "--master-address", default="",
             help="run as slave of the given master host:port")
+        parser.add_argument(
+            "--web-status", default="",
+            help="URL of a WebStatusServer to post periodic session "
+                 "status to (reference launcher.py:852-885)")
         return parser
 
     @classmethod
@@ -61,6 +74,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         root.common.launcher.update({
             "listen_address": getattr(args, "listen_address", ""),
             "master_address": getattr(args, "master_address", ""),
+            "web_status": getattr(args, "web_status", ""),
         })
 
     # -- workflow ownership (Unit.workflow protocol) -----------------------
@@ -143,6 +157,36 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                                  launcher=self)
         self.initialized = True
 
+    def _start_status_reporter(self):
+        """Periodic status posts to the web-status service while the
+        session runs — slaves stay silent, like the reference
+        (launcher.py:852-885 posted from the master/standalone side)."""
+        if not self.web_status_url or self.is_slave:
+            return
+        from veles_tpu.web_status import StatusReporter
+        import uuid
+        reporter = StatusReporter(
+            self.web_status_url,
+            "%s-%s" % (self._workflow.name, uuid.uuid4().hex[:8]),
+            self._workflow)
+        self._reporter_stop.clear()
+
+        def loop():
+            while not self._reporter_stop.wait(
+                    self.notification_interval):
+                try:
+                    reporter.post()
+                except Exception as exc:
+                    self.debug("status post failed: %s", exc)
+            try:
+                reporter.post()  # final state after the run ends
+            except Exception as exc:
+                self.debug("final status post failed: %s", exc)
+
+        self._reporter_thread = threading.Thread(
+            target=loop, daemon=True, name="status-reporter")
+        self._reporter_thread.start()
+
     def run(self):
         if not self.initialized:
             self.initialize()
@@ -151,6 +195,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.stopped = False
         from veles_tpu.thread_pool import ThreadPool
         ThreadPool.sigint_hook = self.stop
+        self._start_status_reporter()
         try:
             if self._agent is not None:
                 self._agent.run()  # blocks until the session ends
@@ -160,6 +205,10 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         finally:
             ThreadPool.sigint_hook = None
             self.stopped = True
+            if self._reporter_thread is not None:
+                self._reporter_stop.set()
+                self._reporter_thread.join(timeout=5)
+                self._reporter_thread = None
         elapsed = time.time() - self.start_time
         self.info("session finished in %.1f s", elapsed)
         self._workflow.print_stats()
