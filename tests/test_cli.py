@@ -211,3 +211,49 @@ def test_per_class_cli_registry():
         root.common.network.update(
             {"job_timeout": 60.0, "async_slave": False})
         root.common.launcher.update({"listen_address": ""})
+
+
+def test_frontend_composer_serves_and_launches(tmp_path):
+    """Web command composer (reference __main__.py:258-332): the form
+    is generated from the registered CLI args, /run launches only
+    ``-m veles_tpu`` commands, /status reports the child."""
+    import json
+    import time
+    import urllib.request
+
+    from veles_tpu.__main__ import Main
+    from veles_tpu.frontend import FrontendServer
+
+    server = FrontendServer(Main().init_parser())
+    server.start_background()
+    base = "http://127.0.0.1:%d" % server.port
+    try:
+        page = urllib.request.urlopen(base + "/").read().decode()
+        assert "--snapshot" in page and "--sync-run" in page
+        assert "--ensemble-train" in page  # registry-aggregated flag
+        token = page.split('TOKEN = "')[1].split('"')[0]
+
+        def post(argv, token=token):
+            req = urllib.request.Request(
+                base + "/run",
+                data=json.dumps({"argv": argv,
+                                 "token": token}).encode())
+            return json.loads(urllib.request.urlopen(req).read())
+
+        # missing token (e.g. a cross-origin POST) is refused
+        assert "error" in post(["-m", "veles_tpu", "--help"], token="x")
+        # non-veles commands are refused
+        refused = post(["-c", "print('pwned')"])
+        assert "error" in refused
+        # a composed dry run executes
+        started = post(["-m", "veles_tpu", "--help"])
+        assert "pid" in started
+        for _ in range(50):
+            status = json.loads(urllib.request.urlopen(
+                base + "/status").read())
+            if not status["running"]:
+                break
+            time.sleep(0.2)
+        assert status["returncode"] == 0
+    finally:
+        server.stop()

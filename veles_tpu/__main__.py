@@ -67,7 +67,52 @@ class Main(object):
         parser.add_argument(
             "--ensemble-dir", default="ensemble",
             help="ensemble output directory")
+        parser.add_argument(
+            "--frontend", nargs="?", const="8080", default=None,
+            metavar="PORT",
+            help="serve the web command composer instead of running "
+                 "(reference __main__.py:258-332)")
+        parser.add_argument(
+            "-b", "--background", action="store_true",
+            help="daemonize: detach and keep running after the "
+                 "terminal closes (log goes to --log-file)")
         return parser
+
+    def _run_frontend(self, parser, port):
+        from veles_tpu.frontend import FrontendServer
+        server = FrontendServer(parser, port=int(port))
+        server.start_background()
+        print("composer on http://127.0.0.1:%d/ (Ctrl-C to stop)"
+              % server.port, flush=True)
+        try:
+            import time
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop()
+        return self.EXIT_SUCCESS
+
+    @staticmethod
+    def _daemonize(log_file):
+        """Classic double fork; stdio re-pointed at the log file
+        (reference vendored python-daemon for -b)."""
+        if os.fork() > 0:
+            os._exit(0)
+        os.setsid()
+        if os.fork() > 0:
+            os._exit(0)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        target = log_file or os.devnull
+        fd = os.open(target, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+        null = os.open(os.devnull, os.O_RDONLY)
+        os.dup2(null, 0)
+        os.dup2(fd, 1)
+        os.dup2(fd, 2)
+        os.close(null)
+        if fd > 2:
+            os.close(fd)
 
     def _seed(self, spec):
         if spec is None:
@@ -148,6 +193,10 @@ class Main(object):
         apply_parsed_args(args)
         if args.sync_run:
             root.common.sync_run = True
+        if args.frontend is not None:
+            return self._run_frontend(parser, args.frontend)
+        if args.background:
+            self._daemonize(args.log_file)
         if not args.workflow:
             parser.print_help()
             return self.EXIT_FAILURE

@@ -16,7 +16,6 @@ the highest.
 import json
 import os
 import re
-import threading
 
 from veles_tpu.logger import Logger
 
@@ -46,8 +45,7 @@ class ForgeServer(Logger):
         self.port = port
         self.upload_token = (upload_token if upload_token is not None
                              else os.environ.get("VELES_FORGE_TOKEN"))
-        self._loop = None
-        self._thread = None
+        self._server_ = None
 
     # -- storage ------------------------------------------------------------
 
@@ -106,10 +104,6 @@ class ForgeServer(Logger):
     # -- HTTP ---------------------------------------------------------------
 
     def start_background(self):
-        import asyncio
-
-        import tornado.httpserver
-        import tornado.netutil
         import tornado.web
 
         forge = self
@@ -182,27 +176,14 @@ class ForgeServer(Logger):
             (r"/fetch", FetchHandler),
             (r"/upload", UploadHandler),
         ])
-        started = threading.Event()
-
-        def serve():
-            loop = asyncio.new_event_loop()
-            asyncio.set_event_loop(loop)
-            self._loop = loop
-            server = tornado.httpserver.HTTPServer(
-                app, max_buffer_size=1 << 30)
-            sockets = tornado.netutil.bind_sockets(
-                self.port, address="127.0.0.1")
-            self.port = sockets[0].getsockname()[1]
-            server.add_sockets(sockets)
-            started.set()
-            loop.run_forever()
-
-        self._thread = threading.Thread(target=serve, daemon=True)
-        self._thread.start()
-        started.wait(5)
+        from veles_tpu.http_util import BackgroundHTTPServer
+        self._server_ = BackgroundHTTPServer(
+            app, port=self.port, max_buffer_size=1 << 30)
+        thread = self._server_.start()
+        self.port = self._server_.port
         self.info("forge on http://127.0.0.1:%d/", self.port)
-        return self._thread
+        return thread
 
     def stop(self):
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._server_ is not None:
+            self._server_.stop()

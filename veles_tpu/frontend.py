@@ -1,0 +1,168 @@
+"""Web frontend command composer (reference veles/__main__.py:258-332:
+a tornado page that builds a ``veles`` command line from every
+registered CLI argument and launches it).
+
+The form is generated straight from the argparse parser that
+:func:`veles_tpu.cmdline.build_parser` aggregates from the per-class
+registry, so any unit/service that contributes a flag shows up here
+automatically.  POST /run executes the composed command as a child
+``python -m veles_tpu`` process; GET /status reports it.
+"""
+
+import html
+import json
+import shlex
+import subprocess
+import sys
+import uuid
+
+from veles_tpu.http_util import BackgroundHTTPServer
+
+__all__ = ["FrontendServer"]
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>veles-tpu composer</title><style>
+body { font: 14px system-ui, sans-serif; margin: 24px; max-width: 760px; }
+label { display: block; margin-top: 8px; }
+input[type=text] { width: 24em; }
+#cmd { background: #f3f3f1; padding: 8px; display: block;
+       margin-top: 16px; word-break: break-all; }
+.help { color: #52514e; font-size: 12px; }
+</style></head><body>
+<h1>compose a veles-tpu run</h1>
+<form id="form">
+<label>workflow file <input type="text" name="workflow" data-pos="1">
+</label>
+<label>config file <input type="text" name="config" data-pos="2"></label>
+%s
+</form>
+<code id="cmd"></code>
+<p><button onclick="run()">run</button> <span id="status"></span></p>
+<script>
+var EXE = %s, TOKEN = %s;
+function compose() {
+  var head = [EXE, "-m", "veles_tpu"];
+  var tail = [];
+  var form = document.getElementById("form");
+  var positional = [];
+  Array.prototype.forEach.call(form.elements, function (el) {
+    if (!el.name) return;
+    if (el.dataset.pos) {
+      if (el.value) positional[+el.dataset.pos - 1] = el.value;
+    } else if (el.type === "checkbox") {
+      if (el.checked) tail.push(el.name);
+    } else if (el.value) {
+      tail.push(el.name, el.value);
+    }
+  });
+  var parts = head.concat(positional.filter(Boolean)).concat(tail);
+  document.getElementById("cmd").textContent = parts.join(" ");
+  return parts;
+}
+document.getElementById("form").addEventListener("input", compose);
+function run() {
+  fetch("/run", {method: "POST",
+                 body: JSON.stringify({argv: compose().slice(1),
+                                       token: TOKEN})})
+    .then(function (r) { return r.json(); })
+    .then(function (d) {
+      document.getElementById("status").textContent =
+        d.error || ("started pid " + d.pid);
+    });
+}
+compose();
+</script></body></html>
+"""
+
+
+def _field(action):
+    name = action.option_strings[-1] if action.option_strings else None
+    if name in (None, "--help", "--frontend"):
+        return ""
+    help_text = html.escape(action.help or "")
+    if action.nargs == 0 or action.const is True:
+        control = "<input type='checkbox' name='%s'>" % name
+    else:
+        control = "<input type='text' name='%s'>" % name
+    return ("<label>%s %s <span class='help'>%s</span></label>"
+            % (html.escape(name), control, help_text))
+
+
+class FrontendServer(object):
+    """Serves the composer; launched by ``--frontend [PORT]``."""
+
+    def __init__(self, parser, port=0):
+        import tornado.web
+
+        fields = "".join(_field(a) for a in parser._actions)
+        # per-session token: a cross-origin page can POST to localhost
+        # without a CORS preflight, but it cannot read this page to
+        # learn the token
+        self.token = uuid.uuid4().hex
+        page = _PAGE % (fields, json.dumps(sys.executable),
+                        json.dumps(self.token))
+        server_self = self
+
+        class PageHandler(tornado.web.RequestHandler):
+            def get(self):
+                self.write(page)
+
+        class RunHandler(tornado.web.RequestHandler):
+            def post(self):
+                payload = json.loads(self.request.body or b"{}")
+                argv = payload.get("argv") or []
+                if not isinstance(argv, list) or \
+                        any(not isinstance(a, str) for a in argv):
+                    self.write({"error": "argv must be a string list"})
+                    return
+                if payload.get("token") != server_self.token:
+                    self.write({"error": "bad or missing token"})
+                    return
+                if argv[:2] != ["-m", "veles_tpu"]:
+                    # only veles_tpu runs may be composed
+                    self.write({"error":
+                                "argv must start with -m veles_tpu"})
+                    return
+                if server_self.process is not None and \
+                        server_self.process.poll() is None:
+                    self.write({"error": "a run is already active "
+                                "(pid %d)" % server_self.process.pid})
+                    return
+                try:
+                    server_self.process = subprocess.Popen(
+                        [sys.executable] + argv)
+                except OSError as exc:
+                    self.write({"error": str(exc)})
+                    return
+                server_self.command = " ".join(shlex.quote(a)
+                                               for a in argv)
+                self.write({"pid": server_self.process.pid})
+
+        class StatusHandler(tornado.web.RequestHandler):
+            def get(self):
+                proc = server_self.process
+                self.write({
+                    "command": server_self.command,
+                    "running": proc is not None and
+                    proc.poll() is None,
+                    "returncode": None if proc is None
+                    else proc.poll()})
+
+        self.app = tornado.web.Application([
+            (r"/", PageHandler),
+            (r"/run", RunHandler),
+            (r"/status", StatusHandler),
+        ])
+        self.process = None
+        self.command = None
+        self._server = BackgroundHTTPServer(self.app, port=port)
+
+    @property
+    def port(self):
+        return self._server.port
+
+    def start_background(self):
+        return self._server.start()
+
+    def stop(self):
+        self._server.stop()

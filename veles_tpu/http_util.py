@@ -1,0 +1,71 @@
+"""Shared background tornado HTTP serving.
+
+Four services (web status, RESTful API, forge, frontend composer) run
+the same serve-in-a-daemon-thread pattern; this is the one copy.  Bind
+errors propagate to the caller instead of dying silently inside the
+thread.
+"""
+
+import threading
+
+__all__ = ["BackgroundHTTPServer"]
+
+
+class BackgroundHTTPServer(object):
+    """Runs a tornado Application on its own asyncio loop thread.
+
+    ``start()`` returns once the socket is bound (raising the bind
+    error, e.g. EADDRINUSE, in the calling thread); ``stop()`` stops the
+    loop and joins the thread.
+    """
+
+    def __init__(self, app, port=0, address="127.0.0.1",
+                 **server_kwargs):
+        self.app = app
+        self.port = port
+        self.address = address
+        self.server_kwargs = server_kwargs
+        self._loop = None
+        self._thread = None
+
+    def start(self):
+        import asyncio
+
+        import tornado.httpserver
+        import tornado.netutil
+
+        started = threading.Event()
+        failure = []
+
+        def serve():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            try:
+                server = tornado.httpserver.HTTPServer(
+                    self.app, **self.server_kwargs)
+                sockets = tornado.netutil.bind_sockets(
+                    self.port, address=self.address)
+                self.port = sockets[0].getsockname()[1]
+                server.add_sockets(sockets)
+            except Exception as exc:
+                failure.append(exc)
+                started.set()
+                return
+            started.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=serve, daemon=True)
+        self._thread.start()
+        if not started.wait(10):
+            raise RuntimeError("HTTP server failed to start in 10 s")
+        if failure:
+            raise failure[0]
+        return self._thread
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -9,7 +9,6 @@ role.
 """
 
 import json
-import threading
 
 import numpy
 
@@ -25,8 +24,7 @@ class RESTfulAPI(Unit):
         self.path = kwargs.get("path", "/api")
         self._forward = None
         self._params = None
-        self._thread = None
-        self._loop = None
+        self._server_ = None
         self.requests_served = 0
 
     def initialize(self, **kwargs):
@@ -65,10 +63,6 @@ class RESTfulAPI(Unit):
     # -- HTTP ---------------------------------------------------------------
 
     def start_background(self):
-        import asyncio
-
-        import tornado.httpserver
-        import tornado.netutil
         import tornado.web
 
         unit = self
@@ -83,28 +77,15 @@ class RESTfulAPI(Unit):
                     self.write({"error": str(exc)})
 
         app = tornado.web.Application([(self.path, ApiHandler)])
-        started = threading.Event()
-
-        def serve():
-            loop = asyncio.new_event_loop()
-            asyncio.set_event_loop(loop)
-            self._loop = loop
-            server = tornado.httpserver.HTTPServer(app)
-            sockets = tornado.netutil.bind_sockets(
-                self.port, address="127.0.0.1")
-            self.port = sockets[0].getsockname()[1]
-            server.add_sockets(sockets)
-            started.set()
-            loop.run_forever()
-
-        self._thread = threading.Thread(target=serve, daemon=True)
-        self._thread.start()
-        started.wait(5)
+        from veles_tpu.http_util import BackgroundHTTPServer
+        self._server_ = BackgroundHTTPServer(app, port=self.port)
+        thread = self._server_.start()
+        self.port = self._server_.port
         self.info("REST API on http://127.0.0.1:%d%s", self.port,
                   self.path)
-        return self._thread
+        return thread
 
     def stop(self):
         super(RESTfulAPI, self).stop()
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._server_ is not None:
+            self._server_.stop()

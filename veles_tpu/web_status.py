@@ -344,9 +344,12 @@ class WebStatusServer(Logger):
             (r"/table", TableHandler),
             (r"/", PageHandler),
         ])
-        self.port = port
-        self._loop = None
-        self._thread = None
+        from veles_tpu.http_util import BackgroundHTTPServer
+        self._server = BackgroundHTTPServer(self.app, port=port)
+
+    @property
+    def port(self):
+        return self._server.port
 
     @property
     def sessions(self):
@@ -383,37 +386,14 @@ class WebStatusServer(Logger):
                 fout.write(json.dumps(stamped) + "\n")
 
     def start_background(self):
-        import asyncio
-
-        import tornado.httpserver
-        import tornado.netutil
-
-        started = threading.Event()
-
-        def serve():
-            loop = asyncio.new_event_loop()
-            asyncio.set_event_loop(loop)
-            self._loop = loop
-            server = tornado.httpserver.HTTPServer(self.app)
-            sockets = tornado.netutil.bind_sockets(
-                self.port, address="127.0.0.1")
-            self.port = sockets[0].getsockname()[1]
-            server.add_sockets(sockets)
-            started.set()
-            loop.run_forever()
-
-        self._thread = threading.Thread(target=serve, daemon=True)
-        self._thread.start()
-        started.wait(5)
+        thread = self._server.start()
         self.info("web status on http://127.0.0.1:%d/", self.port)
-        return self._thread
+        return thread
 
     def stop(self):
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._thread is not None:
-            # let in-flight handlers drain before closing the DB
-            self._thread.join(timeout=5)
+        # stop() joins the loop thread, draining in-flight handlers
+        # before the DB closes
+        self._server.stop()
         self.store.close()
 
 
