@@ -1,0 +1,122 @@
+"""Real multi-PROCESS distributed tests (SURVEY §4 implication (b):
+the JAX analog of the reference's in-process master+slave socket tests
+is multi-process jax.distributed on localhost).
+
+Each test spawns N fresh interpreters; every process pins itself to 2
+virtual CPU devices, joins the cluster through the same
+VELES_COORDINATOR/VELES_NUM_PROCESSES/VELES_PROCESS_ID contract the
+launcher's init_multihost reads, and runs real cross-process
+collectives on the 2N-device global mesh.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import json, os, sys
+pid = int(os.environ["VELES_PROCESS_ID"])
+n = int(os.environ["VELES_NUM_PROCESSES"])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+from veles_tpu.launcher import Launcher
+Launcher.init_multihost()
+
+import numpy
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from veles_tpu.compiler import build_train_step
+from veles_tpu.models.zoo import build_plans_and_state
+from veles_tpu.parallel import (batch_sharding, replicate,
+                                shard_host_batch)
+
+mesh = Mesh(numpy.array(jax.devices()).reshape(-1), ("data",))
+specs = [{"type": "all2all_tanh", "output_sample_shape": 16,
+          "learning_rate": 0.1, "gradient_moment": 0.9},
+         {"type": "softmax", "output_sample_shape": 4,
+          "learning_rate": 0.1, "gradient_moment": 0.9}]
+plans, state, _ = build_plans_and_state(specs, (8,), seed=7)
+with mesh:
+    state = replicate(mesh, state)
+    step = build_train_step(
+        plans, mesh=mesh,
+        batch_sharding=batch_sharding(mesh),
+        donate=False)
+    # every process loads ITS OWN slice (what a per-host Loader window
+    # serves); shard_host_batch stitches the global batch
+    rng = numpy.random.RandomState(100 + pid)
+    local_x = rng.rand(8, 8).astype(numpy.float32)
+    local_y = rng.randint(0, 4, 8).astype(numpy.int32)
+    x = shard_host_batch(mesh, local_x)
+    y = shard_host_batch(mesh, local_y)
+    new_state, metrics = step(state, x, y, numpy.float32(8 * n))
+    loss = float(metrics["loss"])
+    # parameter fingerprint must be IDENTICAL across processes: the
+    # gradient all-reduce is the reference's parameter-server merge
+    w = new_state[0]["weights"]
+    fingerprint = float(jnp.sum(jnp.abs(w)))
+print(json.dumps({"pid": pid,
+                  "global_devices": len(jax.devices()),
+                  "local_devices": len(jax.local_devices()),
+                  "loss": loss, "fingerprint": fingerprint}))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_cluster(n_procs, script):
+    port = _free_port()
+    procs = []
+    for pid in range(n_procs):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "VELES_COORDINATOR": "127.0.0.1:%d" % port,
+            "VELES_NUM_PROCESSES": str(n_procs),
+            "VELES_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for proc in procs:
+            out, err = proc.communicate(timeout=240)
+            assert proc.returncode == 0, err[-2000:]
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # a worker that failed or timed out must not orphan the rest
+        # at the coordinator barrier
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_dp_train_step():
+    """2 processes x 2 virtual devices: cluster forms a 4-device global
+    mesh, each process feeds its local batch slice, one fused DP train
+    step runs a REAL cross-process gradient all-reduce, and both
+    processes end with bit-identical parameters."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = _spawn_cluster(2, _WORKER % {"repo": repo})
+    assert [o["global_devices"] for o in outs] == [4, 4]
+    assert [o["local_devices"] for o in outs] == [2, 2]
+    assert outs[0]["loss"] == outs[1]["loss"]
+    assert outs[0]["fingerprint"] == outs[1]["fingerprint"]

@@ -14,4 +14,5 @@ requeue) stay in veles_tpu.server/client as a host-side concern.
 
 from veles_tpu.parallel.mesh import make_mesh, auto_mesh  # noqa: F401
 from veles_tpu.parallel.api import (  # noqa: F401
-    replicate, shard_batch, mlp_state_shardings, batch_sharding)
+    replicate, shard_batch, mlp_state_shardings, batch_sharding,
+    shard_host_batch)

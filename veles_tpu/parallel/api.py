@@ -11,7 +11,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
 __all__ = ["replicate", "shard_batch", "batch_sharding",
-           "mlp_state_shardings"]
+           "mlp_state_shardings", "shard_host_batch"]
 
 
 def replicate(mesh, tree):
@@ -57,3 +57,25 @@ def mlp_state_shardings(mesh, state, data_axis="data", model_axis=None):
             key: NamedSharding(mesh, spec_for(i, key, leaf))
             for key, leaf in entry.items()})
     return shardings
+
+
+def shard_host_batch(mesh, local_batch, data_axis="data"):
+    """Build a GLOBAL batch-sharded array from each process's local
+    minibatch slice (multi-host data loading: every host's Loader
+    serves its own index window; this stitches the per-host slices
+    into one mesh-spanning array, the multi-host replacement for the
+    reference's master→slave minibatch shipping).
+
+    Every process must pass the same local shape — the Loader contract
+    guarantees this by zero-padding short final minibatches to
+    ``max_minibatch_size`` and shipping the real count in
+    ``batch_size`` (which the evaluators mask on).  The global shape is
+    derived from the sharding, so mixed meshes (e.g. a model axis whose
+    devices span processes) stitch correctly too.
+
+    Single-process meshes fall through to a plain device_put.
+    """
+    if jax.process_count() == 1:
+        return shard_batch(mesh, local_batch, data_axis)
+    return jax.make_array_from_process_local_data(
+        batch_sharding(mesh, data_axis), local_batch)
