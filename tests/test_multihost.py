@@ -93,18 +93,39 @@ def _spawn_cluster(n_procs, script):
             [sys.executable, "-c", script],
             env=env, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
+    import time
     outs = []
     try:
+        # shared deadline + poll so ONE crashed worker surfaces its
+        # stderr immediately instead of the others' barrier timeout
+        deadline = time.time() + 240
+        while any(p.poll() is None for p in procs):
+            if any(p.poll() not in (None, 0) for p in procs):
+                break
+            if time.time() > deadline:
+                raise AssertionError("cluster workers timed out")
+            time.sleep(0.3)
         for proc in procs:
-            out, err = proc.communicate(timeout=240)
+            try:
+                out, err = proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, err = proc.communicate()
+                raise AssertionError(
+                    "worker hung; peer stderr follows:\n" + err[-2000:])
             assert proc.returncode == 0, err[-2000:]
             outs.append(json.loads(out.strip().splitlines()[-1]))
     finally:
         # a worker that failed or timed out must not orphan the rest
-        # at the coordinator barrier
+        # at the coordinator barrier; reap after kill so no zombies or
+        # open pipes outlive the test
         for proc in procs:
             if proc.poll() is None:
                 proc.kill()
+            try:
+                proc.communicate(timeout=10)
+            except Exception:
+                pass
     return outs
 
 
@@ -120,3 +141,60 @@ def test_two_process_dp_train_step():
     assert [o["local_devices"] for o in outs] == [2, 2]
     assert outs[0]["loss"] == outs[1]["loss"]
     assert outs[0]["fingerprint"] == outs[1]["fingerprint"]
+
+
+_RING_WORKER = r"""
+import json, os, sys
+pid = int(os.environ["VELES_PROCESS_ID"])
+n = int(os.environ["VELES_NUM_PROCESSES"])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+from veles_tpu.launcher import Launcher
+Launcher.init_multihost()
+
+import numpy
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from veles_tpu.parallel.ring import ring_attention
+
+mesh = Mesh(numpy.array(jax.devices()).reshape(-1), ("seq",))
+B, T, H, D = 2, 4 * len(jax.devices()), 2, 8
+rng = numpy.random.RandomState(11)  # same on every process
+q, k, v = (rng.randn(B, T, H, D).astype(numpy.float32)
+           for _ in range(3))
+sharding = NamedSharding(mesh, P(None, "seq"))
+# identical full arrays on every process -> device_put is legal
+qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+with mesh:
+    out = ring_attention(qs, ks, vs, mesh, causal=True)
+    got = numpy.asarray(
+        jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))(out))
+
+# oracle: plain causal attention on the full sequence
+scale = 1.0 / numpy.sqrt(D)
+logits = numpy.einsum("bqhd,bkhd->bhqk", q, k) * scale
+mask = numpy.tril(numpy.ones((T, T), bool))
+logits = numpy.where(mask[None, None], logits, -1e30)
+w = numpy.exp(logits - logits.max(-1, keepdims=True))
+w /= w.sum(-1, keepdims=True)
+ref = numpy.einsum("bhqk,bkhd->bqhd", w, v)
+err = float(numpy.abs(got - ref).max())
+print(json.dumps({"pid": pid, "err": err,
+                  "devices": len(jax.devices())}))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_ring_attention():
+    """Ring attention's ppermute hops cross PROCESS boundaries on a
+    2-process x 2-device seq mesh and still matches the single-host
+    oracle exactly — the long-context sequence-parallel path is
+    genuinely multi-host."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = _spawn_cluster(2, _RING_WORKER % {"repo": repo})
+    assert [o["devices"] for o in outs] == [4, 4]
+    for o in outs:
+        assert o["err"] < 2e-5, o

@@ -7,12 +7,17 @@ annotations on the fused train step, and XLA-inserted collectives riding
 ICI.  The master-slave *control* semantics (job bookkeeping, elastic
 requeue) stay in veles_tpu.server/client as a host-side concern.
 
-- mesh.py   — mesh discovery/construction (devices -> named axes)
-- api.py    — shard/replicate placement helpers + DP/TP sharding rules
-              for the fused train step
+- mesh.py     — mesh discovery/construction (devices -> named axes)
+- api.py      — shard/replicate placement helpers + DP/TP sharding
+                rules for the fused train step
+- ring.py     — ring + Ulysses sequence-parallel attention
+- pipeline.py — GPipe wavefront pipeline parallelism
+- moe.py      — sharded mixture-of-experts
 """
 
 from veles_tpu.parallel.mesh import make_mesh, auto_mesh  # noqa: F401
 from veles_tpu.parallel.api import (  # noqa: F401
     replicate, shard_batch, mlp_state_shardings, batch_sharding,
     shard_host_batch)
+from veles_tpu.parallel.ring import (  # noqa: F401
+    ring_attention, ulysses_attention)
