@@ -332,5 +332,9 @@ def test_launcher_posts_status_periodically(tmp_path):
         # PERIODIC posting, not just the final flush: a ~seconds run at
         # a 0.2 s interval must leave more than one history entry
         assert len(server.store.get_history(post["id"])) > 1
+        # Logger.event records reach the dashboard's event log too
+        events = server.store.get_events(post["id"])
+        assert events, "no events forwarded"
+        assert any('"name": "run"' in text for _, text in events), events
     finally:
         server.stop()

@@ -163,25 +163,62 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         (launcher.py:852-885 posted from the master/standalone side)."""
         if not self.web_status_url or self.is_slave:
             return
-        from veles_tpu.web_status import StatusReporter
+        import collections
+        import json
         import uuid
+
+        from veles_tpu.logger import add_event_hook, remove_event_hook
+        from veles_tpu.web_status import StatusReporter
         reporter = StatusReporter(
             self.web_status_url,
             "%s-%s" % (self._workflow.name, uuid.uuid4().hex[:8]),
             self._workflow)
         self._reporter_stop.clear()
+        # Logger.event records ride along with the status posts (the
+        # reference streamed them to MongoDB for the dashboard's event
+        # browser); the hook only enqueues — posting happens on the
+        # reporter thread, never on the traced thread
+        pending_events = collections.deque(maxlen=200)
+        dropped = [0]
+
+        def hook(record):
+            if len(pending_events) == pending_events.maxlen:
+                dropped[0] += 1  # logged from the reporter thread
+            pending_events.append(record)
+
+        add_event_hook(hook)
+
+        def drain_events(limit=50):
+            # peek-then-pop: a failed post leaves the record queued for
+            # the next cycle instead of losing it; the per-tick limit
+            # bounds how long a drain can hold the reporter thread
+            sent = 0
+            while pending_events and sent < limit:
+                reporter.post_event(json.dumps(
+                    pending_events[0], default=repr))
+                pending_events.popleft()
+                sent += 1
+            if dropped[0]:
+                self.debug("%d trace events dropped (queue full)",
+                           dropped[0])
+                dropped[0] = 0
 
         def loop():
-            while not self._reporter_stop.wait(
-                    self.notification_interval):
-                try:
-                    reporter.post()
-                except Exception as exc:
-                    self.debug("status post failed: %s", exc)
             try:
-                reporter.post()  # final state after the run ends
-            except Exception as exc:
-                self.debug("final status post failed: %s", exc)
+                while not self._reporter_stop.wait(
+                        self.notification_interval):
+                    try:
+                        reporter.post()
+                        drain_events()
+                    except Exception as exc:
+                        self.debug("status post failed: %s", exc)
+                try:
+                    reporter.post()  # final state after the run ends
+                    drain_events()
+                except Exception as exc:
+                    self.debug("final status post failed: %s", exc)
+            finally:
+                remove_event_hook(hook)
 
         self._reporter_thread = threading.Thread(
             target=loop, daemon=True, name="status-reporter")

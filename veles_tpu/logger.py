@@ -19,7 +19,8 @@ import threading
 import time
 import uuid
 
-__all__ = ["Logger", "set_file_logging", "set_event_file"]
+__all__ = ["Logger", "set_file_logging", "set_event_file",
+           "add_event_hook", "remove_event_hook"]
 
 _COLORS = {
     logging.DEBUG: "\033[36m",     # cyan
@@ -70,6 +71,24 @@ def set_file_logging(path, level=logging.DEBUG):
     handler.setLevel(level)
     logging.getLogger().addHandler(handler)
     return handler
+
+
+_event_hooks = []
+
+
+def add_event_hook(fn):
+    """Register an observer called with every event record (the
+    reference streamed events to MongoDB, logger.py:264-289; the
+    web-status reporter forwards them to the dashboard's event log).
+    Hooks must be fast or enqueue — they run on the traced thread."""
+    _event_hooks.append(fn)
+
+
+def remove_event_hook(fn):
+    try:
+        _event_hooks.remove(fn)
+    except ValueError:
+        pass
 
 
 def set_event_file(path):
@@ -132,7 +151,7 @@ class Logger(object):
         """
         if kind not in ("begin", "end", "single"):
             raise ValueError("kind must be begin|end|single, got %r" % kind)
-        if _event_file is None:
+        if _event_file is None and not _event_hooks:
             return
         record = {
             "session": session_id,
@@ -147,3 +166,8 @@ class Logger(object):
             if _event_file is not None:
                 _event_file.write(json.dumps(record, default=repr) + "\n")
                 _event_file.flush()
+        for hook in list(_event_hooks):
+            try:
+                hook(record)
+            except Exception:
+                pass  # observers must never break the traced code
