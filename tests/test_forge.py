@@ -88,3 +88,53 @@ def test_forge_rejects_path_traversal(forge, tmp_path):
         forge.store("../pkg", "1.0.0", b"x")
     with pytest.raises(ValueError):
         forge.store("pkg", "../../1.0.0", b"x")
+
+
+def test_git_backed_forge_roundtrip(tmp_path):
+    """git_backed=True (reference forge_server.py kept one git repo
+    per package): uploads commit + tag, every historical version stays
+    fetchable byte-exact, duplicates are refused, and the HTTP surface
+    is unchanged."""
+    import json
+    import shutil
+    import urllib.error
+    import urllib.request
+    if shutil.which("git") is None:
+        pytest.skip("git unavailable")
+    from veles_tpu.forge.server import ForgeServer
+
+    server = ForgeServer(str(tmp_path / "hub"), git_backed=True)
+    server.start_background()
+    base = "http://127.0.0.1:%d" % server.port
+    try:
+        v1 = b"PKG-v1" * 100
+        v2 = b"PKG-v2" * 100
+        for version, payload in (("1.0.0", v1), ("1.1.0", v2)):
+            req = urllib.request.Request(
+                base + "/upload?name=demo&version=%s" % version,
+                data=payload)
+            assert json.loads(urllib.request.urlopen(req).read())[
+                "result"] == "ok"
+        # duplicate version refused with 400
+        req = urllib.request.Request(
+            base + "/upload?name=demo&version=1.0.0", data=v1)
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(req)
+        assert excinfo.value.code == 400
+
+        with urllib.request.urlopen(
+                base + "/service?query=details&name=demo") as resp:
+            details_ = json.loads(resp.read())
+        assert details_["versions"] == ["1.0.0", "1.1.0"]
+        assert details_["metadata"]["version"] == "1.1.0"
+        # historical version comes back byte-exact from git
+        with urllib.request.urlopen(
+                base + "/fetch?name=demo&version=1.0.0") as resp:
+            assert resp.read() == v1
+        with urllib.request.urlopen(base + "/fetch?name=demo") as resp:
+            assert resp.headers["X-Package-Version"] == "1.1.0"
+            assert resp.read() == v2
+        # storage really is a git repo with one tag per version
+        assert (tmp_path / "hub" / "demo" / ".git").is_dir()
+    finally:
+        server.stop()

@@ -2,7 +2,11 @@
 
 Reference veles/forge/forge_server.py kept each package as a git repo
 with email-confirmed uploads; this build stores versioned directories
-(<root>/<name>/<version>/package.tar + metadata.json) and serves:
+(<root>/<name>/<version>/package.tar + metadata.json) by default, or —
+with ``git_backed=True`` — one git repo per package whose worktree
+holds the latest files and whose ``v/<version>`` tags hold history
+(delta compression dedups near-identical package versions, same as the
+reference).  Served endpoints:
 
   GET  /service?query=list                  -> JSON package index
   GET  /service?query=details&name=N        -> metadata + versions
@@ -16,6 +20,7 @@ the highest.
 import json
 import os
 import re
+import subprocess
 
 from veles_tpu.logger import Logger
 
@@ -38,14 +43,85 @@ class ForgeServer(Logger):
     email-confirmed tokens, forge_server.py; a shared bearer token is
     this build's equivalent).  Reads default from $VELES_FORGE_TOKEN."""
 
-    def __init__(self, root_dir, port=0, upload_token=None):
+    def __init__(self, root_dir, port=0, upload_token=None,
+                 git_backed=False):
         super(ForgeServer, self).__init__()
         self.root_dir = root_dir
         os.makedirs(root_dir, exist_ok=True)
         self.port = port
         self.upload_token = (upload_token if upload_token is not None
                              else os.environ.get("VELES_FORGE_TOKEN"))
+        self.git_backed = git_backed
         self._server_ = None
+
+    # -- git backing ----------------------------------------------------------
+
+    def _git(self, name, *args, binary=False):
+        pdir = os.path.join(self.root_dir,
+                            _safe_component(name, "package name"))
+        env = dict(os.environ,
+                   GIT_CONFIG_GLOBAL=os.devnull,
+                   GIT_CONFIG_SYSTEM=os.devnull)
+        try:
+            out = subprocess.run(
+                ["git", "-C", pdir, "-c", "user.name=forge",
+                 "-c", "user.email=forge@localhost",
+                 # payloads are binary: host autocrlf/gitattributes
+                 # must never rewrite them
+                 "-c", "core.autocrlf=false"] + list(args),
+                capture_output=True, check=True, env=env)
+        except FileNotFoundError:
+            raise RuntimeError("git binary not available "
+                               "(git_backed forge requires it)")
+        except subprocess.CalledProcessError as exc:
+            stderr = exc.stderr.decode(errors="replace").strip()
+            self.warning("git %s failed for %s: %s",
+                         args[0] if args else "", name, stderr)
+            raise RuntimeError("git %s failed: %s"
+                               % (args[0] if args else "", stderr))
+        return out.stdout if binary else out.stdout.decode()
+
+    def _git_store(self, name, version, payload, meta):
+        pdir = os.path.join(self.root_dir,
+                            _safe_component(name, "package name"))
+        _safe_component(version, "version")
+        os.makedirs(pdir, exist_ok=True)
+        if not os.path.isdir(os.path.join(pdir, ".git")):
+            self._git(name, "init", "-q")
+        if version in self._git_versions(name):
+            raise ValueError("version %s already published" % version)
+        with open(os.path.join(pdir, "package.tar"), "wb") as fout:
+            fout.write(payload)
+        with open(os.path.join(pdir, "metadata.json"), "w") as fout:
+            json.dump(meta, fout, indent=1, sort_keys=True)
+        self._git(name, "add", "-A")
+        # --allow-empty: a crash between commit and tag leaves the
+        # version unpublished (no tag) but retriable — the retry's
+        # identical content still commits and the tag lands
+        self._git(name, "commit", "-q", "--allow-empty",
+                  "-m", version)
+        self._git(name, "tag", "v/%s" % version)
+
+    def _git_versions(self, name):
+        pdir = os.path.join(self.root_dir,
+                            _safe_component(name, "package name"))
+        if not os.path.isdir(os.path.join(pdir, ".git")):
+            if os.path.isdir(pdir) and os.listdir(pdir):
+                # plain-directory versions from a non-git deployment:
+                # hiding them (or committing them as junk) would be
+                # silent data loss — refuse loudly
+                raise RuntimeError(
+                    "package %r holds non-git version directories; "
+                    "migrate them or run without git_backed" % name)
+            return []
+        tags = self._git(name, "tag", "--list", "v/*").split()
+        return sorted(t[2:] for t in tags)
+
+    def _git_show(self, name, version, filename, binary=False):
+        return self._git(
+            name, "show", "v/%s:%s" % (
+                _safe_component(version, "version"), filename),
+            binary=binary)
 
     # -- storage ------------------------------------------------------------
 
@@ -59,6 +135,8 @@ class ForgeServer(Logger):
         return path
 
     def versions(self, name):
+        if self.git_backed:
+            return self._git_versions(name)
         pdir = os.path.join(self.root_dir,
                             _safe_component(name, "package name"))
         if not os.path.isdir(pdir):
@@ -66,29 +144,55 @@ class ForgeServer(Logger):
         return sorted(os.listdir(pdir))
 
     def store(self, name, version, payload, metadata=None):
+        meta = dict(metadata or {})
+        meta.update({"name": name, "version": version,
+                     "size": len(payload)})
+        if self.git_backed:
+            self._git_store(name, version, payload, meta)
+            self.info("stored %s==%s (%d bytes, git)", name, version,
+                      len(payload))
+            return
         pdir = self._package_dir(name, version)
         os.makedirs(pdir, exist_ok=True)
         with open(os.path.join(pdir, "package.tar"), "wb") as fout:
             fout.write(payload)
-        meta = dict(metadata or {})
-        meta.update({"name": name, "version": version,
-                     "size": len(payload)})
         with open(os.path.join(pdir, "metadata.json"), "w") as fout:
             json.dump(meta, fout, indent=1, sort_keys=True)
         self.info("stored %s==%s (%d bytes)", name, version,
                   len(payload))
 
     def load(self, name, version="latest"):
+        latest_known = False
         if version == "latest":
             versions = self.versions(name)
             if not versions:
                 raise KeyError("unknown package %s" % name)
             version = versions[-1]
+            latest_known = True
+        if self.git_backed:
+            if latest_known:
+                # the worktree already holds the newest files: no
+                # extra git spawns on the hot fetch path
+                pdir = os.path.join(
+                    self.root_dir,
+                    _safe_component(name, "package name"))
+                with open(os.path.join(pdir, "package.tar"),
+                          "rb") as fin:
+                    return fin.read(), version
+            if version not in self._git_versions(name):
+                raise KeyError("unknown version %s" % version)
+            return (self._git_show(name, version, "package.tar",
+                                   binary=True), version)
         pdir = self._package_dir(name, version)
         with open(os.path.join(pdir, "package.tar"), "rb") as fin:
             return fin.read(), version
 
     def metadata(self, name, version):
+        if self.git_backed:
+            if version not in self._git_versions(name):
+                raise KeyError("unknown version %s" % version)
+            return json.loads(
+                self._git_show(name, version, "metadata.json"))
         with open(os.path.join(self._package_dir(name, version),
                                "metadata.json")) as fin:
             return json.load(fin)
@@ -96,6 +200,15 @@ class ForgeServer(Logger):
     def index(self):
         out = []
         for name in sorted(os.listdir(self.root_dir)):
+            if self.git_backed:
+                # worktree holds the latest metadata — one file read
+                # per package instead of two git spawns
+                path = os.path.join(self.root_dir, name,
+                                    "metadata.json")
+                if os.path.isfile(path):
+                    with open(path) as fin:
+                        out.append(json.load(fin))
+                continue
             versions = self.versions(name)
             if versions:
                 out.append(self.metadata(name, versions[-1]))
@@ -165,9 +278,11 @@ class ForgeServer(Logger):
                 try:
                     forge.store(name, version, self.request.body,
                                 json.loads(meta_json))
-                except ValueError:
+                except ValueError as exc:
+                    # distinguish "already published" from a malformed
+                    # name so publishers debug the right thing
                     self.set_status(400)
-                    self.write({"error": "illegal name or version"})
+                    self.write({"error": str(exc)})
                     return
                 self.write({"result": "ok"})
 
