@@ -1,0 +1,70 @@
+"""Digits MLP autoencoder — the offline stand-in for the reference's
+MNIST-autoencoder quality anchor (validation RMSE 0.5478,
+manualrst_veles_algorithms.rst:69; MNIST itself needs network access,
+absent here, so the 8x8 digits reconstruct instead).
+
+    python -m veles_tpu examples/autoencoder.py
+"""
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.datasets import digits_arrays
+from veles_tpu.loader.fullbatch import FullBatchLoaderMSE
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.models.zoo import autoencoder_layers
+from veles_tpu.prng import RandomGenerator
+
+root.digits_ae.update({
+    "bottleneck": 12,
+    "hidden": 48,
+    "learning_rate": 0.02,
+    "gradient_moment": 0.9,
+    "minibatch_size": 48,
+    "max_epochs": 60,
+    "fail_iterations": 15,
+})
+
+
+class DigitsAELoader(FullBatchLoaderMSE):
+    """Reconstruction task: targets ARE the inputs (reference
+    autoencoder workflows fed image->same-image MSE pairs)."""
+
+    def __init__(self, workflow, validation_count=360, seed=4,
+                 **kwargs):
+        super(DigitsAELoader, self).__init__(workflow, **kwargs)
+        self.validation_count = validation_count
+        self.split_seed = seed
+
+    def load_data(self):
+        train_x, _, valid_x, _ = digits_arrays(
+            self.validation_count, self.split_seed)
+        data = numpy.concatenate([valid_x, train_x])
+        self.original_data = data
+        self.original_targets = data.copy()
+        self.class_lengths[0] = 0
+        self.class_lengths[1] = len(valid_x)
+        self.class_lengths[2] = len(train_x)
+
+
+def build(launcher):
+    cfg = root.digits_ae
+    return StandardWorkflow(
+        launcher,
+        layers=autoencoder_layers(
+            bottleneck=cfg.bottleneck, hidden=cfg.hidden,
+            out_features=64, lr=cfg.learning_rate,
+            moment=cfg.gradient_moment),
+        loss="mse",
+        loader_factory=lambda w: DigitsAELoader(
+            w, minibatch_size=cfg.minibatch_size,
+            prng=RandomGenerator("digits_ae", seed=11)),
+        decision_config=dict(max_epochs=cfg.max_epochs,
+                             fail_iterations=cfg.fail_iterations),
+        result_file=root.common.get("result_file"),
+    )
+
+
+def run(load, main):
+    load(build)
+    main()

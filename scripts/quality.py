@@ -86,6 +86,10 @@ def main():
 
     report = {"targets": {
         "digits": {"note": "offline anchor, no reference number"},
+        "autoencoder": {"reference_rmse": 0.5478,
+                        "source": "manualrst_veles_algorithms.rst:69",
+                        "note": "reference number is MNIST; offline "
+                                "anchor reconstructs 8x8 digits"},
         "mnist": {"reference_error_pct": 1.48,
                   "source": "manualrst_veles_algorithms.rst:31"},
         "cifar10": {"reference_error_pct": 17.21,
@@ -97,6 +101,12 @@ def main():
     print("digits: %.2f%% (epoch %d)" % (
         report["results"]["digits"]["best_error_pct"],
         report["results"]["digits"]["best_epoch"]))
+
+    ae = run_example("autoencoder", args.backend)
+    ae["best_rmse"] = ae.pop("best_error_pct")
+    report["results"]["autoencoder"] = ae
+    print("autoencoder: RMSE %.4f (epoch %d)" % (
+        ae["best_rmse"], ae["best_epoch"]))
 
     for name, skip in (("mnist", args.skip_mnist),
                        ("cifar10", args.skip_cifar)):
