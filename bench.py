@@ -244,6 +244,38 @@ def bench_alexnet(small):
     return out
 
 
+def bench_native(small):
+    """C++ inference runtime throughput on an exported MLP package
+    (wavefront engine; host CPU, not the TPU — the runtime's job is
+    chip-free serving, reference libVeles)."""
+    import tempfile
+
+    from veles_tpu import native
+    from veles_tpu.backends import Device
+    native.build_native()
+
+    from tests.test_native import _train_mlp
+
+    sw = _train_mlp(Device(backend="numpy"), epochs=1)
+    pkg = os.path.join(tempfile.mkdtemp(prefix="bench_native_"),
+                       "mlp.tar")
+    sw.package_export(pkg)
+    wf = native.NativeWorkflow(pkg)
+    rng = numpy.random.RandomState(0)
+    out = {}
+    for batch in (1, 256):
+        x = rng.rand(batch, wf.input_size).astype(numpy.float32)
+        wf.run(x)  # warm the arena plan
+        n = 2000 if small else 10000
+        start = time.perf_counter()
+        for _ in range(max(1, n // batch)):
+            wf.run(x)
+        elapsed = time.perf_counter() - start
+        rows = max(1, n // batch) * batch
+        out["batch_%d_rows_per_sec" % batch] = round(rows / elapsed, 1)
+    return out
+
+
 def main():
     small = bool(os.environ.get("VELES_BENCH_SMALL"))
     extras = {}
@@ -258,6 +290,10 @@ def main():
         extras["alexnet"] = bench_alexnet(small)
     except Exception as exc:
         extras["alexnet"] = {"error": repr(exc)}
+    try:
+        extras["native_inference"] = bench_native(small)
+    except Exception as exc:
+        extras["native_inference"] = {"error": repr(exc)}
 
     per_matmul = matmul_res["float32"]["seconds"]
     n = 512 if small else N

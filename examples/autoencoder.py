@@ -6,11 +6,10 @@ absent here, so the 8x8 digits reconstruct instead).
     python -m veles_tpu examples/autoencoder.py
 """
 
-import numpy
 
 from veles_tpu.config import root
 from veles_tpu.datasets import digits_arrays
-from veles_tpu.loader.fullbatch import FullBatchLoaderMSE
+from veles_tpu.datasets import _SplitLoaderMSE
 from veles_tpu.models.nn_workflow import StandardWorkflow
 from veles_tpu.models.zoo import autoencoder_layers
 from veles_tpu.prng import RandomGenerator
@@ -26,9 +25,10 @@ root.digits_ae.update({
 })
 
 
-class DigitsAELoader(FullBatchLoaderMSE):
+class DigitsAELoader(_SplitLoaderMSE):
     """Reconstruction task: targets ARE the inputs (reference
-    autoencoder workflows fed image->same-image MSE pairs)."""
+    autoencoder workflows fed image->same-image MSE pairs); the
+    [valid|train] layout comes from the shared split-loader base."""
 
     def __init__(self, workflow, validation_count=360, seed=4,
                  **kwargs):
@@ -36,15 +36,8 @@ class DigitsAELoader(FullBatchLoaderMSE):
         self.validation_count = validation_count
         self.split_seed = seed
 
-    def load_data(self):
-        train_x, _, valid_x, _ = digits_arrays(
-            self.validation_count, self.split_seed)
-        data = numpy.concatenate([valid_x, train_x])
-        self.original_data = data
-        self.original_targets = data.copy()
-        self.class_lengths[0] = 0
-        self.class_lengths[1] = len(valid_x)
-        self.class_lengths[2] = len(train_x)
+    def get_arrays(self):
+        return digits_arrays(self.validation_count, self.split_seed)
 
 
 def build(launcher):

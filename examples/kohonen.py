@@ -1,0 +1,108 @@
+"""Kohonen self-organising map over real handwritten digits
+(reference algorithm family: manualrst_veles_algorithms.rst "Kohonen
+maps"): the SOM clusters the 64-feature digits onto a 2-D neuron grid
+without labels, then reports how cleanly the grid separates the true
+classes (winner-purity on the validation split).
+
+    python -m veles_tpu examples/kohonen.py
+"""
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.datasets import digits_arrays
+from veles_tpu.memory import Array
+from veles_tpu.models.kohonen import KohonenForward, KohonenTrainer
+from veles_tpu.mutable import Bool
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.plumbing import Repeater
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+root.kohonen.update({
+    "shape": (8, 8),
+    "epochs": 100,
+    "alpha": 0.3,
+})
+
+
+def purity(winners, labels, neurons):
+    """Fraction of samples whose winning neuron's majority label
+    matches their own — the SOM quality readout."""
+    correct = 0
+    for neuron in range(neurons):
+        mask = winners == neuron
+        if not mask.any():
+            continue
+        correct += numpy.bincount(labels[mask]).max()
+    return correct / len(labels)
+
+
+class EpochCounter(Unit):
+    """Raises ``complete`` after N loop passes."""
+
+    def __init__(self, workflow, epochs, **kwargs):
+        super(EpochCounter, self).__init__(workflow, **kwargs)
+        self.epochs = epochs
+        self.passes = 0
+        self.complete = Bool(False)
+
+    def initialize(self, **kwargs):
+        return super(EpochCounter, self).initialize(**kwargs)
+
+    def run(self):
+        self.passes += 1
+        if self.passes >= self.epochs:
+            self.complete <<= True
+
+
+class KohonenWorkflow(Workflow):
+    """start -> repeater -> trainer -> counter -> (loop | end); the
+    forward/purity readout runs once after the loop ends."""
+
+    def __init__(self, launcher, **kwargs):
+        super(KohonenWorkflow, self).__init__(launcher, **kwargs)
+        cfg = root.kohonen
+        shape = tuple(cfg.shape)
+        train_x, _, valid_x, valid_y = digits_arrays(360, 4)
+        self.valid_labels = valid_y.astype(numpy.int64)
+        self.purity = None
+
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+
+        self.trainer = KohonenTrainer(
+            self, shape=shape, alpha=cfg.alpha,
+            prng=RandomGenerator("kohonen", seed=9))
+        self.trainer.input = Array(train_x)
+        self.trainer.link_from(self.repeater)
+
+        self.counter = EpochCounter(self, int(cfg.epochs))
+        self.counter.link_from(self.trainer)
+
+        self.repeater.link_from(self.counter)
+        self.end_point.link_from(self.counter)
+        self.end_point.gate_block = ~self.counter.complete
+
+        self.forward = KohonenForward(self, shape=shape)
+        self.forward.input = Array(valid_x)
+        self.forward.weights = self.trainer.weights
+
+    def on_workflow_finished(self):
+        # readout: winners on the held-out split -> purity
+        self.forward.initialize(device=self.trainer.device)
+        self.forward.run()
+        self.forward.output.map_read()
+        self.purity = purity(
+            numpy.asarray(self.forward.output.mem),
+            self.valid_labels, self.trainer.neurons_number)
+        self.info("SOM validation purity: %.1f%% "
+                  "(%d neurons, %d epochs)",
+                  100.0 * self.purity, self.trainer.neurons_number,
+                  self.counter.passes)
+        super(KohonenWorkflow, self).on_workflow_finished()
+
+
+def run(load, main):
+    load(KohonenWorkflow)
+    main()

@@ -296,3 +296,28 @@ def test_alexnet_workflow_constructs(cpu_device):
     sw.initialize(device=cpu_device)
     assert len(sw.forwards) == 13
     assert sw.forwards[0].weights.shape == (11, 11, 3, 96)
+
+
+def test_kohonen_example_workflow(cpu_device):
+    """The SOM example drives the real graph engine loop
+    (repeater -> trainer -> counter gate) on real digits and reaches
+    useful unsupervised structure (winner purity well above the 10%
+    chance level)."""
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "examples"))
+    module = importlib.import_module("kohonen")
+    from veles_tpu.config import root
+    from veles_tpu.launcher import Launcher
+    saved_epochs = root.kohonen.epochs
+    root.kohonen.epochs = 40  # keep the test fast; purity ~70%
+    try:
+        launcher = Launcher()
+        wf = module.KohonenWorkflow(launcher)
+        launcher.initialize(device=cpu_device)
+        launcher.run()
+        assert wf.purity is not None and wf.purity > 0.5, wf.purity
+    finally:
+        root.kohonen.epochs = saved_epochs

@@ -25,7 +25,8 @@ import struct
 import numpy
 
 from veles_tpu.config import root
-from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.fullbatch import FullBatchLoader, \
+    FullBatchLoaderMSE
 
 __all__ = ["DatasetNotFound", "load_idx", "mnist_arrays", "MnistLoader",
            "digits_arrays", "DigitsLoader", "cifar10_arrays",
@@ -166,6 +167,17 @@ class _SplitLoader(FullBatchLoader):
         self.class_lengths[0] = 0
         self.class_lengths[1] = len(valid_x)
         self.class_lengths[2] = len(train_x)
+
+
+class _SplitLoaderMSE(FullBatchLoaderMSE, _SplitLoader):
+    """_SplitLoader layout with reconstruction targets == inputs (the
+    autoencoder feed); one copy of the [valid|train] class-window
+    contract for both label and MSE variants."""
+
+    def load_data(self):
+        super(_SplitLoaderMSE, self).load_data()
+        self.original_targets = numpy.array(self.original_data.mem,
+                                            copy=True)
 
 
 class MnistLoader(_SplitLoader):
