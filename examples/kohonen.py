@@ -13,10 +13,8 @@ from veles_tpu.config import root
 from veles_tpu.datasets import digits_arrays
 from veles_tpu.memory import Array
 from veles_tpu.models.kohonen import KohonenForward, KohonenTrainer
-from veles_tpu.mutable import Bool
 from veles_tpu.prng import RandomGenerator
-from veles_tpu.plumbing import Repeater
-from veles_tpu.units import Unit
+from veles_tpu.plumbing import EpochCounter, Repeater
 from veles_tpu.workflow import Workflow
 
 root.kohonen.update({
@@ -36,24 +34,6 @@ def purity(winners, labels, neurons):
             continue
         correct += numpy.bincount(labels[mask]).max()
     return correct / len(labels)
-
-
-class EpochCounter(Unit):
-    """Raises ``complete`` after N loop passes."""
-
-    def __init__(self, workflow, epochs, **kwargs):
-        super(EpochCounter, self).__init__(workflow, **kwargs)
-        self.epochs = epochs
-        self.passes = 0
-        self.complete = Bool(False)
-
-    def initialize(self, **kwargs):
-        return super(EpochCounter, self).initialize(**kwargs)
-
-    def run(self):
-        self.passes += 1
-        if self.passes >= self.epochs:
-            self.complete <<= True
 
 
 class KohonenWorkflow(Workflow):
@@ -89,8 +69,8 @@ class KohonenWorkflow(Workflow):
         self.forward.weights = self.trainer.weights
 
     def on_workflow_finished(self):
-        # readout: winners on the held-out split -> purity
-        self.forward.initialize(device=self.trainer.device)
+        # readout: winners on the held-out split -> purity (the
+        # forward unit was initialized with the rest of the graph)
         self.forward.run()
         self.forward.output.map_read()
         self.purity = purity(

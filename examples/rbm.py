@@ -1,0 +1,66 @@
+"""Bernoulli RBM pretraining on real handwritten digits (reference
+algorithm family: manualrst_veles_algorithms.rst "RBM"): CD-k training
+drives reconstruction error down on the train split, then the readout
+reports held-out reconstruction error — the unsupervised pretraining
+quality signal.
+
+    python -m veles_tpu examples/rbm.py
+"""
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.datasets import digits_arrays
+from veles_tpu.memory import Array
+from veles_tpu.models.rbm import RBM
+from veles_tpu.plumbing import EpochCounter, Repeater
+from veles_tpu.prng import RandomGenerator
+from veles_tpu.workflow import Workflow
+
+root.rbm.update({
+    "hidden": 64,
+    "epochs": 60,
+    "learning_rate": 0.1,
+    "cd_k": 1,
+})
+
+
+class RBMWorkflow(Workflow):
+    """start -> repeater -> rbm(CD-k) -> counter -> (loop | end)."""
+
+    def __init__(self, launcher, **kwargs):
+        super(RBMWorkflow, self).__init__(launcher, **kwargs)
+        cfg = root.rbm
+        train_x, _, valid_x, _ = digits_arrays(360, 4)
+        self.valid_x = valid_x  # already scaled to [0, 1]
+        self.holdout_error = None
+
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+
+        self.rbm = RBM(self, hidden_size=cfg.hidden,
+                       learning_rate=cfg.learning_rate, cd_k=cfg.cd_k,
+                       prng=RandomGenerator("rbm", seed=13))
+        self.rbm.input = Array(train_x)
+        self.rbm.link_from(self.repeater)
+
+        self.counter = EpochCounter(self, int(cfg.epochs))
+        self.counter.link_from(self.rbm)
+
+        self.repeater.link_from(self.counter)
+        self.end_point.link_from(self.counter)
+        self.end_point.gate_block = ~self.counter.complete
+
+    def on_workflow_finished(self):
+        self.holdout_error = self.rbm.reconstruct_error(
+            self.valid_x)
+        self.info("RBM holdout reconstruction error: %.4f "
+                  "(train-side final %.4f, %d epochs)",
+                  self.holdout_error, self.rbm.reconstruction_error,
+                  self.counter.passes)
+        super(RBMWorkflow, self).on_workflow_finished()
+
+
+def run(load, main):
+    load(RBMWorkflow)
+    main()

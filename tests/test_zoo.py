@@ -2,8 +2,14 @@
 RNN/LSTM vs autodiff, Kohonen convergence, RBM reconstruction,
 AlexNet/VGG construction + one fused step on tiny shapes."""
 
+import os
+import sys
+
 import numpy
 import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "examples"))
 
 import jax
 import jax.numpy as jnp
@@ -304,10 +310,6 @@ def test_kohonen_example_workflow(cpu_device):
     useful unsupervised structure (winner purity well above the 10%
     chance level)."""
     import importlib
-    import os
-    import sys
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))), "examples"))
     module = importlib.import_module("kohonen")
     from veles_tpu.config import root
     from veles_tpu.launcher import Launcher
@@ -321,3 +323,27 @@ def test_kohonen_example_workflow(cpu_device):
         assert wf.purity is not None and wf.purity > 0.5, wf.purity
     finally:
         root.kohonen.epochs = saved_epochs
+
+
+def test_rbm_example_workflow(cpu_device):
+    """The RBM example pretrains on real digits through the graph
+    engine loop and reconstructs held-out digits well below the
+    untrained error."""
+    import importlib
+    module = importlib.import_module("rbm")
+    from veles_tpu.config import root
+    from veles_tpu.launcher import Launcher
+    saved = root.rbm.epochs
+    root.rbm.epochs = 25
+    try:
+        launcher = Launcher()
+        wf = module.RBMWorkflow(launcher)
+        untrained = None
+        launcher.initialize(device=cpu_device)
+        untrained = wf.rbm.reconstruct_error(wf.valid_x)
+        launcher.run()
+        assert wf.holdout_error is not None
+        assert wf.holdout_error < untrained * 0.7, (
+            wf.holdout_error, untrained)
+    finally:
+        root.rbm.epochs = saved

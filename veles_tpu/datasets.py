@@ -160,10 +160,14 @@ class _SplitLoader(FullBatchLoader):
         """-> (train_x, train_y, valid_x, valid_y)"""
         raise NotImplementedError
 
+    WITH_LABELS = True
+
     def load_data(self):
         train_x, train_y, valid_x, valid_y = self.get_arrays()
         self.original_data = numpy.concatenate([valid_x, train_x])
-        self.original_labels = numpy.concatenate([valid_y, train_y])
+        if self.WITH_LABELS:
+            self.original_labels = numpy.concatenate(
+                [valid_y, train_y])
         self.class_lengths[0] = 0
         self.class_lengths[1] = len(valid_x)
         self.class_lengths[2] = len(train_x)
@@ -172,7 +176,11 @@ class _SplitLoader(FullBatchLoader):
 class _SplitLoaderMSE(FullBatchLoaderMSE, _SplitLoader):
     """_SplitLoader layout with reconstruction targets == inputs (the
     autoencoder feed); one copy of the [valid|train] class-window
-    contract for both label and MSE variants."""
+    contract for both label and MSE variants.  Labels are skipped —
+    a reconstruction task would otherwise pay per-step label gathers
+    it never reads."""
+
+    WITH_LABELS = False
 
     def load_data(self):
         super(_SplitLoaderMSE, self).load_data()

@@ -114,3 +114,23 @@ class RBM(Unit):
         self.visible_bias.map_invalidate()
         self.visible_bias.mem = numpy.asarray(new_vb)
         self.reconstruction_error = float(err)
+
+    def reconstruct_error(self, data):
+        """Deterministic mean-field v -> h -> v reconstruction MSE on
+        arbitrary data (the held-out quality readout; no sampling)."""
+        import jax
+        import jax.numpy as jnp
+        for arr in (self.weights, self.hidden_bias,
+                    self.visible_bias):
+            arr.map_read()
+        v = jnp.asarray(numpy.reshape(data, (len(data), -1)),
+                        jnp.float32)
+        h = jax.nn.sigmoid(
+            jnp.dot(v, jnp.asarray(self.weights.mem),
+                    preferred_element_type=jnp.float32) +
+            jnp.asarray(self.hidden_bias.mem))
+        vr = jax.nn.sigmoid(
+            jnp.dot(h, jnp.asarray(self.weights.mem).T,
+                    preferred_element_type=jnp.float32) +
+            jnp.asarray(self.visible_bias.mem))
+        return float(jnp.mean((v - vr) ** 2))

@@ -2,7 +2,8 @@
 
 from veles_tpu.units import Unit
 
-__all__ = ["StartPoint", "EndPoint", "Repeater", "FireStarter"]
+__all__ = ["StartPoint", "EndPoint", "Repeater", "FireStarter",
+           "EpochCounter"]
 
 
 class StartPoint(Unit):
@@ -46,3 +47,28 @@ class FireStarter(StartPoint):
     def run(self):
         for unit in self.units:
             unit._stopped <<= False
+
+
+class EpochCounter(Unit):
+    """Raises ``complete`` after N loop passes — the minimal
+    termination gate for repeater loops that have no Decision unit
+    (SOM/RBM-style unsupervised training).  Pass count resets on
+    (re-)initialize so a snapshot-resumed workflow runs its full
+    budget again rather than terminating immediately."""
+
+    def __init__(self, workflow, epochs, **kwargs):
+        super(EpochCounter, self).__init__(workflow, **kwargs)
+        from veles_tpu.mutable import Bool
+        self.epochs = epochs
+        self.passes = 0
+        self.complete = Bool(False)
+
+    def initialize(self, **kwargs):
+        self.passes = 0
+        self.complete <<= False
+        return super(EpochCounter, self).initialize(**kwargs)
+
+    def run(self):
+        self.passes += 1
+        if self.passes >= self.epochs:
+            self.complete <<= True
