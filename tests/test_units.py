@@ -302,3 +302,87 @@ class TestDistributedContract:
         NotReady(wf, name="nr").link_from(wf.start_point)
         wf.initialize()
         assert wf.generate_data_for_slave("s") is False
+
+
+def test_run_after_finish_raises(cpu_device):
+    """Broken control links surface loudly (reference units.py:823-839
+    RunAfterStopError): a unit driven through the scheduler wrapper
+    after the workflow finished — with no stop requested — raises
+    instead of silently doing nothing."""
+    from veles_tpu.units import RunAfterStopError
+    from tests.test_models import BlobsLoader
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from veles_tpu.prng import RandomGenerator
+
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[{"type": "softmax", "output_sample_shape": 4,
+                 "learning_rate": 0.1, "gradient_moment": 0.9}],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64, prng=RandomGenerator("ras", seed=1)),
+        decision_config=dict(max_epochs=1),
+    )
+    sw.initialize(device=cpu_device)
+    sw.run()
+    assert sw.finished and not sw.stop_requested
+    with pytest.raises(RunAfterStopError):
+        sw.loader._timed_run()
+    # an explicit stop() is NOT an error: suppressed quietly
+    sw.stop()
+    assert sw.loader._timed_run() is False
+
+
+def test_workflow_leaves_no_uncollectable_garbage(cpu_device):
+    """Reference-cycle hygiene (the reference converted back-links to
+    weakrefs so dropped workflows free): the unit graph is cyclic by
+    design, so the teeth here are the weakref — a built+run+dropped
+    workflow must actually be reclaimed by gc.collect()."""
+    import gc
+    import weakref
+
+    from tests.test_models import BlobsLoader
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from veles_tpu.prng import RandomGenerator
+
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[{"type": "softmax", "output_sample_shape": 4,
+                 "learning_rate": 0.1, "gradient_moment": 0.9}],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64, prng=RandomGenerator("gcw", seed=2)),
+        decision_config=dict(max_epochs=1),
+    )
+    sw.initialize(device=cpu_device)
+    sw.run()
+    ref = weakref.ref(sw)
+    wf.workflow.del_ref(sw)
+    del sw
+    del wf
+    gc.collect()
+    assert ref() is None, "workflow survived del + gc.collect"
+
+
+def test_stopped_workflow_reruns(cpu_device):
+    """stop() then run() executes the graph again (per-job reruns on
+    slaves depend on this): the units' own stop flags reset, so the
+    second run is real, not a silently suppressed phantom."""
+    from tests.test_models import BlobsLoader
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from veles_tpu.prng import RandomGenerator
+
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[{"type": "softmax", "output_sample_shape": 4,
+                 "learning_rate": 0.1, "gradient_moment": 0.9}],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64, prng=RandomGenerator("rr", seed=3)),
+        decision_config=dict(max_epochs=1),
+    )
+    sw.initialize(device=cpu_device)
+    sw.stop()
+    runs_before = sw.loader.run_calls
+    sw.run()
+    assert sw.loader.run_calls > runs_before, "phantom run after stop"

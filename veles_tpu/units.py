@@ -22,7 +22,15 @@ from veles_tpu.config import root
 from veles_tpu.distributable import Distributable
 from veles_tpu.mutable import Bool, LinkableAttribute
 
-__all__ = ["Unit", "IUnit", "UnitRegistry", "nothing"]
+__all__ = ["Unit", "IUnit", "UnitRegistry", "RunAfterStopError",
+           "nothing"]
+
+
+
+class RunAfterStopError(RuntimeError):
+    """A unit was scheduled to run after its workflow FINISHED without
+    any stop request — a broken control-flow link (reference
+    units.py:823-839 raised the same on post-stop runs)."""
 
 
 class UnitRegistry(type):
@@ -277,6 +285,13 @@ class Unit(Distributable, metaclass=UnitRegistry):
             raise RuntimeError("%s.run() before initialize()" % self)
         if self.stopped or (self.workflow is not None and
                             self.workflow.stopped):
+            wf = self.workflow
+            if (wf is not None and
+                    getattr(wf, "finished", False) and
+                    not getattr(wf, "stop_requested", True)):
+                raise RunAfterStopError(
+                    "%s scheduled to run after the workflow finished "
+                    "— check its control links" % self)
             return False
         start = time.time()
         self.run()

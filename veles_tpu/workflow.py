@@ -54,6 +54,7 @@ class Workflow(Unit):
         self._finished_ = threading.Event()
         self._running_ = False
         self._run_time_ = 0.0
+        self._stop_requested_ = False
         self.restored_from_snapshot_ = False
 
     # -- container behavior ------------------------------------------------
@@ -145,9 +146,18 @@ class Workflow(Unit):
         with self._queue_lock_:
             self._worklist_.append((dst, src))
 
+    @property
+    def finished(self):
+        return self._finished_.is_set()
+
+    @property
+    def stop_requested(self):
+        return self._stop_requested_
+
     def run(self):
         """Execute the graph from start_point until end_point fires."""
         self._stopped <<= False
+        self._stop_requested_ = False
         self._finished_.clear()
         self._running_ = True
         with self._queue_lock_:
@@ -158,6 +168,10 @@ class Workflow(Unit):
         for unit in self._units:
             if unit is self:
                 continue
+            # a previous stop() set every unit's own stop flag; a new
+            # run must clear them or the whole graph is silently
+            # suppressed and the drained queue fakes a finished run
+            unit._stopped <<= False
             with unit._gate_lock_:
                 for key in unit._links_from:
                     unit._links_from[key] = False
@@ -191,6 +205,7 @@ class Workflow(Unit):
                 on_finished()
 
     def stop(self):
+        self._stop_requested_ = True
         self._stopped <<= True
         self._finished_.set()
         for unit in self._units:
