@@ -33,6 +33,7 @@ __all__ = ["Device", "TPUDevice", "CPUDevice", "NumpyDevice",
 
 class BackendRegistry(type):
     backends = {}
+    _demotion_warned = False
 
     def __init__(cls, name, bases, namespace):
         super(BackendRegistry, cls).__init__(name, bases, namespace)
@@ -56,13 +57,26 @@ class Device(Pickleable, metaclass=BackendRegistry):
                 root.common.engine.get("backend", "auto")
         if backend == "auto":
             chosen = None
+            skipped = []
             for sub in sorted(BackendRegistry.backends.values(),
                               key=lambda c: -c.PRIORITY):
                 if sub.available():
                     chosen = sub
                     break
+                skipped.append(sub.__name__)
             if chosen is None:
                 raise RuntimeError("no available backend")
+            if skipped and not BackendRegistry._demotion_warned:
+                # a transiently-failing accelerator (e.g. a tunneled
+                # chip mid-restart) must not demote the run silently;
+                # once per process — a CPU-only host would otherwise
+                # repeat this for every Device() and drown the signal
+                BackendRegistry._demotion_warned = True
+                import logging
+                logging.getLogger("Device").warning(
+                    "auto backend selected %s; higher-priority "
+                    "backend(s) unavailable: %s", chosen.__name__,
+                    ", ".join(skipped))
             return super(Device, chosen).__new__(chosen)
         try:
             sub = BackendRegistry.backends[backend]

@@ -137,7 +137,10 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.init_multihost()
         if device is None or isinstance(device, str):
             from veles_tpu.backends import Device
-            device = Device(backend=device or "auto")
+            # backend=None lets Device resolve VELES_BACKEND /
+            # root.common.engine.backend (where the CLI's -d lands)
+            # before falling back to auto
+            device = Device(backend=device)
         self.device = device
         self.info("initializing workflow %s on %s (%s mode)",
                   self._workflow.name, device, self.workflow_mode)

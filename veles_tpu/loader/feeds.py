@@ -80,6 +80,7 @@ class ZeroMQLoader(QueueLoader):
         self.endpoint = None
         self._socket = None
         self._thread = None
+        self.restartable = False  # stop() closes the socket for good
 
     def initialize(self, **kwargs):
         import pickle

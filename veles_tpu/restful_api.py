@@ -26,6 +26,7 @@ class RESTfulAPI(Unit):
         self._params = None
         self._server_ = None
         self.requests_served = 0
+        self.restartable = False  # stop() shuts the HTTP server down
 
     def initialize(self, **kwargs):
         super(RESTfulAPI, self).initialize(**kwargs)

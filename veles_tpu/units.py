@@ -95,6 +95,11 @@ class Unit(Distributable, metaclass=UnitRegistry):
         self._ignores_gate = Bool(False)
         self._initialized = Bool(False)
         self._stopped = Bool(False)
+        #: a re-run may clear this unit's stop flag; units whose stop()
+        #: permanently tears down resources (sockets, server threads)
+        #: set this False so a rerun leaves them suppressed instead of
+        #: hanging on a dead resource
+        self.restartable = True
         self._ran = False
         self._demanded = set()
         self.timers = {"run": 0.0}

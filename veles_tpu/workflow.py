@@ -171,7 +171,10 @@ class Workflow(Unit):
             # a previous stop() set every unit's own stop flag; a new
             # run must clear them or the whole graph is silently
             # suppressed and the drained queue fakes a finished run
-            unit._stopped <<= False
+            # (non-restartable units keep it: their stop() tore down
+            # resources a rerun cannot revive)
+            if getattr(unit, "restartable", True):
+                unit._stopped <<= False
             with unit._gate_lock_:
                 for key in unit._links_from:
                     unit._links_from[key] = False
@@ -391,6 +394,8 @@ class AcceleratedWorkflow(Workflow):
     def initialize(self, device=None, **kwargs):
         if device is None:
             from veles_tpu.backends import Device
-            device = Device(backend="auto")
+            # backend=None -> VELES_BACKEND / root.common.engine
+            # resolution, same as the launcher
+            device = Device(backend=None)
         return super(AcceleratedWorkflow, self).initialize(
             device=device, **kwargs)
