@@ -257,3 +257,18 @@ def test_frontend_composer_serves_and_launches(tmp_path):
         assert status["returncode"] == 0
     finally:
         server.stop()
+
+
+def test_dump_unit_attributes():
+    """--dump-unit-attributes prints the per-unit attribute table
+    (reference __main__.py:663) after initialize, without running."""
+    out = _run_cli("examples/digits.py", "-", "-d", "cpu",
+                   "--dump-unit-attributes")
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "DigitsLoader" in out.stdout
+    assert "class_lengths" in out.stdout
+    # positive no-training signal: every unit still has zero runs
+    runs = [line for line in out.stdout.splitlines()
+            if " run_calls " in line]
+    assert runs and all(line.rstrip().endswith(" 0")
+                        for line in runs), runs[:5]

@@ -54,6 +54,13 @@ class Main(object):
         parser.add_argument("--dump-graph", default=None,
                             help="write the graphviz dot file and exit")
         parser.add_argument(
+            "--dump-unit-attributes", default=None, nargs="?",
+            const="no-arrays", choices=("all", "no-arrays"),
+            metavar="all|no-arrays",
+            help="after initialize, print every unit's attributes "
+                 "(reference __main__.py:663) and exit; 'all' includes "
+                 "large array contents")
+        parser.add_argument(
             "--optimize", default=None, metavar="GENS:POP",
             help="genetic hyper-parameter optimization: the workflow "
                  "module must expose tunable_spec() and fitness(spec)")
@@ -77,6 +84,32 @@ class Main(object):
             help="daemonize: detach and keep running after the "
                  "terminal closes (log goes to --log-file)")
         return parser
+
+    @staticmethod
+    def _dump_unit_attributes(workflow, arrays=False):
+        """Aligned dump of every unit's public attributes (reference
+        __main__.py:663-685 used prettytable; plain columns here)."""
+        rows = []
+        for i, unit in enumerate(workflow.units_in_dependency_order):
+            for key in sorted(vars(unit)):
+                if key.startswith("_"):
+                    continue
+                value = vars(unit)[key]
+                if (not arrays and hasattr(value, "__len__")
+                        and not isinstance(value, (str, bytes))
+                        and len(value) > 32):
+                    text = "<%s of length %d>" % (
+                        type(value).__name__, len(value))
+                else:
+                    text = repr(value)
+                if len(text) > 100:
+                    text = text[:97] + "..."
+                rows.append((str(i), type(unit).__name__, key, text))
+        widths = [max(len(r[c]) for r in rows) for c in range(3)]
+        for row in rows:
+            print("%*s  %-*s  %-*s  %s" % (
+                widths[0], row[0], widths[1], row[1],
+                widths[2], row[2], row[3]))
 
     def _run_frontend(self, parser, port):
         from veles_tpu.frontend import FrontendServer
@@ -243,6 +276,11 @@ class Main(object):
                     fout.write(state["workflow"].generate_graph())
                 return
             launcher.initialize(**kwargs)
+            if args.dump_unit_attributes:
+                self._dump_unit_attributes(
+                    state["workflow"],
+                    arrays=args.dump_unit_attributes == "all")
+                return
             if args.dry_run == "init":
                 return
             launcher.run()
