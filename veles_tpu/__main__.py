@@ -97,6 +97,7 @@ class Main(object):
                 value = vars(unit)[key]
                 if (not arrays and hasattr(value, "__len__")
                         and not isinstance(value, (str, bytes))
+                        and getattr(value, "ndim", 1) != 0
                         and len(value) > 32):
                     text = "<%s of length %d>" % (
                         type(value).__name__, len(value))
