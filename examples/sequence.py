@@ -1,0 +1,58 @@
+"""Recurrent sequence classification on real handwritten digits
+(reference algorithm family: manualrst_veles_algorithms.rst RNN/LSTM,
+which the reference shipped untested — here the path is exercised end
+to end): each 8x8 digit is fed as a sequence of 8 row-vectors, an LSTM
+consumes the rows, and a softmax head classifies the final state.
+
+    python -m veles_tpu examples/sequence.py
+"""
+
+from veles_tpu.config import root
+from veles_tpu.datasets import DigitsLoader
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+
+root.sequence.update({
+    "hidden": 48,
+    "learning_rate": 0.05,
+    "gradient_moment": 0.9,
+    "minibatch_size": 48,
+    "max_epochs": 60,
+    "fail_iterations": 15,
+})
+
+
+class DigitsRowsLoader(DigitsLoader):
+    """Serves digits reshaped (batch, 8, 8): a sequence of 8 rows."""
+
+    def load_data(self):
+        super(DigitsRowsLoader, self).load_data()
+        data = self.original_data.mem
+        self.original_data = data.reshape(len(data), 8, 8)
+
+
+def build(launcher):
+    cfg = root.sequence
+    return StandardWorkflow(
+        launcher,
+        layers=[
+            {"type": "lstm", "hidden_size": cfg.hidden,
+             "return_sequences": False,
+             "learning_rate": cfg.learning_rate,
+             "gradient_moment": cfg.gradient_moment},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": cfg.learning_rate,
+             "gradient_moment": cfg.gradient_moment},
+        ],
+        loader_factory=lambda w: DigitsRowsLoader(
+            w, minibatch_size=cfg.minibatch_size,
+            prng=RandomGenerator("sequence", seed=21)),
+        decision_config=dict(max_epochs=cfg.max_epochs,
+                             fail_iterations=cfg.fail_iterations),
+        result_file=root.common.get("result_file"),
+    )
+
+
+def run(load, main):
+    load(build)
+    main()

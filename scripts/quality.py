@@ -86,6 +86,9 @@ def main():
 
     report = {"targets": {
         "digits": {"note": "offline anchor, no reference number"},
+        "sequence": {"note": "LSTM over digit rows; the reference "
+                             "shipped RNN/LSTM untested — no number "
+                             "to match, anchor is ours"},
         "autoencoder": {"reference_rmse": 0.5478,
                         "source": "manualrst_veles_algorithms.rst:69",
                         "note": "reference number is MNIST; offline "
@@ -101,6 +104,11 @@ def main():
     print("digits: %.2f%% (epoch %d)" % (
         report["results"]["digits"]["best_error_pct"],
         report["results"]["digits"]["best_epoch"]))
+
+    seq = run_example("sequence", args.backend)
+    report["results"]["sequence"] = seq
+    print("sequence (LSTM): %.2f%% (epoch %d)" % (
+        seq["best_error_pct"], seq["best_epoch"]))
 
     ae = run_example("autoencoder", args.backend)
     ae["best_rmse"] = ae.pop("best_error_pct")

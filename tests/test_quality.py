@@ -119,3 +119,26 @@ def test_autoencoder_reconstructs_digits(cpu_device):
     # measured 0.1256 on plain CPU; generous headroom for backend and
     # mesh-size numeric drift, still far under the reference MNIST 0.5478
     assert best < 0.2, best
+
+
+@pytest.mark.slow
+def test_lstm_sequence_classification(cpu_device):
+    """LSTM over digit-row sequences (the reference shipped RNN/LSTM
+    untested; this pins our recurrent training path on real data)."""
+    import importlib
+
+    from veles_tpu.config import root
+    from veles_tpu.launcher import Launcher
+
+    module = importlib.import_module("sequence")
+    saved = root.sequence.max_epochs
+    root.sequence.max_epochs = 25
+    try:
+        launcher = Launcher()
+        wf = module.build(launcher)
+        launcher.initialize(device=cpu_device)
+        launcher.run()
+        best = wf.decision.best_metric
+        assert best is not None and best < 5.0, best
+    finally:
+        root.sequence.max_epochs = saved
