@@ -89,6 +89,9 @@ def main():
         "sequence": {"note": "LSTM over digit rows; the reference "
                              "shipped RNN/LSTM untested — no number "
                              "to match, anchor is ours"},
+        "conv_autoencoder": {"note": "conv+deconv reconstruction on "
+                                     "digits (reference family: conv "
+                                     "autoencoders)"},
         "autoencoder": {"reference_rmse": 0.5478,
                         "source": "manualrst_veles_algorithms.rst:69",
                         "note": "reference number is MNIST; offline "
@@ -115,6 +118,12 @@ def main():
     report["results"]["autoencoder"] = ae
     print("autoencoder: RMSE %.4f (epoch %d)" % (
         ae["best_rmse"], ae["best_epoch"]))
+
+    cae = run_example("conv_autoencoder", args.backend)
+    cae["best_rmse"] = cae.pop("best_error_pct")
+    report["results"]["conv_autoencoder"] = cae
+    print("conv_autoencoder: RMSE %.4f (epoch %d)" % (
+        cae["best_rmse"], cae["best_epoch"]))
 
     for name, skip in (("mnist", args.skip_mnist),
                        ("cifar10", args.skip_cifar)):

@@ -142,3 +142,28 @@ def test_lstm_sequence_classification(cpu_device):
         assert best is not None and best < 5.0, best
     finally:
         root.sequence.max_epochs = saved
+
+
+@pytest.mark.slow
+def test_conv_autoencoder_reconstructs_digits(cpu_device):
+    """Convolutional autoencoder (reference family: conv autoencoders):
+    conv encode + deconv decode on real digits, pinned well below the
+    MLP autoencoder's RMSE."""
+    import importlib
+
+    from veles_tpu.config import root
+    from veles_tpu.launcher import Launcher
+
+    module = importlib.import_module("conv_autoencoder")
+    saved = root.conv_ae.max_epochs
+    root.conv_ae.max_epochs = 15
+    try:
+        launcher = Launcher()
+        wf = module.build(launcher)
+        launcher.initialize(device=cpu_device)
+        launcher.run()
+        best = wf.decision.best_metric
+        # 4x spatial bottleneck: measured 0.114 at full epochs
+        assert best is not None and best < 0.2, best
+    finally:
+        root.conv_ae.max_epochs = saved
