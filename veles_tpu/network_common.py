@@ -165,12 +165,17 @@ class ShmChannel(object):
     owner-only permissions, so the OS user boundary is the protection.
     """
 
+    #: names created by THIS process (attach must not unregister them)
+    _local_creations = set()
+
     def __init__(self, shm, created):
         self._shm = shm
         self._created = created
         self._slot = 0
         self.name = shm.name
         self.slot_size = shm.size // 2
+        if created:
+            ShmChannel._local_creations.add(shm.name)
 
     @classmethod
     def create(cls, size):
@@ -182,7 +187,20 @@ class ShmChannel(object):
     @classmethod
     def attach(cls, name):
         from multiprocessing import shared_memory
-        return cls(shared_memory.SharedMemory(name=name), created=False)
+        shm = shared_memory.SharedMemory(name=name)
+        if name not in cls._local_creations:
+            # The CREATOR owns the segment's lifetime (it unlinks in
+            # close()); Python auto-registers every open with the
+            # resource tracker, which then warns about the creator's
+            # segment at CROSS-process attacher exit.  A same-process
+            # attach (tests) shares the creator's tracker entry and
+            # must leave it alone.
+            try:
+                from multiprocessing import resource_tracker
+                resource_tracker.unregister(shm._name, "shared_memory")
+            except Exception:
+                pass
+        return cls(shm, created=False)
 
     def write(self, raw):
         """Write bytes into the next slot -> (offset, length), or None
@@ -204,6 +222,7 @@ class ShmChannel(object):
             self._shm.close()
             if self._created:
                 self._shm.unlink()
+                ShmChannel._local_creations.discard(self.name)
         except Exception:
             pass
 
