@@ -272,3 +272,48 @@ def test_dump_unit_attributes():
             if " run_calls " in line]
     assert runs and all(line.rstrip().endswith(" 0")
                         for line in runs), runs[:5]
+
+
+
+def test_cli_snapshot_and_crash_resume(wf_file, tmp_path):
+    """--snapshot-dir auto-wires a Snapshotter into StandardWorkflow
+    (the reference put one in every standard workflow); killing the
+    process mid-training and restoring the _current symlink with -w
+    resumes and finishes the remaining epochs."""
+    import time
+
+    snaps = tmp_path / "snaps"
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", VELES_BACKEND="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "veles_tpu", wf_file, "-", "-d", "cpu",
+         "root.cli_test.max_epochs=60",
+         "--snapshot-dir", str(snaps)],
+        env=env, cwd="/root/repo",
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        # wait for the first checkpoint, then crash the trainer
+        deadline = time.time() + 180
+        current = None
+        while time.time() < deadline:
+            if snaps.is_dir():
+                found = [p for p in snaps.iterdir()
+                         if "current" in p.name]
+                if found:
+                    current = found[0]
+                    break
+            time.sleep(0.5)
+        assert current is not None, "no snapshot appeared"
+    finally:
+        proc.kill()
+        proc.wait()
+
+    resumed = _run_cli(wf_file, "-", "-d", "cpu", "-w", str(current),
+                       "--result-file", str(tmp_path / "r2.json"))
+    assert resumed.returncode == 0, resumed.stderr[-1500:]
+    r2 = json.loads((tmp_path / "r2.json").read_text())
+    # the resumed session trained on to the snapshot's own stopping
+    # criterion — far past wherever the crash landed
+    assert r2["Total epochs"] == 60, r2
+    assert r2["Best metric"] is not None

@@ -144,6 +144,24 @@ class StandardWorkflow(Workflow):
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
 
+        # standard snapshotting: when the config names a snapshot dir
+        # (e.g. the CLI's --snapshot-dir), every improvement checkpoints
+        # automatically — the reference wired a Snapshotter into every
+        # standard workflow; restore with -w <file>
+        self.snapshotter = None
+        from veles_tpu.config import root as _root
+        if _root.common.snapshot.get("dir"):
+            from veles_tpu.snapshotter import Snapshotter
+            self.snapshotter = Snapshotter(
+                self, prefix=type(self).__name__)
+            self.snapshotter.link_from(self.decision)
+            self.snapshotter.gate_skip = ~self.decision.improved
+            # the exit gate also waits on the snapshotter (reference
+            # topology decision -> snapshotter -> end): otherwise the
+            # worklist is abandoned at end_point before a queued
+            # final-epoch snapshot runs
+            self.end_point.link_from(self.snapshotter)
+
     def fuse(self, **kwargs):
         """Swap the per-unit chain for the single-dispatch fused train
         step (veles_tpu.models.fused); call before initialize()."""

@@ -139,6 +139,26 @@ class LinkableAttribute(object):
     #: aliases survive snapshot/restore.
     TABLE = "_linked_attrs"
 
+    @classmethod
+    def reinstall(cls, obj):
+        """Ensure class-level descriptors exist for every pickled link.
+
+        A snapshot restored in a FRESH process carries the
+        per-instance link table, but the descriptors were installed on
+        the original process's class object — without this, restored
+        units lose every data alias and re-initialize fails on
+        unsatisfied demands."""
+        table = obj.__dict__.get(cls.TABLE)
+        if not table:
+            return
+        klass = type(obj)
+        for name in table:
+            if not isinstance(klass.__dict__.get(name),
+                              _LinkDescriptor):
+                setattr(klass, name, _LinkDescriptor(name))
+            # a plain instance attribute would shadow the descriptor
+            obj.__dict__.pop(name, None)
+
     def __init__(self, obj, name, source_obj, source_name,
                  two_way=False, assignment_guard=True):
         self.name = name

@@ -174,7 +174,11 @@ class SnapshotterBase(Unit):
             return
         if self._counter % self.interval:
             return
-        if time.time() - self._last_time < self.time_interval:
+        # time_interval throttles REPEAT snapshots; the first one is
+        # exempt, else a short run (or a crash before time_interval
+        # elapses) leaves nothing on disk to resume from
+        if self.destination is not None and \
+                time.time() - self._last_time < self.time_interval:
             return
         self._last_time = time.time()
         self.export()
@@ -220,19 +224,43 @@ class SnapshotterBase(Unit):
                                    pickle.HIGHEST_PROTOCOL, ext))
 
     def _update_current_link(self):
+        # atomic replace: _current is the canonical crash-resume
+        # target, so there must never be a window without it
         link = os.path.join(self.directory, "%s_current" % self.prefix)
+        temp = link + ".tmp"
         try:
-            if os.path.islink(link):
-                os.remove(link)
-            os.symlink(os.path.basename(self.destination), link)
+            try:
+                os.remove(temp)
+            except FileNotFoundError:
+                pass
+            os.symlink(os.path.basename(self.destination), temp)
+            os.replace(temp, link)
         except OSError:
             pass
 
     @staticmethod
     def import_file(path):
-        """Restore a workflow object from a snapshot file."""
-        ext = os.path.splitext(path)[1].lstrip(".")
-        codec = ext if ext in CODECS else ""
+        """Restore a workflow object from a snapshot file.
+
+        The codec is sniffed from the file's magic bytes, not the
+        extension — the ``_current`` symlink (the natural -w target)
+        carries no extension."""
+        with open(path, "rb") as probe:
+            magic = probe.read(10)
+        if magic[:2] == b"\x1f\x8b":
+            codec = "gz"
+        elif magic[:3] == b"BZh":
+            codec = "bz2"
+        elif magic[:6] == b"\xfd7zXZ\x00":
+            codec = "xz"
+        elif magic.startswith(b"\xff\x06\x00\x00sNaPpY") and \
+                "snappy" in CODECS:
+            codec = "snappy"
+        else:
+            # unknown magic: fall back to the extension (covers plain
+            # pickles and any codec the sniff list lags behind)
+            ext = os.path.splitext(path)[1].lstrip(".")
+            codec = ext if ext in CODECS else ""
         _, opener = CODECS[codec]
         with opener(path) as fin:
             return pickle.load(fin)

@@ -114,6 +114,10 @@ class Unit(Distributable, metaclass=UnitRegistry):
         self._gate_lock_ = threading.RLock()
         self._run_lock_ = threading.RLock()
         self._is_initialized_ = False
+        # data aliases need their class-level descriptors back when the
+        # snapshot lands in a process that never built this graph
+        from veles_tpu.mutable import LinkableAttribute
+        LinkableAttribute.reinstall(self)
 
     def __repr__(self):
         return "<%s \"%s\">" % (type(self).__name__, self.name or
