@@ -138,3 +138,50 @@ def test_git_backed_forge_roundtrip(tmp_path):
         assert (tmp_path / "hub" / "demo" / ".git").is_dir()
     finally:
         server.stop()
+
+
+def test_git_backed_forge_out_of_order_uploads(tmp_path):
+    """Backfilling an older version after a newer one must not change
+    what "latest" serves: payload, X-Package-Version, details, and
+    index all keep agreeing on the numerically greatest version
+    (advisor finding, round 2)."""
+    import shutil
+    if shutil.which("git") is None:
+        pytest.skip("git unavailable")
+    from veles_tpu.forge.server import ForgeServer
+
+    server = ForgeServer(str(tmp_path / "hub"), git_backed=True)
+    v110 = b"NEW" * 50
+    v101 = b"OLD-BACKFILL" * 50
+    server.store("demo", "1.1.0", v110)
+    server.store("demo", "1.0.1", v101)  # worktree now holds 1.0.1
+
+    payload, version = server.load("demo", "latest")
+    assert version == "1.1.0"
+    assert payload == v110
+    assert server.index()[0]["version"] == "1.1.0"
+    # the backfilled version is still fetchable byte-exact
+    payload, version = server.load("demo", "1.0.1")
+    assert (payload, version) == (v101, "1.0.1")
+
+
+def test_forge_versions_sort_numerically(tmp_path):
+    """'1.10.0' must beat '1.9.0' for latest (advisor finding: naive
+    lexicographic sort breaks at two-digit components), on both the
+    plain-directory and git-backed paths."""
+    import shutil
+    from veles_tpu.forge.server import ForgeServer
+
+    plain = ForgeServer(str(tmp_path / "plain"))
+    plain.store("p", "1.9.0", b"nine")
+    plain.store("p", "1.10.0", b"ten")
+    assert plain.versions("p") == ["1.9.0", "1.10.0"]
+    assert plain.load("p", "latest") == (b"ten", "1.10.0")
+
+    if shutil.which("git") is None:
+        pytest.skip("git unavailable")
+    hub = ForgeServer(str(tmp_path / "hub"), git_backed=True)
+    hub.store("p", "1.10.0", b"ten")
+    hub.store("p", "1.9.0", b"nine")
+    assert hub.versions("p") == ["1.9.0", "1.10.0"]
+    assert hub.load("p", "latest") == (b"ten", "1.10.0")

@@ -13,8 +13,10 @@ reference).  Served endpoints:
   GET  /fetch?name=N[&version=V]            -> package bytes (latest)
   POST /upload?name=N&version=V             -> store package (body)
 
-Versions order lexicographically ("1.0.0" style); "latest" resolves to
-the highest.
+Versions order by natural numeric sort ("1.9.0" < "1.10.0"); "latest"
+resolves to the numerically greatest version everywhere — index,
+details, and fetch agree (reference forge_server.py resolved latest
+from one place too, git HEAD).
 """
 
 import json
@@ -35,6 +37,12 @@ def _safe_component(value, what):
     if not _SAFE_COMPONENT.match(value or "") or ".." in value:
         raise ValueError("illegal %s %r" % (what, value))
     return value
+
+
+def _version_key(version):
+    # natural sort: "1.10.0" > "1.9.0"
+    return [int(part) if part.isdigit() else part
+            for part in re.split(r"(\d+)", version)]
 
 
 class ForgeServer(Logger):
@@ -115,7 +123,7 @@ class ForgeServer(Logger):
                     "migrate them or run without git_backed" % name)
             return []
         tags = self._git(name, "tag", "--list", "v/*").split()
-        return sorted(t[2:] for t in tags)
+        return sorted((t[2:] for t in tags), key=_version_key)
 
     def _git_show(self, name, version, filename, binary=False):
         return self._git(
@@ -141,7 +149,7 @@ class ForgeServer(Logger):
                             _safe_component(name, "package name"))
         if not os.path.isdir(pdir):
             return []
-        return sorted(os.listdir(pdir))
+        return sorted(os.listdir(pdir), key=_version_key)
 
     def store(self, name, version, payload, metadata=None):
         meta = dict(metadata or {})
@@ -161,18 +169,30 @@ class ForgeServer(Logger):
         self.info("stored %s==%s (%d bytes)", name, version,
                   len(payload))
 
+    def _worktree_version(self, name):
+        """Version held by the git worktree (= most recent upload),
+        or None."""
+        path = os.path.join(
+            self.root_dir, _safe_component(name, "package name"),
+            "metadata.json")
+        try:
+            with open(path) as fin:
+                return json.load(fin).get("version")
+        except (OSError, ValueError):
+            return None
+
     def load(self, name, version="latest"):
-        latest_known = False
         if version == "latest":
             versions = self.versions(name)
             if not versions:
                 raise KeyError("unknown package %s" % name)
             version = versions[-1]
-            latest_known = True
         if self.git_backed:
-            if latest_known:
-                # the worktree already holds the newest files: no
-                # extra git spawns on the hot fetch path
+            if version == self._worktree_version(name):
+                # worktree fast path, but only when it actually holds
+                # the requested version — out-of-order uploads (1.0.1
+                # backfilled after 1.1.0) leave the worktree behind
+                # "latest" and must go through the tag
                 pdir = os.path.join(
                     self.root_dir,
                     _safe_component(name, "package name"))
@@ -201,13 +221,17 @@ class ForgeServer(Logger):
         out = []
         for name in sorted(os.listdir(self.root_dir)):
             if self.git_backed:
-                # worktree holds the latest metadata — one file read
-                # per package instead of two git spawns
-                path = os.path.join(self.root_dir, name,
-                                    "metadata.json")
-                if os.path.isfile(path):
+                versions = self.versions(name)
+                if not versions:
+                    continue
+                if versions[-1] == self._worktree_version(name):
+                    # worktree fast path: one file read, no git show
+                    path = os.path.join(self.root_dir, name,
+                                        "metadata.json")
                     with open(path) as fin:
                         out.append(json.load(fin))
+                else:
+                    out.append(self.metadata(name, versions[-1]))
                 continue
             versions = self.versions(name)
             if versions:
