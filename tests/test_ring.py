@@ -54,6 +54,19 @@ def test_ring_attention_2d_mesh_with_dp():
     numpy.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("fn", [ring_attention, ulysses_attention])
+def test_attention_data_axis_shards_batch(fn):
+    """data_axis= shards the batch over a second mesh axis (the dp x sp
+    layout the 64-device dryrun runs pod-shaped) and stays exact."""
+    rng = numpy.random.RandomState(4)
+    q, k, v = _qkv(rng, batch=4, seq=32, heads=4)
+    mesh = make_mesh({"data": 2, "seq": 4})
+    want = numpy.asarray(attention_reference(q, k, v, causal=True))
+    got = numpy.asarray(fn(q, k, v, mesh, causal=True,
+                           data_axis="data"))
+    numpy.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
 def test_ring_attention_gradients_flow():
     rng = numpy.random.RandomState(3)
     q, k, v = _qkv(rng, batch=1, seq=32, heads=2, depth=8)

@@ -70,8 +70,13 @@ def shard_moe_params(mesh, params, axis="expert"):
     return out
 
 
-def moe_apply(params, x, mesh, top_k=2, axis="expert"):
-    """Expert-parallel forward: (B, F) -> (B, out)."""
+def moe_apply(params, x, mesh, top_k=2, axis="expert",
+              data_axis=None):
+    """Expert-parallel forward: (B, F) -> (B, out).
+
+    ``data_axis``: optionally shard tokens over a second mesh axis
+    (dp x ep) — the gate-weighted combine still psums over the expert
+    axis only; no cross-row traffic."""
     n_shards = mesh.shape[axis]
 
     def sharded(params_local, x_full):
@@ -93,6 +98,6 @@ def moe_apply(params, x, mesh, top_k=2, axis="expert"):
     fn = jax.shard_map(
         sharded, mesh=mesh,
         in_specs=({"gate": P(), "w1": P(axis), "b1": P(axis),
-                   "w2": P(axis), "b2": P(axis)}, P()),
-        out_specs=P(), check_vma=False)
+                   "w2": P(axis), "b2": P(axis)}, P(data_axis)),
+        out_specs=P(data_axis), check_vma=False)
     return fn(params, x)

@@ -38,15 +38,24 @@ def stage_param_sharding(mesh, params_stacked, axis="pipe"):
 
 
 def pipeline_forward(stage_fn, params_stacked, x, mesh, microbatches,
-                     axis="pipe"):
+                     axis="pipe", data_axis=None):
     """Run x (B, ...) through P pipelined stages; returns (B, ...).
 
     stage_fn(stage_params, activation) -> activation (same shape).
     params_stacked: pytree, leading dim = number of stages, sharded
     over ``axis`` (see stage_param_sharding).
+    ``data_axis``: optionally shard the batch dim over a second mesh
+    axis — each data-parallel row runs its own wavefront (dp x pp);
+    stage params replicate across rows.
     """
     n_stages = mesh.shape[axis]
     batch = x.shape[0]
+    if data_axis is not None:
+        rows = mesh.shape[data_axis]
+        if batch % rows:
+            raise ValueError("batch %d %% %s rows %d != 0" %
+                             (batch, data_axis, rows))
+        batch //= rows  # per-row batch, as seen inside shard_map
     if batch % microbatches:
         raise ValueError("batch %d %% microbatches %d != 0" %
                          (batch, microbatches))
@@ -81,6 +90,6 @@ def pipeline_forward(stage_fn, params_stacked, x, mesh, microbatches,
 
     fn = jax.shard_map(
         sharded, mesh=mesh,
-        in_specs=(P(axis), P()), out_specs=P(),
+        in_specs=(P(axis), P(data_axis)), out_specs=P(data_axis),
         check_vma=False)
     return fn(params_stacked, x)

@@ -78,8 +78,13 @@ def _ring_body(my_index, n_shards, t_local, axis_name, causal, scale,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False):
-    """q,k,v (B, T, H, D), T sharded over ``seq_axis``."""
+def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False,
+                   data_axis=None):
+    """q,k,v (B, T, H, D), T sharded over ``seq_axis``.
+
+    ``data_axis``: optionally shard the batch dim over a second mesh
+    axis (dp x sp on a pod-shaped mesh) — the ring rides the seq axis
+    within each data-parallel row, no cross-row traffic."""
     scale = 1.0 / float(jnp.sqrt(q.shape[-1]))
     n_shards = mesh.shape[seq_axis]
     t_local = q.shape[1] // n_shards
@@ -89,17 +94,19 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False):
         return _ring_body(my, n_shards, t_local, seq_axis, causal,
                           scale, q_s, k_s, v_s)
 
-    spec = P(None, seq_axis)
+    spec = P(data_axis, seq_axis)
     fn = jax.shard_map(
         sharded, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 
-def ulysses_attention(q, k, v, mesh, seq_axis="seq", causal=False):
+def ulysses_attention(q, k, v, mesh, seq_axis="seq", causal=False,
+                      data_axis=None):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style):
     reshard (T/n, H) -> (T, H/n), run full local attention on the head
-    group, reshard back.  Requires heads %% n_shards == 0."""
+    group, reshard back.  Requires heads %% n_shards == 0.
+    ``data_axis`` additionally shards the batch dim (dp x sp)."""
     n_shards = mesh.shape[seq_axis]
     if q.shape[2] % n_shards:
         raise ValueError("heads %d not divisible by mesh axis %d" %
@@ -119,7 +126,7 @@ def ulysses_attention(q, k, v, mesh, seq_axis="seq", causal=False):
         out = attention_reference(qh, kh, vh, causal=causal)
         return gather_back(out)
 
-    spec = P(None, seq_axis)
+    spec = P(data_axis, seq_axis)
     fn = jax.shard_map(
         sharded, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
