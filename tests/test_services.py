@@ -128,6 +128,17 @@ def test_web_status_roundtrip(trained):
                 "http://127.0.0.1:%d/" % server.port) as r:
             page = r.read().decode()
         assert "sess1" in page
+        # live JS dashboard (reference web/ frontend role): the detail
+        # page embeds the client and the static file serves
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/session/sess1" % server.port) as r:
+            detail = r.read().decode()
+        assert 'data-sid="sess1"' in detail
+        assert "/static/live.js" in detail
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/static/live.js" % server.port) as r:
+            js = r.read().decode()
+        assert "extractSeries" in js and "crosshair" in js
     finally:
         server.stop()
 

@@ -22,18 +22,24 @@ from veles_tpu.logger import Logger
 
 __all__ = ["WebStatusServer", "StatusReporter"]
 
-# Single-series sparklines: one categorical hue, text in text tokens,
-# light/dark from the same ramp (no legend needed for one series).
+# Categorical series palette in fixed order, validated per mode with
+# the dataviz six-checks validator (lightness band, chroma floor, CVD
+# ΔE >= 8 adjacent, normal-vision floor, contrast vs surface); text in
+# text tokens, light/dark selected (not auto-flipped).
 _STYLE = """
 :root {
   color-scheme: light;
   --surface-1: #fcfcfb; --text-primary: #0b0b0b;
-  --text-secondary: #52514e; --grid: #e4e3df; --series-1: #2a78d6;
+  --text-secondary: #52514e; --grid: #e4e3df;
+  --series-1: #2a78d6; --series-2: #d97706;
+  --series-3: #0f8a6d; --series-4: #9d5ad1;
 }
 @media (prefers-color-scheme: dark) {
   :root { color-scheme: dark;
     --surface-1: #1a1a19; --text-primary: #ffffff;
-    --text-secondary: #c3c2b7; --grid: #3a3936; --series-1: #3987e5; }
+    --text-secondary: #c3c2b7; --grid: #3a3936;
+    --series-1: #3987e5; --series-2: #c98000;
+    --series-3: #18a383; --series-4: #a368d6; }
 }
 body { background: var(--surface-1); color: var(--text-primary);
        font: 14px system-ui, sans-serif; margin: 24px; }
@@ -46,6 +52,18 @@ th { color: var(--text-secondary); font-weight: 600; }
 svg.spark polyline { fill: none; stroke: var(--series-1);
                      stroke-width: 2; }
 svg.spark text { fill: var(--text-secondary); font-size: 10px; }
+svg.chart { display: block; margin: 8px 0; }
+svg.chart line.grid { stroke: var(--grid); stroke-width: 1; }
+svg.chart line.cross { stroke: var(--text-secondary);
+                       stroke-width: 1; stroke-dasharray: 3 3; }
+svg.chart text.axis { fill: var(--text-secondary); font-size: 10px; }
+.legend { color: var(--text-secondary); font-size: 12px; }
+.legend span { margin-right: 14px; }
+.swatch { display: inline-block; width: 10px; height: 10px;
+          border-radius: 2px; margin-right: 4px; }
+#tip { visibility: hidden; border: 1px solid var(--grid);
+       background: var(--surface-1); padding: 6px 10px;
+       font-size: 12px; max-width: 420px; }
 """
 
 _INDEX = """<!DOCTYPE html>
@@ -62,13 +80,16 @@ setInterval(function () {
 
 _DETAIL = """<!DOCTYPE html>
 <html><head><title>%(sid)s — veles-tpu</title><style>%(style)s</style>
-</head><body><h1>session %(sid)s</h1>
+</head><body data-sid="%(sid)s"><h1>session %(sid)s</h1>
 <p><a href="/">&larr; all sessions</a></p>
-%(spark)s
-<table><tr><th>time</th><th>epoch</th><th>metrics</th><th>slaves</th>
-</tr>%(rows)s</table>
+<div id="chart">%(spark)s</div>
+<div id="tip"></div>
+<table id="posts"><tr><th>time</th><th>epoch</th><th>metrics</th>
+<th>slaves</th></tr>%(rows)s</table>
 <h1>events</h1>
-<table><tr><th>time</th><th>event</th></tr>%(events)s</table>
+<table id="events"><tr><th>time</th><th>event</th></tr>%(events)s
+</table>
+<script src="/static/live.js"></script>
 </body></html>
 """
 
@@ -334,6 +355,7 @@ class WebStatusServer(Logger):
                         _metric_history(history), width=420, height=64),
                     "rows": rows, "events": events})
 
+        import os
         self.app = tornado.web.Application([
             (r"/update", UpdateHandler),
             (r"/event", EventHandler),
@@ -342,6 +364,9 @@ class WebStatusServer(Logger):
             (r"/events/([^/]+)\.json", EventsHandler),
             (r"/session/([^/]+)", DetailHandler),
             (r"/table", TableHandler),
+            (r"/static/(.*)", tornado.web.StaticFileHandler,
+             {"path": os.path.join(os.path.dirname(
+                 os.path.abspath(__file__)), "web")}),
             (r"/", PageHandler),
         ])
         from veles_tpu.http_util import BackgroundHTTPServer
