@@ -124,13 +124,21 @@ class DecisionGD(DecisionBase):
         self.epoch_n_err = [0, 0, 0]
 
     def _accumulate_minibatch(self):
-        self.epoch_n_err[self.minibatch_class] += self.evaluator.n_err
+        # evaluator.n_err may be a LAZY device scalar — lazy_add keeps
+        # the accumulation an async jitted dispatch; the float() below
+        # is the only sync point
+        from veles_tpu.models.evaluator import lazy_add
+        cls = self.minibatch_class
+        self.epoch_n_err[cls] = lazy_add(self.epoch_n_err[cls],
+                                         self.evaluator.n_err)
 
     def _epoch_class_metric(self, class_index):
         length = self.class_lengths[class_index]
         if length == 0:
             return None
-        return 100.0 * self.epoch_n_err[class_index] / length
+        # forces the device sync (once per finished class, not per
+        # minibatch) and normalizes to a plain float for logs/JSON
+        return float(100.0 * self.epoch_n_err[class_index] / length)
 
     # -- master-slave contract: slaves ship per-job error counts; the
     # master merges them and performs the class/epoch-end bookkeeping
@@ -144,9 +152,16 @@ class DecisionGD(DecisionBase):
         self.complete <<= data.get("complete", False)
 
     def generate_data_for_master(self):
-        delta = list(self.epoch_n_err)
+        # wire payload: concretize any lazy device scalars
+        delta = [int(v) for v in self.epoch_n_err]
         self._reset_epoch_accumulators()
         return {"n_err": delta}
+
+    def __getstate__(self):
+        state = super(DecisionGD, self).__getstate__()
+        if "epoch_n_err" in state:
+            state["epoch_n_err"] = [int(v) for v in self.epoch_n_err]
+        return state
 
     def apply_data_from_slave(self, data, slave=None):
         if not data:
@@ -176,11 +191,21 @@ class DecisionMSE(DecisionBase):
         self.epoch_sse = [0.0, 0.0, 0.0]
 
     def _accumulate_minibatch(self):
-        self.epoch_sse[self.minibatch_class] += self.evaluator.mse_sum
+        from veles_tpu.models.evaluator import lazy_add
+        cls = self.minibatch_class
+        self.epoch_sse[cls] = lazy_add(self.epoch_sse[cls],
+                                       self.evaluator.mse_sum)
 
     def _epoch_class_metric(self, class_index):
         import math
         length = self.class_lengths[class_index]
         if length == 0:
             return None
-        return math.sqrt(self.epoch_sse[class_index] / length)
+        # float() is the once-per-class device sync (see DecisionGD)
+        return math.sqrt(float(self.epoch_sse[class_index]) / length)
+
+    def __getstate__(self):
+        state = super(DecisionMSE, self).__getstate__()
+        if "epoch_sse" in state:
+            state["epoch_sse"] = [float(v) for v in self.epoch_sse]
+        return state

@@ -55,9 +55,27 @@ class FusedTrainer(Unit):
         self._plans = plans
         self._step_fn = build_train_step(
             plans, loss=self.loss, donate=True)
-        self._forward_only = jax.jit(
-            __import__("veles_tpu.compiler", fromlist=["x"])
-            .build_forward(plans))
+        forward = __import__("veles_tpu.compiler", fromlist=["x"]) \
+            .build_forward(plans)
+
+        # eval metrics fused INTO the forward dispatch: one async call
+        # per eval minibatch, no eager ops (each eager op costs a
+        # full remote round trip on a tunneled chip)
+        import jax.numpy as jnp
+        if self.loss == "softmax":
+            def eval_metrics(params, x, labels):
+                out = forward(params, x)
+                valid = labels >= 0
+                pred = jnp.argmax(out, axis=-1)
+                return ((pred != labels) & valid).sum()
+        else:
+            def eval_metrics(params, x, target, batch_size):
+                out = forward(params, x)
+                diff = (out.reshape(out.shape[0], -1) -
+                        target.reshape(target.shape[0], -1))
+                mask = jnp.arange(out.shape[0]) < batch_size
+                return jnp.sum(jnp.mean(diff * diff, axis=1) * mask)
+        self._eval_metrics = jax.jit(eval_metrics)
         self._state = extract_state(self.sw)
         self._has_dropout = any(
             p.static.get("dropout_ratio") is not None for p in plans)
@@ -97,32 +115,31 @@ class FusedTrainer(Unit):
             else:
                 self._state, metrics = self._step_fn(
                     self._state, x, target, batch_size)
-            self.last_loss = float(metrics["loss"])
-            self.n_err = int(metrics["n_err"])
+            # all lazy device scalars: the decision unit forces the
+            # sync once per finished class, so the fused path stays
+            # one async dispatch per step even on a tunneled chip
+            self.last_loss = metrics["loss"]
+            self.n_err = metrics["n_err"]
             # mse_sum from the step's aux metric matches EvaluatorMSE's
             # definition (per-feature mean, summed over samples); the
             # scalar loss is SSE/batch over ALL elements and would
-            # inflate epoch RMSE by sqrt(num_features)
-            self.mse_sum = float(metrics.get(
-                "mse_sum", self.last_loss * float(batch_size)))
+            # inflate epoch RMSE by sqrt(num_features).  The fallback
+            # product only exists inside the conditional — an eager
+            # default arg would dispatch one remote op per step
+            if "mse_sum" in metrics:
+                self.mse_sum = metrics["mse_sum"]
+            elif self.loss != "softmax":
+                self.mse_sum = metrics["loss"] * batch_size
         else:
-            # eval minibatch: forward only, metrics on device
+            # eval minibatch: ONE jitted forward+metrics dispatch,
+            # result stays lazy on device until class end
             params = [{"weights": s["weights"], "bias": s["bias"]}
                       for s in self._state]
-            out = self._forward_only(params, x)
             if self.loss == "softmax":
-                import jax.numpy as jnp
-                labels = target
-                valid = numpy.asarray(labels) >= 0
-                pred = numpy.asarray(jnp.argmax(out, axis=-1))
-                self.n_err = int(
-                    ((pred != numpy.asarray(labels)) & valid).sum())
+                self.n_err = self._eval_metrics(params, x, target)
             else:
-                diff = (numpy.asarray(out).reshape(out.shape[0], -1) -
-                        numpy.asarray(target).reshape(out.shape[0], -1))
-                mask = numpy.arange(out.shape[0]) < int(batch_size)
-                self.mse_sum = float(
-                    (diff[mask] ** 2).mean(axis=1).sum())
+                self.mse_sum = self._eval_metrics(
+                    params, x, target, batch_size)
         self.n_samples = int(batch_size)
 
     def __getstate__(self):
@@ -131,8 +148,13 @@ class FusedTrainer(Unit):
         state = super(FusedTrainer, self).__getstate__()
         state["_step_fn"] = None
         state["_state"] = None
-        state["_forward_only"] = None
+        state["_eval_metrics"] = None
         state["_plans"] = None
+        # concretize lazy device metrics for the pickle
+        state["n_err"] = int(self.n_err)
+        state["mse_sum"] = float(self.mse_sum)
+        if self.last_loss is not None:
+            state["last_loss"] = float(self.last_loss)
         return state
 
 
