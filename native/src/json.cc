@@ -45,15 +45,20 @@ class Parser {
   }
 
   JsonValue ParseValue() {
+    // hostile deeply-nested input must error, not smash the stack
+    if (++depth_ > kMaxDepth) throw Error("json: nesting too deep");
     char c = Peek();
+    JsonValue v;
     switch (c) {
-      case '{': return ParseObject();
-      case '[': return ParseArray();
-      case '"': return ParseString();
-      case 't': case 'f': return ParseBool();
-      case 'n': return ParseNull();
-      default:  return ParseNumber();
+      case '{': v = ParseObject(); break;
+      case '[': v = ParseArray(); break;
+      case '"': v = ParseString(); break;
+      case 't': case 'f': v = ParseBool(); break;
+      case 'n': v = ParseNull(); break;
+      default:  v = ParseNumber(); break;
     }
+    --depth_;
+    return v;
   }
 
   JsonValue ParseObject() {
@@ -167,8 +172,10 @@ class Parser {
     return v;
   }
 
+  static constexpr int kMaxDepth = 256;
   const std::string& text_;
   size_t pos_ = 0;
+  int depth_ = 0;
 };
 
 }  // namespace

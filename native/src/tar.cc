@@ -54,11 +54,18 @@ bool AllZero(const char* block) {
 TarFile::TarFile(const std::string& path) {
   std::ifstream in(path, std::ios::binary);
   if (!in) throw Error("cannot open package " + path);
+  // hostile size fields must not drive multi-GB allocations: no
+  // member can be larger than the archive that contains it
+  in.seekg(0, std::ios::end);
+  const int64_t file_size = static_cast<int64_t>(in.tellg());
+  in.seekg(0, std::ios::beg);
   char block[512];
   while (in.read(block, 512)) {
     if (AllZero(block)) break;  // end-of-archive marker
     const auto* hdr = reinterpret_cast<const UstarHeader*>(block);
     int64_t size = ParseOctal(hdr->size, sizeof(hdr->size));
+    if (size < 0 || size > file_size)
+      throw Error("tar member size field exceeds archive size");
     std::string name(hdr->name, strnlen(hdr->name, sizeof(hdr->name)));
     if (hdr->typeflag == '0' || hdr->typeflag == '\0') {
       std::vector<char> data(static_cast<size_t>(size));

@@ -4,7 +4,9 @@ Counterpart of the reference's libVeles consumption path: a package
 exported by Workflow.package_export is loaded and executed by the C++
 runtime (native/src/), with the greedy strip-packing arena planner and
 the batch-sharding thread-pool engine.  Build uses cmake+make the first
-time and caches the shared library in native/build/.
+time and caches the shared library under the user cache dir (NOT
+inside the repo: CMake drops generated .cpp probes into its build
+tree, which pollutes source-tree audits).
 """
 
 import ctypes
@@ -18,7 +20,28 @@ __all__ = ["NativeWorkflow", "build_native", "native_available"]
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_ROOT, "native")
-_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+
+
+def _source_digest():
+    """Hash of every native source file: the cache key.  An
+    existence-only check against a shared cache dir would keep serving
+    a stale .so across source changes and checkouts."""
+    import hashlib
+    digest = hashlib.sha256()
+    for dirpath, _, filenames in sorted(os.walk(_NATIVE_DIR)):
+        for filename in sorted(filenames):
+            if filename.endswith((".cc", ".h", ".txt")):
+                path = os.path.join(dirpath, filename)
+                digest.update(filename.encode())
+                with open(path, "rb") as fin:
+                    digest.update(fin.read())
+    return digest.hexdigest()[:16]
+
+
+_BUILD_DIR = os.path.join(
+    os.environ.get("XDG_CACHE_HOME",
+                   os.path.expanduser("~/.cache")),
+    "veles_tpu", "native_build", _source_digest())
 _LIB_PATH = os.path.join(_BUILD_DIR, "libveles_tpu_native.so")
 _build_lock = threading.Lock()
 _lib = None
@@ -31,7 +54,7 @@ def build_native(force=False):
             return _LIB_PATH
         os.makedirs(_BUILD_DIR, exist_ok=True)
         subprocess.run(
-            ["cmake", "-DCMAKE_BUILD_TYPE=Release", ".."],
+            ["cmake", "-DCMAKE_BUILD_TYPE=Release", _NATIVE_DIR],
             cwd=_BUILD_DIR, check=True, capture_output=True)
         subprocess.run(
             ["cmake", "--build", ".", "-j"],
