@@ -213,12 +213,15 @@ def bench_matmul(small):
         guard = _rate_guard(info, dtype_name, peak)
         for _ in range(2):
             tflops = 2.0 * n * n * n / per / 1e12
-            if guard is None or tflops <= guard * 1.02 or small:
+            # no grace above the guard: a rate past physical peak is
+            # impossible however slightly (a 2% tolerance once let
+            # 199.6 TF = 101.3% MFU into the record)
+            if guard is None or tflops <= guard or small:
                 break
             per = max(per, _slope(chain, n1, n2 * 2))
         tflops = 2.0 * n * n * n / per / 1e12
         if not small and dtype_name == "float32" and (
-                guard is None or tflops <= guard * 1.02):
+                guard is None or tflops <= guard):
             ceiling = info.get(F32_CEILING_KEY)
             if ceiling is None or tflops > ceiling:
                 # never persist past the physical cap (see _rate_guard)
@@ -360,26 +363,44 @@ def bench_alexnet(small):
 
     from veles_tpu.models.zoo import alexnet_layers
 
-    batch = 32 if small else 128
     size = 67 if small else 227
     dataset = 256 if small else 1024
     peak = _peak_bf16(jax.devices()[0].device_kind)
-    out = {}
-    for dtype_name in ("float32", "bfloat16"):
-        per_step, ips, flops = _train_step_images_per_sec(
-            alexnet_layers(classes=1000 if not small else 10),
-            (size, size, 3), batch, dataset, dtype_name,
-            (1, 10) if small else (4, 44),
-            classes=1000 if not small else 10)
-        row = {"step_seconds": round(per_step, 9),
-               "images_per_sec": round(ips, 1)}
-        if flops:
-            row["tflops"] = round(flops / per_step / 1e12, 2)
-            if peak and dtype_name == "bfloat16":
-                row["mfu_pct"] = round(
-                    100.0 * flops / per_step / 1e12 / peak, 1)
-        out[dtype_name] = row
+
+    def rows(batch, chain_lens):
+        out = {}
+        for dtype_name in ("float32", "bfloat16"):
+            per_step, ips, flops = _train_step_images_per_sec(
+                alexnet_layers(classes=1000 if not small else 10),
+                (size, size, 3), batch, dataset, dtype_name,
+                chain_lens, classes=1000 if not small else 10)
+            row = {"step_seconds": round(per_step, 9),
+                   "images_per_sec": round(ips, 1)}
+            if flops:
+                row["tflops"] = round(flops / per_step / 1e12, 2)
+                if peak and dtype_name == "bfloat16":
+                    row["mfu_pct"] = round(
+                        100.0 * flops / per_step / 1e12 / peak, 1)
+            out[dtype_name] = row
+        return out
+
+    # batch 128 = the historical comparison row (and what SCALING.json
+    # projects from); batch 256 = the measured throughput sweet spot
+    # (52% MFU, bf16 1.5x f32 — fixed per-step overheads dilute the
+    # bf16 win at 128)
+    batch = 32 if small else 128
+    out = rows(batch, (1, 10) if small else (4, 44))
     out["batch"] = batch
+    if not small:
+        out["batch_256"] = rows(256, (2, 12))
+        out["precision_note"] = (
+            "f32 rows use XLA TPU default matmul precision, which "
+            "computes f32 convs/dense with one bf16 MXU pass; true "
+            "f32 (precision=highest) measured 3.1x slower "
+            "(36.0 ms/step at batch 128).  bf16's win over default-"
+            "f32 is therefore memory traffic, not MXU rate — it "
+            "reaches 1.5x at batch 256 where fixed overheads "
+            "amortize.")
     return out
 
 
@@ -452,7 +473,7 @@ def main():
 
                 def plausible(res):
                     return (limit is None
-                            or res["tflops"] <= limit * 1.02)
+                            or res["tflops"] <= limit)
                 candidates = [r for r in (matmul_res[dtype_name],
                                           second[dtype_name])
                               if plausible(r)]
