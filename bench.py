@@ -227,13 +227,20 @@ def bench_matmul(small):
                 # never persist past the physical cap (see _rate_guard)
                 cap = peak / 2 if peak else tflops
                 info.put(F32_CEILING_KEY, round(min(tflops, cap), 2))
-        out[dtype_name] = {"seconds": round(per, 9),
-                           "tflops": round(tflops, 2),
-                           "blocks": list(blocks)}
+        row = {"seconds": round(per, 9),
+               "tflops": round(tflops, 2),
+               "blocks": list(blocks)}
+        if not small and guard is not None and tflops > guard:
+            # every remeasure still exceeded the physical bound: the
+            # value is recorded for diagnosis but explicitly flagged —
+            # never published as a silent >peak rate
+            row["implausible"] = True
+        out[dtype_name] = row
     peak = _peak_bf16(dev.device_kind)
     if peak:
-        out["bfloat16"]["mfu_pct"] = round(
-            100.0 * out["bfloat16"]["tflops"] / peak, 1)
+        if not out["bfloat16"].get("implausible"):
+            out["bfloat16"]["mfu_pct"] = round(
+                100.0 * out["bfloat16"]["tflops"] / peak, 1)
         out["device_peak_bf16_tflops"] = peak
     out["device_kind"] = dev.device_kind
     return out

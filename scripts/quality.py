@@ -29,7 +29,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def run_example(module_name, backend, snapshot_check=False):
+def run_example(module_name, backend, snapshot_check=False,
+                fuse=False):
     """Build the example's workflow, run it, and report
     {best_error_pct, best_epoch, epochs, seconds}.  With
     ``snapshot_check`` a snapshotter rides the loop (snapshot on every
@@ -44,6 +45,9 @@ def run_example(module_name, backend, snapshot_check=False):
     module = importlib.import_module(module_name)
     launcher = Launcher()
     workflow = module.build(launcher)
+    if fuse:
+        # the TPU performance path: one jitted dispatch per minibatch
+        workflow.fuse()
 
     # the snapshotter rides the loop only for the anchor that proves
     # restore: each whole-workflow pickle map_reads every param from
@@ -96,6 +100,9 @@ def main():
         "VELES_BACKEND", "cpu"))
     parser.add_argument("--anchors", default=None,
                         help="comma list; default all")
+    parser.add_argument("--fuse", action="store_true",
+                        help="use the fused single-dispatch trainer "
+                             "(rows land under results_<backend>_fused)")
     parser.add_argument("--skip-mnist", action="store_true")
     parser.add_argument("--skip-cifar", action="store_true")
     args = parser.parse_args()
@@ -139,6 +146,8 @@ def main():
             pass
     results_key = ("results" if args.backend == "cpu"
                    else "results_%s" % args.backend)
+    if args.fuse:
+        results_key += "_fused"
     results = report.setdefault(results_key, {})
 
     anchors = (args.anchors.split(",") if args.anchors else
@@ -153,7 +162,9 @@ def main():
             continue
         try:
             row = run_example(name, args.backend,
-                              snapshot_check=(name == "digits"))
+                              snapshot_check=(name == "digits"
+                                              and not args.fuse),
+                              fuse=args.fuse)
         except DatasetNotFound as exc:
             results[name] = {"status": "data_unavailable",
                              "detail": str(exc)}

@@ -171,6 +171,61 @@ def test_digits_conv_classification_quality(cpu_device):
 
 
 @pytest.mark.slow
+def test_mnist_drop_rehearsal(tmp_path, cpu_device):
+    """A canonical-shaped MNIST drop starts the parity workflow with
+    ZERO code changes (round-3 verdict item 5): synthesize idx files
+    with the real shapes (random pixels — quality is meaningless,
+    execution is the point), point the datasets dir at them, and run
+    the real examples/mnist.py workflow end to end."""
+    import importlib
+
+    from veles_tpu.config import root
+    from veles_tpu.datasets import MNIST_FILES, selfcheck
+    from veles_tpu.launcher import Launcher
+
+    rng = numpy.random.RandomState(0)
+    counts = {"train": 60000, "test": 10000}
+    code = 0x08  # idx ubyte, for images and labels alike
+    for key, filename in MNIST_FILES.items():
+        kind = "train" if key.startswith("train") else "test"
+        if key.endswith("images"):
+            arr = rng.randint(0, 256, (counts[kind], 28, 28)).astype(
+                numpy.uint8)
+        else:
+            arr = rng.randint(0, 10, counts[kind]).astype(numpy.uint8)
+        raw = struct.pack(">HBB", 0, code, arr.ndim)
+        raw += struct.pack(">" + "I" * arr.ndim, *arr.shape)
+        raw += arr.tobytes()
+        # uncompressed variant: _fetch accepts the .gz name minus .gz
+        (tmp_path / filename[:-3]).write_bytes(raw)
+
+    report = selfcheck(str(tmp_path))
+    assert report["mnist"]["status"] == "ok"
+    # synthetic files are structurally canonical but not THE files
+    # (uncompressed names have no published md5 -> canonical None)
+    assert all(f["canonical"] is not True
+               for f in report["mnist"]["files"].values())
+
+    saved_dir = root.common.dirs.datasets
+    module = importlib.import_module("mnist")
+    saved_epochs = root.mnist.max_epochs
+    root.common.dirs.datasets = str(tmp_path)
+    root.mnist.max_epochs = 1
+    try:
+        launcher = Launcher()
+        wf = module.build(launcher)
+        launcher.initialize(device=cpu_device)
+        launcher.run()
+        # random labels: anything finite proves the pipeline ran
+        assert wf.decision.best_metric is not None
+        assert 0.0 <= wf.decision.best_metric <= 100.0
+        assert int(wf.loader.epoch_number) >= 1
+    finally:
+        root.common.dirs.datasets = saved_dir
+        root.mnist.max_epochs = saved_epochs
+
+
+@pytest.mark.slow
 def test_digits_quality_on_real_tpu():
     """On-chip end-to-end proof (round-3 verdict item 2): the FULL
     unit-graph product (loader -> per-unit jitted forwards/GD ->
