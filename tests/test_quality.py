@@ -185,7 +185,6 @@ def test_mnist_drop_rehearsal(tmp_path, cpu_device):
 
     rng = numpy.random.RandomState(0)
     counts = {"train": 60000, "test": 10000}
-    code = 0x08  # idx ubyte, for images and labels alike
     for key, filename in MNIST_FILES.items():
         kind = "train" if key.startswith("train") else "test"
         if key.endswith("images"):
@@ -193,11 +192,8 @@ def test_mnist_drop_rehearsal(tmp_path, cpu_device):
                 numpy.uint8)
         else:
             arr = rng.randint(0, 10, counts[kind]).astype(numpy.uint8)
-        raw = struct.pack(">HBB", 0, code, arr.ndim)
-        raw += struct.pack(">" + "I" * arr.ndim, *arr.shape)
-        raw += arr.tobytes()
         # uncompressed variant: _fetch accepts the .gz name minus .gz
-        (tmp_path / filename[:-3]).write_bytes(raw)
+        _write_idx(tmp_path / filename[:-3], arr)
 
     report = selfcheck(str(tmp_path))
     assert report["mnist"]["status"] == "ok"

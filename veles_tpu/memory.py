@@ -240,6 +240,20 @@ class Array(Pickleable):
                 self._mem = numpy.zeros(jax_array.shape, jax_array.dtype)
             self._track_device_bytes(self._mem.nbytes)
 
+    def prefetch_host(self):
+        """Start an async device->host copy when the device copy is
+        authoritative.  A later map_read finds the bytes already local,
+        so N arrays cost ~one round trip instead of N sequential ones
+        (a whole-workflow snapshot over a tunneled chip measured
+        ~1.9 s/pickle from serialized per-array fetches)."""
+        with self._lock_:
+            if self._state_ == _DEVICE_DIRTY and hasattr(
+                    self._devmem_, "copy_to_host_async"):
+                try:
+                    self._devmem_.copy_to_host_async()
+                except Exception:
+                    pass  # best effort: map_read stays correct
+
     # -- pickling ----------------------------------------------------------
 
     def __getstate__(self):
