@@ -162,8 +162,7 @@ def main():
             continue
         try:
             row = run_example(name, args.backend,
-                              snapshot_check=(name == "digits"
-                                              and not args.fuse),
+                              snapshot_check=(name == "digits"),
                               fuse=args.fuse)
         except DatasetNotFound as exc:
             results[name] = {"status": "data_unavailable",

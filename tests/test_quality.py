@@ -251,16 +251,18 @@ def test_digits_quality_on_real_tpu():
 
     # run the maintained harness, not a re-implementation: the same
     # path that records QUALITY.json rows (incl. the snapshot-restore
-    # proof for digits)
+    # proof for digits).  --fuse: one compiled program (~75 s on the
+    # tunneled chip) instead of the remote-compile-bound per-unit walk
     out = os.path.join(tempfile.mkdtemp(prefix="quality_tpu_"),
                        "q.json")
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "scripts", "quality.py"),
-         "--backend", "tpu", "--anchors", "digits", "--out", out],
+         "--backend", "tpu", "--anchors", "digits", "--fuse",
+         "--out", out],
         env=env, capture_output=True, text=True, timeout=1800,
         cwd=repo)
     assert proc.returncode == 0, proc.stderr[-2000:]
-    row = json.load(open(out))["results_tpu"]["digits"]
+    row = json.load(open(out))["results_tpu_fused"]["digits"]
     assert row.get("snapshot_restored"), row
     # same bar as the CPU anchor (measured 1.39% on both backends)
     assert row["best_error_pct"] <= 2.5, row

@@ -82,7 +82,14 @@ def extract_state(sw):
 
 
 def adopt_state(sw, new_state, device=None):
-    """Write a fused-step result back into the workflow's Arrays."""
+    """Stage a fused-step result back into the workflow's Arrays.
+
+    The fused step donates its input state buffers (donate_argnums),
+    so the Arrays must not keep references to ``new_state``'s leaves —
+    the next step would delete them under the Arrays' feet.  Values
+    are copied to host with overlapped async transfers and the device
+    side detached; host is authoritative afterwards."""
+    adopted = []
     for (fwd, gd), entry in zip(zip(sw.forwards, sw.gds), new_state):
         for key, arr in (("weights", fwd.weights), ("bias", fwd.bias),
                          ("accum_weights", gd.accum_weights),
@@ -91,6 +98,11 @@ def adopt_state(sw, new_state, device=None):
                          ("accum2_bias", gd.accum2_bias)):
             if entry.get(key) is not None and arr:
                 arr.set_device_array(entry[key], device or fwd.device)
+                adopted.append(arr)
+    for arr in adopted:
+        arr.prefetch_host()   # start all transfers...
+    for arr in adopted:
+        arr.detach_device()   # ...then collect, dropping references
 
 
 def _forward_for_loss(plans, params, x, key=None):

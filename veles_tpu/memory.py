@@ -240,6 +240,21 @@ class Array(Pickleable):
                 self._mem = numpy.zeros(jax_array.shape, jax_array.dtype)
             self._track_device_bytes(self._mem.nbytes)
 
+    def detach_device(self):
+        """Materialise the host copy and DROP the device reference.
+
+        For adopting buffers another computation is about to donate
+        (the fused train step donates its input state): keeping the
+        reference would hand later devmem readers a deleted jax.Array.
+        Host becomes authoritative; a future unmap re-uploads."""
+        with self._lock_:
+            self.map_read()
+            if self._devmem_ is not None:
+                self._devmem_ = None
+                self._track_device_bytes(0)
+                if self._device_ is not None:
+                    self._state_ = _HOST_DIRTY
+
     def prefetch_host(self):
         """Start an async device->host copy when the device copy is
         authoritative.  A later map_read finds the bytes already local,
