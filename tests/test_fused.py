@@ -88,7 +88,6 @@ def test_fused_sync_survives_donation(cpu_device):
     sw = _build_fused(cpu_device, max_epochs=2)
     trainer = sw.fused_trainer
     loader = sw.loader
-    loader.initialize(device=cpu_device)
 
     sw.run()                       # trains to max_epochs
     trainer.sync()                 # stage params out (snapshot path)

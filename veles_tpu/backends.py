@@ -154,6 +154,33 @@ class Device(Pickleable, metaclass=BackendRegistry):
         return "<%s backend=%s>" % (type(self).__name__, self.BACKEND)
 
 
+_COMPILE_CACHE_SET = False
+
+
+def _enable_persistent_compile_cache():
+    """Point JAX's persistent compilation cache at the veles cache dir
+    (unless the user configured one).  On a remote-compile TPU tunnel
+    a cold conv-net program costs 20-40 s to compile; the persistent
+    cache makes every later process reuse it (analog of the
+    reference's kernel binary cache, accelerated_units.py:605-636)."""
+    global _COMPILE_CACHE_SET
+    if _COMPILE_CACHE_SET:
+        return
+    _COMPILE_CACHE_SET = True
+    try:
+        import jax
+        if jax.config.jax_compilation_cache_dir:
+            return  # user already chose one
+        path = os.path.join(root.common.dirs.get("cache", "/tmp"),
+                            "jax_cache")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimisation, never a requirement
+
+
 class _JaxDevice(Device):
     """Shared implementation for JAX-backed devices."""
 
@@ -162,6 +189,7 @@ class _JaxDevice(Device):
     def __init__(self, **kwargs):
         self.device_index = kwargs.pop("device_index", 0)
         super(_JaxDevice, self).__init__(**kwargs)
+        _enable_persistent_compile_cache()
         self.init_unpickled()
 
     def init_unpickled(self):
