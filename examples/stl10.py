@@ -1,0 +1,68 @@
+"""STL-10 convolutional workflow — BASELINE quality target 35.10 %
+validation error (/root/reference/docs/source/
+manualrst_veles_algorithms.rst:51; the reference's conv config).
+
+    python -m veles_tpu examples/stl10.py
+
+Needs the STL-10 binary files under ``$VELES_DATA``
+(stl10_binary/train_X.bin ...); see veles_tpu/datasets.py.
+STL-10: 96x96x3, only 5,000 labeled train images — heavier
+augmentation-free regularization (dropout + weight decay) than
+CIFAR-10.
+"""
+
+from veles_tpu.config import root
+from veles_tpu.datasets import Stl10Loader
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+
+root.stl10.update({
+    "minibatch_size": 50,
+    "learning_rate": 0.01,
+    "gradient_moment": 0.9,
+    "weights_decay": 1e-4,
+    "dropout": 0.5,
+    "max_epochs": 120,
+    "fail_iterations": 25,
+})
+
+
+def _conv(n, k, stride=1, pad=1):
+    cfg = root.stl10
+    return {"type": "conv_relu", "n_kernels": n, "kx": k, "ky": k,
+            "sliding": (stride, stride), "padding": pad,
+            "learning_rate": cfg.learning_rate,
+            "gradient_moment": cfg.gradient_moment,
+            "weights_decay": cfg.weights_decay}
+
+
+def build(launcher):
+    cfg = root.stl10
+    dense = {"learning_rate": cfg.learning_rate,
+             "gradient_moment": cfg.gradient_moment,
+             "weights_decay": cfg.weights_decay}
+    return StandardWorkflow(
+        launcher,
+        layers=[
+            # 96 -> 48 -> 24 -> 12 -> 6 spatial
+            _conv(32, 3), {"type": "max_pooling", "kx": 2, "ky": 2},
+            _conv(64, 3), {"type": "max_pooling", "kx": 2, "ky": 2},
+            _conv(128, 3), {"type": "max_pooling", "kx": 2, "ky": 2},
+            _conv(128, 3), {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "all2all_relu", "output_sample_shape": 256,
+             **dense},
+            {"type": "dropout", "dropout_ratio": cfg.dropout},
+            {"type": "softmax", "output_sample_shape": 10, **dense},
+        ],
+        loader_factory=lambda w: Stl10Loader(
+            w, minibatch_size=cfg.minibatch_size,
+            prng=RandomGenerator("stl10", seed=6)),
+        decision_config=dict(max_epochs=cfg.max_epochs,
+                             fail_iterations=cfg.fail_iterations),
+        result_file=root.common.get("result_file"),
+    )
+
+
+def run(load, main):
+    load(build)
+    main()

@@ -2,11 +2,13 @@
 through the full loader->workflow->decision->snapshotter graph and
 records the reached validation errors in QUALITY.json (committed).
 
-Always runs the offline digits anchor (real handwritten digits bundled
-with scikit-learn).  Runs MNIST / CIFAR-10 against the reference's
-published quality table (1.48 % / 17.21 %,
-/root/reference/docs/source/manualrst_veles_algorithms.rst:31,50) when
-their datasets are cached locally or downloadable.
+Always runs the offline anchors (real handwritten digits bundled with
+scikit-learn, across MLP/conv/LSTM/autoencoder families).  Runs the
+dataset-gated parity anchors — MNIST 1.48 %, CIFAR-10 17.21 %, STL-10
+35.10 %, MNIST autoencoder RMSE 0.5478
+(/root/reference/docs/source/manualrst_veles_algorithms.rst:31,50,51,69)
+— when their datasets are present; ``--skip-datasets`` skips all of
+them.
 
 Rows are keyed by backend: ``--backend cpu`` writes under
 ``results``, any other backend under ``results_<backend>`` — both are
@@ -105,6 +107,10 @@ def main():
                              "(rows land under results_<backend>_fused)")
     parser.add_argument("--skip-mnist", action="store_true")
     parser.add_argument("--skip-cifar", action="store_true")
+    parser.add_argument("--skip-datasets", action="store_true",
+                        help="skip every dataset-gated anchor "
+                             "(mnist, cifar10, stl10, "
+                             "mnist_autoencoder)")
     args = parser.parse_args()
 
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
@@ -132,6 +138,11 @@ def main():
                   "source": "manualrst_veles_algorithms.rst:31"},
         "cifar10": {"reference_error_pct": 17.21,
                     "source": "manualrst_veles_algorithms.rst:50"},
+        "stl10": {"reference_error_pct": 35.10,
+                  "source": "manualrst_veles_algorithms.rst:51"},
+        "mnist_autoencoder": {
+            "reference_rmse": 0.5478,
+            "source": "manualrst_veles_algorithms.rst:69"},
     }
 
     # merge into the existing record so a TPU pass extends (not
@@ -152,12 +163,16 @@ def main():
 
     anchors = (args.anchors.split(",") if args.anchors else
                ["digits", "digits_conv", "sequence", "autoencoder",
-                "conv_autoencoder", "mnist", "cifar10"])
+                "conv_autoencoder", "mnist", "cifar10", "stl10",
+                "mnist_autoencoder"])
 
-    rmse_anchors = {"autoencoder", "conv_autoencoder"}
+    rmse_anchors = {"autoencoder", "conv_autoencoder",
+                    "mnist_autoencoder"}
+    dataset_gated = {"mnist", "cifar10", "stl10", "mnist_autoencoder"}
     for name in anchors:
-        if name == "mnist" and args.skip_mnist or \
-                name == "cifar10" and args.skip_cifar:
+        if (name == "mnist" and args.skip_mnist
+                or name == "cifar10" and args.skip_cifar
+                or name in dataset_gated and args.skip_datasets):
             results[name] = {"status": "skipped"}
             continue
         try:
