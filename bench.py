@@ -136,9 +136,13 @@ def _peak_bf16(device_kind):
     return None
 
 
-#: autotune-DB key holding the best plausibility-checked f32 matmul
-#: rate ever measured on this chip kind (TFLOP/s)
-F32_CEILING_KEY = "bench:f32_ceiling_tflops"
+def _f32_ceiling_key():
+    """Autotune-DB key for the best plausibility-checked f32 matmul
+    rate measured on this chip kind (TFLOP/s) — versioned with the
+    kernel algorithm, since a faster kernel makes an old ceiling a
+    false upper bound that would flag every legitimate new rate."""
+    from veles_tpu.ops.matmul import MATMUL_KERNEL_VERSION
+    return "bench:f32_ceiling_tflops:v%d" % MATMUL_KERNEL_VERSION
 
 
 def _rate_guard(info, dtype_name, peak_bf16):
@@ -151,7 +155,7 @@ def _rate_guard(info, dtype_name, peak_bf16):
     if dtype_name == "bfloat16":
         return peak_bf16
     hard_cap = peak_bf16 / 2 if peak_bf16 else None
-    ceiling = info.get(F32_CEILING_KEY)
+    ceiling = info.get(_f32_ceiling_key())
     if ceiling:
         soft = ceiling * 1.25
         return min(soft, hard_cap) if hard_cap else soft
@@ -222,11 +226,12 @@ def bench_matmul(small):
         tflops = 2.0 * n * n * n / per / 1e12
         if not small and dtype_name == "float32" and (
                 guard is None or tflops <= guard):
-            ceiling = info.get(F32_CEILING_KEY)
+            ceiling = info.get(_f32_ceiling_key())
             if ceiling is None or tflops > ceiling:
                 # never persist past the physical cap (see _rate_guard)
                 cap = peak / 2 if peak else tflops
-                info.put(F32_CEILING_KEY, round(min(tflops, cap), 2))
+                info.put(_f32_ceiling_key(),
+                         round(min(tflops, cap), 2))
         row = {"seconds": round(per, 9),
                "tflops": round(tflops, 2),
                "blocks": list(blocks)}

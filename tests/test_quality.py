@@ -62,6 +62,22 @@ def _write_stl10_drop(data_dir, rng):
     return base
 
 
+def _write_cifar10_drop(data_dir, rng):
+    """Canonical-shaped synthetic CIFAR-10 python batches."""
+    import pickle
+    base = data_dir / "cifar-10-batches-py"
+    base.mkdir(exist_ok=True)
+    for name in ["data_batch_%d" % i for i in range(1, 6)] + [
+            "test_batch"]:
+        with open(base / name, "wb") as fout:
+            pickle.dump({
+                b"data": rng.randint(0, 256, (10000, 3072),
+                                     dtype=numpy.uint8),
+                b"labels": rng.randint(0, 10, 10000).tolist(),
+            }, fout)
+    return base
+
+
 def _write_mnist_drop(data_dir, rng):
     """Canonical-shaped synthetic MNIST idx files (uncompressed names;
     _fetch accepts the .gz name minus .gz)."""
@@ -268,10 +284,10 @@ def test_mnist_drop_rehearsal(tmp_path, cpu_device):
 
 @pytest.mark.slow
 def test_stl10_and_mnist_ae_drop_rehearsal(tmp_path, cpu_device):
-    """The remaining reference-table parity configs (STL-10 35.10 %,
-    MNIST AE RMSE 0.5478) execute end to end on canonical-shaped
-    synthetic drops: one fused train step each through the real
-    example workflows."""
+    """The dataset-gated parity configs (CIFAR-10 17.21 %, STL-10
+    35.10 %, MNIST AE RMSE 0.5478) execute end to end on
+    canonical-shaped synthetic drops: one fused eval + train step
+    each through the real example workflows."""
     import importlib
 
     from veles_tpu.config import root
@@ -280,11 +296,12 @@ def test_stl10_and_mnist_ae_drop_rehearsal(tmp_path, cpu_device):
     rng = numpy.random.RandomState(0)
     _write_stl10_drop(tmp_path, rng)
     _write_mnist_drop(tmp_path, rng)
+    _write_cifar10_drop(tmp_path, rng)
 
     saved_dir = root.common.dirs.datasets
     root.common.dirs.datasets = str(tmp_path)
     try:
-        for module_name in ("stl10", "mnist_autoencoder"):
+        for module_name in ("cifar10", "stl10", "mnist_autoencoder"):
             module = importlib.import_module(module_name)
             from veles_tpu.launcher import Launcher
             launcher = Launcher()

@@ -5,6 +5,11 @@ CUBLAS-compatible wrapper (reference: veles/ocl_blas.py:77,187-236):
 ``C = alpha * op(A) @ op(B) + beta * C`` with transpose flags.
 Kernel compilation caching per (transA, transB, shapes, dtype) is XLA's
 jit cache — no hand-rolled binary cache is needed on TPU.
+
+Numerics: the default ``precision_level=0`` computes f32 products via
+the kernel's bf16x3 decomposition (~5e-7 max rel err vs f64, ~2x
+faster than true-f32 MXU passes); pass ``precision_level=1`` for
+CUBLAS-equivalent true-f32 products.
 """
 
 import functools

