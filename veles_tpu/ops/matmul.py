@@ -73,7 +73,10 @@ def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, comp_ref,
     # trades speed for digits (config.py:245-248: level 2 ~2x slower).
     # bf16 inputs MUST use DEFAULT: Mosaic rejects HIGHEST for bf16
     # operands on real TPUs ("Bad lhs type").
-    if a_ref.dtype != jnp.bfloat16 and precision_level == 0:
+    # (f32 only: other wide dtypes keep the conservative HIGHEST path;
+    # note the decomposition maps |x| >= bf16-max (~3.39e38) and inf
+    # to NaN — f32 operands that large are out of the kernel's domain)
+    if a_ref.dtype == jnp.float32 and precision_level == 0:
         a_f32 = a_ref[:].astype(jnp.float32)
         b_f32 = b_ref[:].astype(jnp.float32)
         a_hi = a_f32.astype(jnp.bfloat16)
@@ -254,9 +257,13 @@ def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
     best, best_time = None, float("inf")
     for blocks in distinct:
         try:
+            # repeats=24: short chains (~8) can INVERT tile rankings
+            # on a tunneled chip — a config measured 192 TF over
+            # 20-step chains sustained only 86 TF over 100-step ones
+            # while the true winner sustained 135
             elapsed = matmul_benchmark(
                 size=size, dtype=dtype, precision_level=precision_level,
-                repeats=8, blocks=blocks, samples=5)
+                repeats=24, blocks=blocks, samples=5)
         except Exception:
             continue
         if elapsed < best_time:
