@@ -17,13 +17,31 @@ blocking fetch costs ~0.1 s regardless of compute, so every number is a
 slope — two dependent chains of n1 and n2 iterations, each ended by one
 scalar fetch; (t2-t1)/(n2-n1) cancels the latency.
 
-Prints ONE JSON line: the required {metric, value, unit, vs_baseline}
-headline plus an "extras" dict carrying the BASELINE metrics.
+Wall-clock budget: the driver kills long benches, and on a tunneled
+chip the dominant cost is the FIRST EXECUTION of each distinct program
+(~60 s server-side compile for an AlexNet-sized step; measured: the
+local persistent compile cache does NOT shorten it, and concurrent
+first-execs serialize server-side).  So the suite (a) prints a full
+headline JSON line AFTER EVERY SECTION — the driver's tail-parse takes
+the last complete line, so a kill loses only the unfinished tail, never
+the whole record; (b) checks a deadline (env VELES_BENCH_DEADLINE_S,
+default 480 s) before each optional section and sheds the lowest
+evidence-per-second first — core sections (headline matmul, MNIST,
+AlexNet f32@128 + bf16@256) always run, then native, the second
+headline pass, bf16@128, the level-1 true-f32 row, and f32@256 run
+richest-first as time allows; (c) runs the native C++ build on a host
+thread concurrently with the TPU sections.
+
+Each printed line is the required {metric, value, unit, vs_baseline}
+headline plus an "extras" dict carrying the BASELINE metrics, per-row
+{median, min, max, passes} timing spreads, per-section wall times, and
+the list of sections shed to fit the deadline.
 """
 
 import functools
 import json
 import os
+import threading
 import time
 
 import numpy
@@ -38,6 +56,19 @@ PEAK_BF16_TFLOPS = (
     ("v3", 123.0), ("v2", 45.0),
 )
 
+# conservative wall-cost estimates per sheddable section (seconds,
+# measured on the axon tunnel, dominated by the one-time server-side
+# compile of each new program: ~60-100 s for a batch-128 AlexNet step,
+# ~200 s at batch 256); a section only starts when this much time
+# remains before the deadline
+SECTION_EST = {
+    "native_inference": 50.0,
+    "matmul_pass2": 40.0,
+    "alexnet_b128_bfloat16": 95.0,
+    "matmul_f32_level1": 80.0,
+    "alexnet_b256_float32": 230.0,
+}
+
 
 class BenchError(RuntimeError):
     """A measurement failed plausibility checks after remeasurement.
@@ -45,6 +76,16 @@ class BenchError(RuntimeError):
     Raised instead of publishing an impossible number (round-2 lesson:
     a floor-clamped negative slope once published 1e-9 s/step = 1e11
     samples/sec as the official MNIST record)."""
+
+
+def _slope_samples(run_chain, n1, n2, repeats=5):
+    """The individual (t(n2)-t(n1))/(n2-n1) slope samples."""
+    slopes = []
+    for _ in range(repeats):
+        t1 = run_chain(n1)
+        t2 = run_chain(n2)
+        slopes.append((t2 - t1) / (n2 - n1))
+    return slopes
 
 
 def _slope(run_chain, n1, n2, repeats=5):
@@ -55,12 +96,16 @@ def _slope(run_chain, n1, n2, repeats=5):
     report physically impossible (> chip peak) rates.  May return a
     non-positive value when tunnel jitter swamps the chain delta —
     callers MUST validate (see _robust_slope), never clamp."""
-    slopes = []
-    for _ in range(repeats):
-        t1 = run_chain(n1)
-        t2 = run_chain(n2)
-        slopes.append((t2 - t1) / (n2 - n1))
-    return float(numpy.median(slopes))
+    return float(numpy.median(_slope_samples(run_chain, n1, n2, repeats)))
+
+
+def _spread(samples):
+    """{median, min, max, passes} for a list of slope samples — makes
+    cross-round headline deltas readable as congestion vs regression."""
+    return {"median": round(float(numpy.median(samples)), 9),
+            "min": round(float(min(samples)), 9),
+            "max": round(float(max(samples)), 9),
+            "passes": len(samples)}
 
 
 _DISPATCH_FLOOR = None
@@ -114,13 +159,17 @@ def _robust_slope(chain, n1, n2, floor, what, repeats=5):
     4x longer so the compute delta grows past tunnel jitter; if every
     attempt stays implausible, raise BenchError carrying the observed
     values so the failure is loud and diagnosable.
+
+    Returns ``(median_slope, samples)`` — the samples feed the
+    published {median, min, max, passes} spread.
     """
     observed = []
     for scale in (1, 2, 4):
-        per = _slope(chain, n1, n2 * scale, repeats=repeats)
+        samples = _slope_samples(chain, n1, n2 * scale, repeats=repeats)
+        per = float(numpy.median(samples))
         observed.append(round(per, 9))
         if per > floor:
-            return per
+            return per, samples
     raise BenchError(
         "%s: step-time slope implausible after remeasurement "
         "(observed %s s/step vs dispatch floor %.3g s; the tunnel "
@@ -162,85 +211,113 @@ def _rate_guard(info, dtype_name, peak_bf16):
     return hard_cap
 
 
-def bench_matmul(small):
+def _measure_matmul_row(n, dtype_name, precision_level, n1, n2, small):
+    """Autotune + measure ONE matmul program; apply the chip-peak
+    guard and return the published row.
+
+    Shared by the two level-0 headline dtypes and the optional level-1
+    true-f32 anchor so the chain/guard/spread logic exists once.  When
+    a guard remeasure changes the published slope, the spread is
+    recomputed from the samples that actually back it — a row whose
+    ``seconds`` sits outside its own spread would misread as
+    congestion in exactly the congested case the spread targets.
+    """
     import jax
 
     from veles_tpu.backends import DeviceInfo
     from veles_tpu.ops import matmul
     from veles_tpu.ops.matmul import autotune_matmul
 
+    dev = jax.devices()[0]
+    info = DeviceInfo(dev.device_kind)
+    dtype = getattr(jax.numpy, dtype_name)
+    # tune at the benchmark size itself — tile optima don't transfer
+    # between 2048 (power-of-two) and 3001 (padded) shapes
+    blocks = autotune_matmul(
+        info, size=n, dtype=dtype, precision_level=precision_level)
+    rng = numpy.random.RandomState(0)
+    scale = 0.01  # keep chained products bounded
+    a = jax.device_put(
+        ((rng.rand(n, n) - 0.5) * scale).astype(numpy.float32)
+    ).astype(dtype)
+    b = jax.device_put(
+        ((rng.rand(n, n) - 0.5) * scale).astype(numpy.float32)
+    ).astype(dtype)
+
+    def mm(x, y):
+        return matmul(x, y, precision_level=precision_level,
+                      blocks=blocks)
+
+    float(mm(a, b)[0, 0].astype(jax.numpy.float32))  # compile
+
+    def chain(k):
+        start = time.perf_counter()
+        acc = a
+        for _ in range(k):
+            acc = mm(acc, b)
+        float(acc[0, 0].astype(jax.numpy.float32))
+        return time.perf_counter() - start
+
+    per, samples = _robust_slope(
+        chain, n1, n2, dispatch_floor_seconds(),
+        "matmul_%s_pl%d" % (dtype_name, precision_level))
+    # physical sanity: a rate above chip peak is a measurement
+    # artifact — remeasure with a longer chain and keep the slower.
+    # bf16 guards against the MXU spec peak; f32 guards against a
+    # previously MEASURED f32 ceiling (+25 % headroom) persisted in
+    # the autotune DB — the MXU's multi-pass f32 path has no spec
+    # sheet number, so a real measurement beats the old peak/2 guess
+    peak = _peak_bf16(dev.device_kind)
+    guard = _rate_guard(info, dtype_name, peak)
+    for _ in range(2):
+        tflops = 2.0 * n * n * n / per / 1e12
+        # no grace above the guard: a rate past physical peak is
+        # impossible however slightly (a 2% tolerance once let
+        # 199.6 TF = 101.3% MFU into the record)
+        if guard is None or tflops <= guard or small:
+            break
+        redo = _slope_samples(chain, n1, n2 * 2)
+        redo_med = float(numpy.median(redo))
+        if redo_med > per:  # slower remeasure wins; spread follows it
+            per, samples = redo_med, redo
+    tflops = 2.0 * n * n * n / per / 1e12
+    row = {"seconds": round(per, 9),
+           "tflops": round(tflops, 2),
+           "blocks": list(blocks),
+           "spread": _spread(samples)}
+    if dtype_name == "float32":
+        # self-describing precision (round-3 advice): level 0 computes
+        # f32 products via a bf16x3 MXU decomposition (~5e-7 max rel
+        # err vs f64; see ops/matmul.py), level 1 is the true-f32
+        # 6-pass path with Kahan accumulation
+        row["precision_level"] = precision_level
+        row["algorithm"] = ("bf16x3" if precision_level == 0
+                            else "highest+kahan")
+    if not small and guard is not None and tflops > guard:
+        # every remeasure still exceeded the physical bound: the
+        # value is recorded for diagnosis but explicitly flagged —
+        # never published as a silent >peak rate
+        row["implausible"] = True
+    return row
+
+
+def bench_matmul(small):
+    """One full headline pass: autotuned f32 + bf16 matmul rows.
+
+    Does NOT persist the f32 ceiling — a single pass can be a noise
+    spike; main() persists min-of-two-passes only (the ratchet needs
+    two independent passes to agree before the guard loosens)."""
+    import jax
+
     n = 512 if small else N
     # small shapes are dispatch-bound; long chains keep the slope
     # above timer noise
     n1, n2 = (1, 100) if small else (1, 41)
     dev = jax.devices()[0]
-    info = DeviceInfo(dev.device_kind)
-
-    rng = numpy.random.RandomState(0)
-    scale = 0.01  # keep chained products bounded
     out = {}
     for dtype_name in ("float32", "bfloat16"):
-        dtype = getattr(jax.numpy, dtype_name)
-        # tune at the benchmark size itself — tile optima don't
-        # transfer between 2048 (power-of-two) and 3001 (padded) shapes
-        blocks = autotune_matmul(
-            info, size=n, dtype=dtype, precision_level=0)
-        a = jax.device_put(
-            ((rng.rand(n, n) - 0.5) * scale).astype(numpy.float32)
-        ).astype(dtype)
-        b = jax.device_put(
-            ((rng.rand(n, n) - 0.5) * scale).astype(numpy.float32)
-        ).astype(dtype)
-
-        def mm(x, y):
-            return matmul(x, y, precision_level=0, blocks=blocks)
-
-        float(mm(a, b)[0, 0].astype(jax.numpy.float32))  # compile
-
-        def chain(k):
-            start = time.perf_counter()
-            acc = a
-            for _ in range(k):
-                acc = mm(acc, b)
-            float(acc[0, 0].astype(jax.numpy.float32))
-            return time.perf_counter() - start
-
-        per = _robust_slope(chain, n1, n2, dispatch_floor_seconds(),
-                            "matmul_%s" % dtype_name)
-        # physical sanity: a rate above chip peak is a measurement
-        # artifact — remeasure with a longer chain and keep the slower.
-        # bf16 guards against the MXU spec peak; f32 guards against a
-        # previously MEASURED f32 ceiling (+25 % headroom) persisted in
-        # the autotune DB — the MXU's multi-pass f32 path has no spec
-        # sheet number, so a real measurement beats the old peak/2 guess
-        peak = _peak_bf16(dev.device_kind)
-        guard = _rate_guard(info, dtype_name, peak)
-        for _ in range(2):
-            tflops = 2.0 * n * n * n / per / 1e12
-            # no grace above the guard: a rate past physical peak is
-            # impossible however slightly (a 2% tolerance once let
-            # 199.6 TF = 101.3% MFU into the record)
-            if guard is None or tflops <= guard or small:
-                break
-            per = max(per, _slope(chain, n1, n2 * 2))
-        tflops = 2.0 * n * n * n / per / 1e12
-        if not small and dtype_name == "float32" and (
-                guard is None or tflops <= guard):
-            ceiling = info.get(_f32_ceiling_key())
-            if ceiling is None or tflops > ceiling:
-                # never persist past the physical cap (see _rate_guard)
-                cap = peak / 2 if peak else tflops
-                info.put(_f32_ceiling_key(),
-                         round(min(tflops, cap), 2))
-        row = {"seconds": round(per, 9),
-               "tflops": round(tflops, 2),
-               "blocks": list(blocks)}
-        if not small and guard is not None and tflops > guard:
-            # every remeasure still exceeded the physical bound: the
-            # value is recorded for diagnosis but explicitly flagged —
-            # never published as a silent >peak rate
-            row["implausible"] = True
-        out[dtype_name] = row
+        out[dtype_name] = _measure_matmul_row(
+            n, dtype_name, 0, n1, n2, small)
     peak = _peak_bf16(dev.device_kind)
     if peak:
         if not out["bfloat16"].get("implausible"):
@@ -249,6 +326,15 @@ def bench_matmul(small):
         out["device_peak_bf16_tflops"] = peak
     out["device_kind"] = dev.device_kind
     return out
+
+
+def bench_matmul_f32_level1(small):
+    """True-f32 (precision level 1: HIGHEST products + Kahan) row at
+    the headline shape, so the published level-0 bf16x3 ratio has an
+    in-record true-f32 anchor to compare against."""
+    n = 512 if small else N
+    n1, n2 = (1, 50) if small else (1, 21)
+    return _measure_matmul_row(n, "float32", 1, n1, n2, small)
 
 
 def _train_step_images_per_sec(specs, input_shape, batch, dataset_size,
@@ -340,11 +426,11 @@ def _train_step_images_per_sec(specs, input_shape, batch, dataset_size,
         return time.perf_counter() - start
 
     n1, n2 = chain_lens
-    per_step = _robust_slope(
+    per_step, samples = _robust_slope(
         chain, n1, n2, dispatch_floor_seconds(),
         "train_step_%s_%s" % ("x".join(map(str, input_shape)),
                               dtype_name))
-    return per_step, batch / per_step, flops
+    return per_step, batch / per_step, flops, _spread(samples)
 
 
 def bench_mnist(small):
@@ -358,7 +444,7 @@ def bench_mnist(small):
     # n2 >= 500: at ~1.6 ms/step the long chain runs ~0.9 s, far above
     # tunnel jitter — the round-2 failure was a 100-step delta (0.16 s)
     # drowned by latency spikes of the same magnitude
-    per_step, sps, _ = _train_step_images_per_sec(
+    per_step, sps, _, spread = _train_step_images_per_sec(
         specs, (784,), batch, 6000 if not small else 1000,
         "float32", (2, 22) if small else (10, 510))
     steps_per_epoch = 60000 // batch
@@ -367,64 +453,65 @@ def bench_mnist(small):
         "samples_per_sec": round(sps, 1),
         "epoch_seconds_projected": round(per_step * steps_per_epoch, 3),
         "batch": batch,
+        "spread": spread,
     }
 
 
-def bench_alexnet(small):
-    import jax
-
+def bench_alexnet_row(batch, dtype_name, small, peak):
+    """One AlexNet throughput row (one distinct program = one
+    unavoidable ~60 s server-side compile on a tunneled chip)."""
     from veles_tpu.models.zoo import alexnet_layers
 
     size = 67 if small else 227
     dataset = 256 if small else 1024
-    peak = _peak_bf16(jax.devices()[0].device_kind)
-
-    def rows(batch, chain_lens):
-        out = {}
-        for dtype_name in ("float32", "bfloat16"):
-            per_step, ips, flops = _train_step_images_per_sec(
-                alexnet_layers(classes=1000 if not small else 10),
-                (size, size, 3), batch, dataset, dtype_name,
-                chain_lens, classes=1000 if not small else 10)
-            row = {"step_seconds": round(per_step, 9),
-                   "images_per_sec": round(ips, 1)}
-            if flops:
-                row["tflops"] = round(flops / per_step / 1e12, 2)
-                if peak and dtype_name == "bfloat16":
-                    row["mfu_pct"] = round(
-                        100.0 * flops / per_step / 1e12 / peak, 1)
-            out[dtype_name] = row
-        return out
-
-    # batch 128 = the historical comparison row (and what SCALING.json
-    # projects from); batch 256 = the measured throughput sweet spot
-    # (52% MFU, bf16 1.5x f32 — fixed per-step overheads dilute the
-    # bf16 win at 128)
-    batch = 32 if small else 128
-    out = rows(batch, (1, 10) if small else (4, 44))
-    out["batch"] = batch
-    if not small:
-        out["batch_256"] = rows(256, (2, 12))
-        out["precision_note"] = (
-            "f32 rows use XLA TPU default matmul precision, which "
-            "computes f32 convs/dense with one bf16 MXU pass; true "
-            "f32 (precision=highest) measured 3.1x slower "
-            "(36.0 ms/step at batch 128).  bf16's win over default-"
-            "f32 is therefore memory traffic, not MXU rate — it "
-            "reaches 1.5x at batch 256 where fixed overheads "
-            "amortize.")
-    return out
+    chain_lens = ((1, 10) if small else
+                  (4, 44) if batch <= 128 else (4, 24))
+    per_step, ips, flops, spread = _train_step_images_per_sec(
+        alexnet_layers(classes=1000 if not small else 10),
+        (size, size, 3), batch, dataset, dtype_name,
+        chain_lens, classes=1000 if not small else 10)
+    row = {"step_seconds": round(per_step, 9),
+           "images_per_sec": round(ips, 1),
+           "spread": spread}
+    if flops:
+        row["tflops"] = round(flops / per_step / 1e12, 2)
+        if peak and dtype_name == "bfloat16":
+            row["mfu_pct"] = round(
+                100.0 * flops / per_step / 1e12 / peak, 1)
+    return row
 
 
-def bench_native(small):
+ALEXNET_PRECISION_NOTE = (
+    "f32 rows use XLA TPU default matmul precision, which "
+    "computes f32 convs/dense with one bf16 MXU pass; true "
+    "f32 (precision=highest) measured 3.1x slower "
+    "(36.0 ms/step at batch 128).  bf16's win over default-"
+    "f32 is therefore memory traffic, not MXU rate — it "
+    "reaches 1.5x at batch 256 where fixed overheads "
+    "amortize.")
+
+
+def _build_native():
+    from veles_tpu import native
+    native.build_native()
+
+
+def bench_native(small, build_thread=None, wait_budget_s=120.0):
     """C++ inference runtime throughput on an exported MLP package
     (wavefront engine; host CPU, not the TPU — the runtime's job is
-    chip-free serving, reference libVeles)."""
+    chip-free serving, reference libVeles).
+
+    The CMake build runs on a background thread started at suite
+    entry; by measurement time it is normally long done."""
     import tempfile
 
     from veles_tpu import native
     from veles_tpu.backends import Device
-    native.build_native()
+    if build_thread is not None:
+        build_thread.join(timeout=max(1.0, wait_budget_s))
+        if build_thread.is_alive():
+            raise BenchError("native build still running at deadline")
+    native.build_native()  # no-op when the thread built it; else build
 
     from tests.test_native import _train_mlp
 
@@ -450,64 +537,172 @@ def bench_native(small):
 
 def main():
     small = bool(os.environ.get("VELES_BENCH_SMALL"))
-    extras = {}
+    deadline = time.monotonic() + float(
+        os.environ.get("VELES_BENCH_DEADLINE_S", "480"))
+    t_start = time.monotonic()
+    # enable JAX's persistent compile cache: it does not shorten the
+    # tunnel's server-side first-exec, but it does skip client-side
+    # recompiles and keeps the XLA autotune cache warm
+    try:
+        from veles_tpu.backends import _enable_persistent_compile_cache
+        _enable_persistent_compile_cache()
+    except Exception:
+        pass
 
+    extras = {"sections_s": {}, "shed": []}
+    result = {"value": None}
+
+    def remaining():
+        return deadline - time.monotonic()
+
+    def emit():
+        """Print the full record line; the driver tail-parses the LAST
+        complete line, so every section makes the published record
+        strictly richer — a kill can only lose the unfinished tail."""
+        n = 512 if small else N
+        print(json.dumps({
+            "metric": "matmul_%dx%d_f32_avg_time" % (n, n),
+            "value": result["value"],
+            "unit": "s",
+            "vs_baseline": (
+                round(BASELINE_MATMUL_S / result["value"], 2)
+                if result["value"] and not small else None),
+            "extras": extras,
+        }), flush=True)
+
+    def section(name, fn, always=False):
+        """Run one section under the deadline policy and emit."""
+        est = SECTION_EST.get(name, 30.0)
+        if not always and not small and remaining() < est:
+            extras["shed"].append(name)
+            return None
+        t0 = time.monotonic()
+        try:
+            value = fn()
+        except Exception as exc:  # keep the record alive
+            value = {"error": repr(exc)}
+            extras.setdefault("section_errors", {})[name] = repr(exc)
+        extras["sections_s"][name] = round(time.monotonic() - t0, 1)
+        emit()
+        return value
+
+    # the native C++ build is pure host CPU — overlap it with every
+    # TPU-bound section below
+    build_thread = threading.Thread(target=_build_native, daemon=True)
+    build_thread.start()
+
+    # headline pass 1: always runs (it IS the record)
+    t0 = time.monotonic()
     matmul_res = bench_matmul(small)
+    extras["sections_s"]["matmul_pass1"] = round(
+        time.monotonic() - t0, 1)
     extras["matmul"] = matmul_res
-    try:
-        extras["mnist_784_100_10"] = bench_mnist(small)
-    except Exception as exc:  # keep the headline alive
-        extras["mnist_784_100_10"] = {"error": repr(exc)}
-    try:
-        extras["alexnet"] = bench_alexnet(small)
-    except Exception as exc:
-        extras["alexnet"] = {"error": repr(exc)}
-    try:
-        extras["native_inference"] = bench_native(small)
-    except Exception as exc:
-        extras["native_inference"] = {"error": repr(exc)}
+    result["value"] = matmul_res["float32"]["seconds"]
+    headline_passes = [matmul_res["float32"]["seconds"]]
+    emit()
+
+    mnist = section("mnist", lambda: bench_mnist(small), always=True)
+    if mnist is not None:
+        extras["mnist_784_100_10"] = mnist
+
+    # AlexNet rows, one program (= one ~60-200 s server compile) each.
+    # Batch 128 f32 = the historical comparison row (what SCALING.json
+    # projects from); batch 256 bf16 = the throughput/MFU sweet spot —
+    # both are core evidence and always run.  The remaining rows are
+    # ordered by evidence-per-second and shed from the back: bf16@128
+    # (cross-round history), the level-1 true-f32 matmul anchor, and
+    # f32@256 (the 1.5x partner row — its conclusion is carried by
+    # precision_note when shed).
+    peak = _peak_bf16(matmul_res["device_kind"])
+    alexnet = {"batch": 32 if small else 128}
+
+    def alex(batch, dtype_name):
+        row = bench_alexnet_row(batch, dtype_name, small, peak)
+        dest = (alexnet if batch == alexnet["batch"]
+                else alexnet.setdefault("batch_256", {}))
+        dest[dtype_name] = row
+        if not small:
+            alexnet["precision_note"] = ALEXNET_PRECISION_NOTE
+        extras["alexnet"] = alexnet
+        return row
+
+    b = alexnet["batch"]
+    section("alexnet_b128", lambda: alex(b, "float32"), always=True)
+    if small:
+        section("alexnet_b32_bfloat16", lambda: alex(b, "bfloat16"),
+                always=True)
+    else:
+        section("alexnet_b256_bfloat16",
+                lambda: alex(256, "bfloat16"), always=True)
+    native_res = section(
+        "native_inference",
+        lambda: bench_native(small, build_thread,
+                             wait_budget_s=remaining() - 30.0))
+    if native_res is not None:
+        extras["native_inference"] = native_res
 
     # a tunneled chip's congestion varies minute to minute; measure the
     # headline twice (start + end of the suite) and keep the faster
-    # pass.  Each pass's own guard already remeasures rates above chip
-    # peak, and the cap below rejects a still-impossible pass outright
-    # so min-time cannot lock in a spuriously fast sample.
+    # plausible pass.  The f32 ceiling guard only ratchets when BOTH
+    # passes agree (min of the two) — one spiked pass must not loosen
+    # the next run's plausibility guard.
+    def pass2():
+        import jax
+
+        from veles_tpu.backends import DeviceInfo
+        second = bench_matmul(small)  # in-process jit cache: no compile
+        info = DeviceInfo(jax.devices()[0].device_kind)
+        headline_passes.append(second["float32"]["seconds"])
+        # snapshot BOTH independent passes before the min-selection
+        # below overwrites matmul_res: the ceiling ratchet must see
+        # pass1 vs pass2, not winner vs itself
+        first_f32 = matmul_res["float32"]
+        for dtype_name in ("float32", "bfloat16"):
+            limit = _rate_guard(info, dtype_name, peak)
+
+            def plausible(res):
+                return limit is None or res["tflops"] <= limit
+            candidates = [r for r in (matmul_res[dtype_name],
+                                      second[dtype_name])
+                          if plausible(r)]
+            if not candidates:  # both spiked: keep the slower
+                candidates = [max((matmul_res[dtype_name],
+                                   second[dtype_name]),
+                                  key=lambda r: r["seconds"])]
+            matmul_res[dtype_name] = min(
+                candidates, key=lambda r: r["seconds"])
+        # persist the f32 ceiling from the SLOWER of two plausible
+        # passes: a single congestion-free spike cannot ratchet the
+        # guard, but a genuinely faster kernel (seen twice) can
+        f32_rates = [r["tflops"] for r in (first_f32,
+                                           second["float32"])
+                     if not r.get("implausible")]
+        limit = _rate_guard(info, "float32", peak)
+        if (len(f32_rates) == 2 and not small
+                and (limit is None or min(f32_rates) <= limit)):
+            agreed = min(f32_rates)
+            ceiling = info.get(_f32_ceiling_key())
+            if ceiling is None or agreed > ceiling:
+                cap = peak / 2 if peak else agreed
+                info.put(_f32_ceiling_key(),
+                         round(min(agreed, cap), 2))
+        extras["matmul"] = matmul_res
+        extras["matmul"]["headline_passes"] = [
+            round(s, 9) for s in headline_passes]
+        result["value"] = matmul_res["float32"]["seconds"]
+        return True
+
     if not small:
-        try:
-            import jax
+        section("matmul_pass2", pass2)
+        section("alexnet_b128_bfloat16", lambda: alex(b, "bfloat16"))
+        lvl1 = section("matmul_f32_level1",
+                       lambda: bench_matmul_f32_level1(small))
+        if lvl1 is not None and "error" not in lvl1:
+            extras["matmul"]["float32_level1"] = lvl1
+        section("alexnet_b256_float32", lambda: alex(256, "float32"))
 
-            from veles_tpu.backends import DeviceInfo
-            second = bench_matmul(small)  # tuned-table cache hit
-            peak = matmul_res.get("device_peak_bf16_tflops")
-            info = DeviceInfo(jax.devices()[0].device_kind)
-            for dtype_name in ("float32", "bfloat16"):
-                limit = _rate_guard(info, dtype_name, peak)
-
-                def plausible(res):
-                    return (limit is None
-                            or res["tflops"] <= limit)
-                candidates = [r for r in (matmul_res[dtype_name],
-                                          second[dtype_name])
-                              if plausible(r)]
-                if not candidates:  # both spiked: keep the slower
-                    candidates = [max((matmul_res[dtype_name],
-                                       second[dtype_name]),
-                                      key=lambda r: r["seconds"])]
-                matmul_res[dtype_name] = min(
-                    candidates, key=lambda r: r["seconds"])
-        except Exception:
-            pass
-
-    per_matmul = matmul_res["float32"]["seconds"]
-    n = 512 if small else N
-    print(json.dumps({
-        "metric": "matmul_%dx%d_f32_avg_time" % (n, n),
-        "value": per_matmul,
-        "unit": "s",
-        "vs_baseline": (round(BASELINE_MATMUL_S / per_matmul, 2)
-                        if not small else None),
-        "extras": extras,
-    }))
+    extras["wall_s"] = round(time.monotonic() - t_start, 1)
+    emit()
 
 
 if __name__ == "__main__":

@@ -10,11 +10,20 @@ dataset-gated parity anchors — MNIST 1.48 %, CIFAR-10 17.21 %, STL-10
 — when their datasets are present; ``--skip-datasets`` skips all of
 them.
 
-Rows are keyed by backend: ``--backend cpu`` writes under
-``results``, any other backend under ``results_<backend>`` — both are
-kept in the same file, so a TPU run records on-chip proof alongside
-the CPU anchors (round-3 verdict item 2).  ``--anchors`` selects a
-subset (default: all offline anchors + mnist/cifar when data exists).
+Rows are keyed by backend and path: ``--backend cpu`` writes under
+``results`` (the historical CPU key), any other backend under
+``results_<backend>`` — all kept in the same file, so a TPU run
+records on-chip proof alongside the CPU anchors (round-3 verdict
+item 2).  On TPU the DEFAULT path auto-fuses (StandardWorkflow fuses
+the train loop into one dispatch per minibatch), so ``results_tpu``
+is fused-path evidence; every row carries a ``fused`` flag.
+``--fuse`` forces fusing on a backend whose default is per-unit
+(rows land under ``results_<backend>_fused``, including cpu);
+``--no-fuse`` keeps the per-unit debug path on TPU (rows land under
+``results_tpu_unit``).  Anchors no longer in the known set are
+dropped from every results_* map on rewrite.  ``--anchors`` selects
+a subset (default: all offline anchors + mnist/cifar when data
+exists).
 
     python scripts/quality.py [--out QUALITY.json] [--backend cpu]
                               [--anchors digits,sequence,...]
@@ -32,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def run_example(module_name, backend, snapshot_check=False,
-                fuse=False):
+                fuse=False, no_fuse=False):
     """Build the example's workflow, run it, and report
     {best_error_pct, best_epoch, epochs, seconds}.  With
     ``snapshot_check`` a snapshotter rides the loop (snapshot on every
@@ -44,11 +53,15 @@ def run_example(module_name, backend, snapshot_check=False,
     from veles_tpu.launcher import Launcher
     from veles_tpu.snapshotter import Snapshotter, SnapshotterBase
 
+    from veles_tpu.config import root
+    if no_fuse:
+        root.common.engine.auto_fuse = False
     module = importlib.import_module(module_name)
     launcher = Launcher()
     workflow = module.build(launcher)
-    if fuse:
-        # the TPU performance path: one jitted dispatch per minibatch
+    if fuse and getattr(workflow, "fused_trainer", None) is None:
+        # force the fused path on a backend whose default is per-unit
+        # (on TPU the StandardWorkflow auto-fuses at initialize)
         workflow.fuse()
 
     # the snapshotter rides the loop only for the anchor that proves
@@ -76,6 +89,7 @@ def run_example(module_name, backend, snapshot_check=False,
         "epochs": int(workflow.loader.epoch_number),
         "seconds": round(elapsed, 2),
         "backend": backend,
+        "fused": getattr(workflow, "fused_trainer", None) is not None,
     }
     if snapshot_check:
         # checkpoint/resume proof: the best snapshot reloads and its
@@ -103,8 +117,13 @@ def main():
     parser.add_argument("--anchors", default=None,
                         help="comma list; default all")
     parser.add_argument("--fuse", action="store_true",
-                        help="use the fused single-dispatch trainer "
-                             "(rows land under results_<backend>_fused)")
+                        help="force the fused single-dispatch trainer "
+                             "on a backend whose default is per-unit "
+                             "(rows land under "
+                             "results_<backend>_fused, incl. cpu)")
+    parser.add_argument("--no-fuse", action="store_true",
+                        help="keep the per-unit debug path on TPU "
+                             "(rows land under results_tpu_unit)")
     parser.add_argument("--skip-mnist", action="store_true")
     parser.add_argument("--skip-cifar", action="store_true")
     parser.add_argument("--skip-datasets", action="store_true",
@@ -155,11 +174,25 @@ def main():
             report["targets"] = targets
         except ValueError:
             pass
-    results_key = ("results" if args.backend == "cpu"
-                   else "results_%s" % args.backend)
+    if args.fuse and args.no_fuse:
+        parser.error("--fuse and --no-fuse are mutually exclusive")
+    base_key = ("results" if args.backend == "cpu"
+                else "results_%s" % args.backend)
     if args.fuse:
-        results_key += "_fused"
+        # explicit fused suffix always names the backend (cpu included)
+        results_key = "results_%s_fused" % args.backend
+    elif args.no_fuse and args.backend == "tpu":
+        # the TPU default IS fused; the opt-out is the marked path
+        results_key = "results_tpu_unit"
+    else:
+        results_key = base_key
     results = report.setdefault(results_key, {})
+    # drop rows for anchors that no longer exist (renamed/removed
+    # anchors otherwise live in the record forever)
+    for key, rows in list(report.items()):
+        if key.startswith("results") and isinstance(rows, dict):
+            for stale in set(rows) - set(targets):
+                del rows[stale]
 
     anchors = (args.anchors.split(",") if args.anchors else
                ["digits", "digits_conv", "sequence", "autoencoder",
@@ -178,7 +211,7 @@ def main():
         try:
             row = run_example(name, args.backend,
                               snapshot_check=(name == "digits"),
-                              fuse=args.fuse)
+                              fuse=args.fuse, no_fuse=args.no_fuse)
         except DatasetNotFound as exc:
             results[name] = {"status": "data_unavailable",
                              "detail": str(exc)}

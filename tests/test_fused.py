@@ -109,3 +109,58 @@ def test_fused_sync_survives_donation(cpu_device):
     assert not numpy.array_equal(before, after)  # training moved on
     dev_arr = sw.forwards[0].weights.device_array(cpu_device)
     assert numpy.isfinite(numpy.asarray(dev_arr)).all()
+
+
+def _build_unfused(max_epochs=3):
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    wf = DummyWorkflow()
+    return StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("fused", seed=7)),
+        decision_config=dict(max_epochs=max_epochs),
+    )
+
+
+def test_auto_fuse_on_tpu_backend(cpu_device):
+    """A device resolving to the TPU backend auto-fuses at initialize
+    (the per-unit loop is the opt-in debug path on TPU)."""
+    sw = _build_unfused()
+    cpu_device.BACKEND = "tpu"  # instance attr: claims tpu backend
+    sw.initialize(device=cpu_device)
+    assert sw.fused_trainer is not None
+    sw.run()
+    assert bool(sw.decision.complete)
+    assert sw.fused_trainer.run_calls > 0
+    assert sw.forwards[0].run_calls == 0
+
+
+def test_auto_fuse_opt_out(cpu_device):
+    from veles_tpu.config import root
+    sw = _build_unfused()
+    cpu_device.BACKEND = "tpu"
+    root.common.engine.auto_fuse = False
+    try:
+        sw.initialize(device=cpu_device)
+    finally:
+        root.common.engine.auto_fuse = True
+    assert getattr(sw, "fused_trainer", None) is None
+    sw.run()
+    assert sw.forwards[0].run_calls > 0
+
+
+def test_no_auto_fuse_on_cpu(cpu_device):
+    """CPU keeps the per-unit default: reference-parity semantics."""
+    sw = _build_unfused()
+    sw.initialize(device=cpu_device)
+    assert getattr(sw, "fused_trainer", None) is None
+    sw.run()
+    assert sw.forwards[0].run_calls > 0

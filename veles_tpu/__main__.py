@@ -51,6 +51,11 @@ class Main(object):
             "--sync-run", action="store_true",
             help="block after every unit's device call for honest "
                  "per-unit timings")
+        parser.add_argument(
+            "--no-fuse", action="store_true",
+            help="keep the per-unit dispatch loop on TPU instead of "
+                 "auto-fusing the train step (debug path; 8-25x "
+                 "slower on a real chip)")
         parser.add_argument("--dump-graph", default=None,
                             help="write the graphviz dot file and exit")
         parser.add_argument(
@@ -227,6 +232,8 @@ class Main(object):
         apply_parsed_args(args)
         if args.sync_run:
             root.common.sync_run = True
+        if args.no_fuse:
+            root.common.engine.auto_fuse = False
         if args.frontend is not None:
             return self._run_frontend(parser, args.frontend)
         if args.background:

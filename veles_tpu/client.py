@@ -112,11 +112,20 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
     @property
     def computing_power(self):
         """Reference: 1000/avg-matmul-time (accelerated_units.py:768).
-        Estimated once from the benchmark op when available."""
+        Estimated once from the benchmark op when available.
+
+        A failed rating falls back to the neutral 1.0 so the handshake
+        still completes, but LOUDLY: a silent fallback would skew the
+        master's load balancing invisibly (the rating itself already
+        refuses to publish a clamped nonsense slope)."""
         try:
             from veles_tpu.ops.benchmark import estimate_computing_power
             return float(estimate_computing_power(size=256, repeats=1))
-        except Exception:
+        except Exception as exc:
+            self.warning(
+                "computing-power rating failed (%s); reporting "
+                "neutral power=1.0 — this slave will be weighted "
+                "as baseline by the master's load balancer", exc)
             return 1.0
 
     # -- asyncio internals ---------------------------------------------------

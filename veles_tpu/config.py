@@ -163,10 +163,22 @@ root.common.update({
         # Numeric precision for model math.  bfloat16 keeps the MXU fed;
         # float32 is the reference-compatible default for parity tests.
         "precision_type": os.environ.get("VELES_PRECISION", "float32"),
-        # 0: plain accumulate; 1: f32 accumulation on MXU (maps the
-        # reference's Kahan level); 2: compensated (Kahan) summation.
+        # Speed/digits ladder (reference PRECISION_LEVEL analog):
+        # 0 (default): fastest — f32 matmul products run a bf16x3 MXU
+        #    decomposition (~5e-7 max rel err; |x| >= ~3.39e38 or inf
+        #    is out of domain and yields NaN) with plain f32
+        #    accumulation;
+        # 1: true-f32 (HIGHEST) products + Kahan-compensated sums;
+        # 2: level 1 plus Neumaier compensation (most digits, ~2x
+        #    slower than level 1).  See ops/matmul.py.
         "precision_level": int(os.environ.get("VELES_PRECISION_LEVEL", "0")),
         "backend": os.environ.get("VELES_BACKEND", "auto"),
+        # On TPU the per-unit dispatch loop is 8-25x slower than the
+        # fused single-dispatch train step (QUALITY.json results_tpu
+        # history), so StandardWorkflow fuses automatically when the
+        # resolved device is a TPU.  Set VELES_AUTO_FUSE=0 (or the CLI
+        # --no-fuse) to keep the per-unit graph for debugging.
+        "auto_fuse": os.environ.get("VELES_AUTO_FUSE", "1") != "0",
     },
     "trace": {
         "run": False,

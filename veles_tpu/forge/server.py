@@ -61,6 +61,13 @@ class ForgeServer(Logger):
                              else os.environ.get("VELES_FORGE_TOKEN"))
         self.git_backed = git_backed
         self._server_ = None
+        # per-package version-list cache: ``git tag --list`` is a
+        # subprocess spawn, and index() calls versions() for every
+        # package on every /service?query=list — without the cache the
+        # endpoint is O(packages) process spawns per request.  The
+        # server owns the store, so store() is the only invalidation
+        # point needed.
+        self._versions_cache = {}
 
     # -- git backing ----------------------------------------------------------
 
@@ -93,6 +100,10 @@ class ForgeServer(Logger):
         pdir = os.path.join(self.root_dir,
                             _safe_component(name, "package name"))
         _safe_component(version, "version")
+        # drop the cached version list up front: even a failed store
+        # may have advanced the underlying repo (e.g. crash between
+        # commit and tag), so the next read must re-list
+        self._versions_cache.pop(name, None)
         os.makedirs(pdir, exist_ok=True)
         if not os.path.isdir(os.path.join(pdir, ".git")):
             self._git(name, "init", "-q")
@@ -109,8 +120,19 @@ class ForgeServer(Logger):
         self._git(name, "commit", "-q", "--allow-empty",
                   "-m", version)
         self._git(name, "tag", "v/%s" % version)
+        # the already-published check above re-populated the cache
+        # with the pre-tag list — drop it again now that the tag lands
+        self._versions_cache.pop(name, None)
 
     def _git_versions(self, name):
+        cached = self._versions_cache.get(name)
+        if cached is not None:
+            return list(cached)
+        versions = self._git_versions_uncached(name)
+        self._versions_cache[name] = list(versions)
+        return versions
+
+    def _git_versions_uncached(self, name):
         pdir = os.path.join(self.root_dir,
                             _safe_component(name, "package name"))
         if not os.path.isdir(os.path.join(pdir, ".git")):

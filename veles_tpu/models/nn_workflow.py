@@ -211,5 +211,47 @@ class StandardWorkflow(Workflow):
             # one job = one pass: a slave must not loop the repeater; the
             # drained worklist ends the pass (master drives iteration)
             self.repeater.unlink_from(self.gds[0])
+        elif self.workflow_mode == "standalone":
+            # standalone ONLY: in distributed runs master and slaves
+            # exchange unit state by zipping their unit lists
+            # positionally (workflow.py generate_data_for_slave /
+            # apply_data_from_slave), so a fused master would
+            # desynchronize from its unfused slaves
+            device = self._maybe_auto_fuse(device)
         return super(StandardWorkflow, self).initialize(
             device=device, **kwargs)
+
+    def _maybe_auto_fuse(self, device):
+        """Fuse automatically when the resolved device is a TPU.
+
+        The per-unit dispatch loop is the DEBUG path on TPU — measured
+        8-25x slower than the fused step over a tunneled chip
+        (QUALITY.json results_tpu history), so the product default is
+        the fast path; ``--no-fuse`` / VELES_AUTO_FUSE=0 opts out.
+        Distributed modes never auto-fuse — master and slaves exchange
+        state by zipping unit lists positionally, so both sides must
+        keep the same unit graph — and a workflow the compiler cannot
+        plan falls back to the per-unit path with a warning instead of
+        failing.
+        Returns the RESOLVED device so initialize passes it down
+        without a second backend auto-selection."""
+        from veles_tpu.backends import Device
+        from veles_tpu.config import root
+        if device is None or isinstance(device, str):
+            device = Device(backend=device)
+        if (getattr(self, "fused_trainer", None) is None
+                and root.common.engine.get("auto_fuse", True)
+                and device.BACKEND == "tpu"):
+            try:
+                from veles_tpu.compiler import workflow_plan
+                workflow_plan(self)  # structural check only
+            except Exception as exc:
+                self.warning(
+                    "auto-fuse skipped (workflow not fusable: %s); "
+                    "running the per-unit debug path on TPU", exc)
+            else:
+                self.info("TPU device: fusing the train loop into one "
+                          "dispatch per minibatch (--no-fuse to keep "
+                          "the per-unit debug path)")
+                self.fuse()
+        return device

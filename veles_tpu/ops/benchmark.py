@@ -13,6 +13,17 @@ __all__ = ["estimate_computing_power", "matmul_benchmark",
 
 
 def estimate_computing_power(size=1024, repeats=3):
-    """1000 / avg-matmul-seconds, the reference's arbitrary power unit."""
-    elapsed = matmul_benchmark(size=size, repeats=repeats)
-    return 1000.0 / max(elapsed, 1e-9)
+    """1000 / avg-matmul-seconds, the reference's arbitrary power unit.
+
+    A non-positive slope (tunnel jitter swamping the chain delta) is
+    remeasured with a longer chain; if it stays non-positive the
+    rating fails loudly — a clamped nonsense rating would skew the
+    master's load balancing invisibly."""
+    for scale in (1, 4, 16):
+        elapsed = matmul_benchmark(size=size, repeats=repeats * scale)
+        if elapsed > 0:
+            return 1000.0 / elapsed
+    raise RuntimeError(
+        "estimate_computing_power: matmul timing slope stayed "
+        "non-positive after remeasurement; refusing to publish a "
+        "power rating from noise")

@@ -27,6 +27,13 @@ __all__ = ["gemm", "veles_gemm"]
     static_argnames=("trans_a", "trans_b", "precision_level"))
 def gemm(a, b, c=None, alpha=1.0, beta=0.0, trans_a=False, trans_b=False,
          precision_level=0):
+    """alpha * op(a) @ op(b) + beta * c (BLAS GEMM facade).
+
+    ``precision_level`` follows ops.matmul: the default level 0
+    computes f32 products via the fast bf16x3 MXU decomposition —
+    f32-class accuracy, but operands with |x| >= bf16 max (~3.39e38)
+    or inf produce NaN; pass precision_level=1 for true-f32 products
+    when operands can be that large."""
     if trans_a:
         a = a.T
     if trans_b:

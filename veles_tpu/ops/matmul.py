@@ -128,6 +128,17 @@ def matmul(a, b, precision_level=0, blocks=None, out_dtype=None):
     a: (M, K), b: (K, N).  Inputs may be float32 or bfloat16; the MXU
     accumulates in float32 regardless.
 
+    ``precision_level`` trades digits for speed (the reference's
+    PRECISION_LEVEL ladder).  Level 0 (default, fastest) computes
+    float32 products via a bf16x3 decomposition on the MXU: ~5e-7 max
+    relative error vs an f64 oracle (f32-class results) at ~2x the
+    true-f32 throughput, BUT operands with |x| >= bf16 max (~3.39e38)
+    or inf land outside the decomposition's domain and produce NaN.
+    For inputs that large — or when bit-exact f32 products matter —
+    use level 1 (true-f32 HIGHEST products + Kahan accumulation) or
+    level 2 (adds Neumaier compensation).  bfloat16 inputs are
+    unaffected: they always take single-pass MXU products.
+
     A thin eager wrapper around the jitted kernel: the interpret-mode
     decision needs the CONCRETE operand placement (CPU-committed arrays
     on a TPU-default host must interpret), which is invisible once
@@ -193,7 +204,13 @@ def matmul_benchmark(size=3001, dtype=jnp.float32, precision_level=0,
     ``samples`` > 1 the median of that many slopes is returned — single
     slopes are noisy enough on tunneled devices to go non-positive, so
     rank-sensitive callers (the autotuner) raise it; the one-shot
-    default keeps the client power-rating handshake cheap."""
+    default keeps the client power-rating handshake cheap.
+
+    Returns the RAW slope, which may be zero or negative when tunnel
+    jitter swamps the chain delta.  Callers must validate and discard
+    non-positive samples (never clamp: a floored nonsense slope once
+    crowned the wrong autotune tile and published an impossible rate).
+    """
     import time
 
     import numpy
@@ -218,9 +235,8 @@ def matmul_benchmark(size=3001, dtype=jnp.float32, precision_level=0,
     slopes = sorted(
         (chain(repeats + 1) - chain(1)) / repeats for _ in range(samples))
     mid = samples // 2
-    median = (slopes[mid] if samples % 2
-              else (slopes[mid - 1] + slopes[mid]) / 2.0)
-    return max(median, 1e-9)
+    return (slopes[mid] if samples % 2
+            else (slopes[mid - 1] + slopes[mid]) / 2.0)
 
 
 def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
@@ -266,8 +282,19 @@ def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
                 repeats=24, blocks=blocks, samples=5)
         except Exception:
             continue
+        if elapsed <= 0:
+            # tunnel jitter swamped the whole 5-sample median: this
+            # tile cannot be ranked — skip it rather than let a
+            # nonsense slope crown it (never clamp, validate)
+            continue
         if elapsed < best_time:
             best, best_time = blocks, elapsed
-    best = best or _DEFAULT_BLOCKS
+    if best is None:
+        import logging
+        logging.getLogger("veles_tpu.autotune").warning(
+            "autotune_matmul: no tile produced a positive timing "
+            "slope (size=%d dtype=%s); falling back to %s and NOT "
+            "persisting", size, jnp.dtype(dtype).name, _DEFAULT_BLOCKS)
+        return _DEFAULT_BLOCKS
     device_info.put(key, list(best))
     return best

@@ -18,6 +18,7 @@ exact (tested against a single-device oracle on the virtual mesh).
 """
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -51,7 +52,7 @@ def _ring_body(my_index, n_shards, t_local, axis_name, causal, scale,
     l = jnp.zeros((batch, heads, t_local), jnp.float32)
     o = jnp.zeros((batch, heads, t_local, depth), jnp.float32)
 
-    def body(i, carry):
+    def body(carry, i):
         k_blk, v_blk, m, l, o = carry
         src = (my_index - i) % n_shards  # origin rank of current block
         k_pos = src * t_local + jnp.arange(t_local)
@@ -71,21 +72,32 @@ def _ring_body(my_index, n_shards, t_local, axis_name, causal, scale,
         perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return k_blk, v_blk, m, l, o
+        return (k_blk, v_blk, m, l, o), None
 
-    _, _, m, l, o = lax.fori_loop(0, n_shards, body, (k, v, m, l, o))
+    # scan, not fori_loop: same trip count, but scan is
+    # reverse-differentiable (ppermute transposes to the opposite
+    # rotation), so the ring composes into TRAINING steps — long-context
+    # models backprop through it (fori_loop would fail at jax.grad)
+    (_, _, m, l, o), _ = lax.scan(
+        body, (k, v, m, l, o), jnp.arange(n_shards))
     out = o / l[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False,
-                   data_axis=None):
+                   data_axis=None, head_axis=None):
     """q,k,v (B, T, H, D), T sharded over ``seq_axis``.
 
     ``data_axis``: optionally shard the batch dim over a second mesh
     axis (dp x sp on a pod-shaped mesh) — the ring rides the seq axis
-    within each data-parallel row, no cross-row traffic."""
-    scale = 1.0 / float(jnp.sqrt(q.shape[-1]))
+    within each data-parallel row, no cross-row traffic.
+    ``head_axis``: optionally shard the HEAD dim over a third mesh
+    axis (dp x sp x tp): attention is embarrassingly parallel over
+    heads, so a tensor-parallel axis composes with the ring at zero
+    extra communication."""
+    # math.sqrt, not jnp: the depth is a static shape, and the
+    # function must stay traceable inside an outer jit (train steps)
+    scale = 1.0 / math.sqrt(q.shape[-1])
     n_shards = mesh.shape[seq_axis]
     t_local = q.shape[1] // n_shards
 
@@ -94,7 +106,7 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False,
         return _ring_body(my, n_shards, t_local, seq_axis, causal,
                           scale, q_s, k_s, v_s)
 
-    spec = P(data_axis, seq_axis)
+    spec = P(data_axis, seq_axis, head_axis)
     fn = jax.shard_map(
         sharded, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
