@@ -164,3 +164,69 @@ def test_no_auto_fuse_on_cpu(cpu_device):
     assert getattr(sw, "fused_trainer", None) is None
     sw.run()
     assert sw.forwards[0].run_calls > 0
+
+
+@pytest.mark.slow
+def test_fused_snapshot_resume_on_real_tpu():
+    """Round-3 verdict item 9: snapshot/restore round trip ON THE CHIP
+    under the fused (auto-fuse default) path — train, snapshot
+    mid-training, restore, train on.  Donation + detach interactions
+    ("Array has been deleted") only reproduce on real TPU, where the
+    fused step donates its state buffers.  Subprocess because conftest
+    pins this process to the virtual CPU mesh."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "VELES_BACKEND")}
+    env["XLA_FLAGS"] = ""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(int(bool(d) and d[0].platform != 'cpu'))"],
+            env=env, capture_output=True, text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU probe timed out (runtime unresponsive)")
+    if probe.returncode != 0 or probe.stdout.strip() != "1":
+        pytest.skip("no real TPU attached")
+
+    code = """
+import pickle
+import sys
+sys.path.insert(0, %r)
+
+from tests.test_fused import _build_unfused
+from veles_tpu.dummy import DummyLauncher
+
+sw = _build_unfused(max_epochs=3)
+sw.initialize(device="tpu")      # auto-fuses (TPU default path)
+assert sw.fused_trainer is not None, "expected auto-fuse on TPU"
+sw.run()
+err_before = float(sw.decision.epoch_metrics[1])
+
+# snapshot mid-training on the chip: sync pulls the donated device
+# state back into the unit Arrays (prefetch_host sweep), then pickle
+sw.fused_trainer.sync()
+blob = pickle.dumps(sw)
+
+restored = pickle.loads(blob)
+restored.workflow = DummyLauncher()
+restored.restored_from_snapshot_ = True
+restored.decision.max_epochs = 6
+restored.decision.complete <<= False
+restored.initialize(device="tpu")   # re-adopts state; auto-fuse again
+assert restored.fused_trainer is not None
+restored.run()
+err_after = float(restored.decision.epoch_metrics[1])
+assert err_after <= err_before + 1.0, (err_before, err_after)
+print("RESUME_OK", err_before, err_after)
+""" % repo
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=900, cwd=repo)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    assert "RESUME_OK" in proc.stdout, proc.stdout[-2000:]

@@ -154,6 +154,40 @@ class Device(Pickleable, metaclass=BackendRegistry):
         return "<%s backend=%s>" % (type(self).__name__, self.BACKEND)
 
 
+_HOST_CPU_DEVICE = None
+
+
+def host_compute_context(device=None):
+    """Context manager pinning jax ops to the in-process host CPU.
+
+    The numpy backend's unit fallbacks evaluate the same jax math the
+    device path jits — but an unpinned eager op (or jit dispatch) runs
+    on jax's DEFAULT backend, which on a tunneled-TPU host is a remote
+    chip costing ~0.15 s of round trip PER OP: a 4 s host-side MLP
+    epoch measured ~45 s when left unpinned.  Every numpy-path call
+    site wraps itself in this context so "numpy backend" really means
+    "this host".
+
+    Pins when ``device`` is None or the numpy backend.  No-op for
+    real accelerator devices: the nn-unit call sites then take their
+    device-array paths instead, while host-array units (Kohonen, RBM)
+    deliberately dispatch to the accelerator and pay a transfer per
+    call — that is their accelerated mode, not an oversight.
+    """
+    import contextlib
+    global _HOST_CPU_DEVICE
+    if device is not None and not isinstance(device, NumpyDevice):
+        return contextlib.nullcontext()
+    if _HOST_CPU_DEVICE is None:
+        try:
+            import jax
+            _HOST_CPU_DEVICE = jax.local_devices(backend="cpu")[0]
+        except Exception:
+            return contextlib.nullcontext()
+    import jax
+    return jax.default_device(_HOST_CPU_DEVICE)
+
+
 _COMPILE_CACHE_SET = False
 
 

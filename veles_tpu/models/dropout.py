@@ -69,24 +69,34 @@ class DropoutForward(ForwardBase):
                 self.output.mem = numpy.array(self.input.mem)
             self.mask.reset()
             return
-        key = jax.random.PRNGKey(self.prng.seed_value or 0)
-        key = jax.random.fold_in(key, self._step)
+        seed = numpy.uint32((self.prng.seed_value or 0) & 0xffffffff)
+        step = numpy.uint32(self._step & 0xffffffff)
         if self.on_device():
             if self._jit_fn_ is None:
-                def fwd(k, x, ratio):
+                # seed/step ride as jit ARGUMENTS and the key is built
+                # inside the program: eager PRNGKey+fold_in per
+                # minibatch would cost two remote round trips each on
+                # a tunneled chip
+                def fwd(seed, step, x, ratio):
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(seed), step)
                     mask = DropoutForward.make_mask(
-                        k, x.shape, ratio, x.dtype)
+                        key, x.shape, ratio, x.dtype)
                     return x * mask, mask
-                self._jit_fn_ = jax.jit(fwd, static_argnums=(2,))
-            out, mask = self._jit_fn_(key, self.input.devmem,
+                self._jit_fn_ = jax.jit(fwd, static_argnums=(3,))
+            out, mask = self._jit_fn_(seed, step, self.input.devmem,
                                       self.dropout_ratio)
             self.output.set_device_array(out, self.device)
             self.mask.set_device_array(mask, self.device)
         else:
+            from veles_tpu.backends import host_compute_context
             self.input.map_read()
-            mask = numpy.asarray(DropoutForward.make_mask(
-                key, self.input.mem.shape, self.dropout_ratio,
-                self.input.mem.dtype))
+            with host_compute_context(self.device):
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(seed), step)
+                mask = numpy.asarray(DropoutForward.make_mask(
+                    key, self.input.mem.shape, self.dropout_ratio,
+                    self.input.mem.dtype))
             self.output.map_invalidate()
             self.output.mem = self.input.mem * mask
             self.mask.map_invalidate()

@@ -132,11 +132,13 @@ class EvaluatorSoftmax(EvaluatorBase):
                 self.confusion_matrix.set_device_array(
                     self._confusion_acc_, self.device)
             return
+        from veles_tpu.backends import host_compute_context
         self.output.map_read()
         self.labels.map_read()
-        err, n_err, confusion = EvaluatorSoftmax.compute(
-            self.output.mem, self.labels.mem,
-            numpy.float32(self.batch_size), n_classes)
+        with host_compute_context(self.device):
+            err, n_err, confusion = EvaluatorSoftmax.compute(
+                self.output.mem, self.labels.mem,
+                numpy.float32(self.batch_size), n_classes)
         self.err_output.map_invalidate()
         self.err_output.mem = numpy.asarray(err)
         self.n_err = int(n_err)
@@ -192,11 +194,13 @@ class EvaluatorMSE(EvaluatorBase):
             self.mse_sum = mse_sum
             self.n_samples = int(self.batch_size)
             return
+        from veles_tpu.backends import host_compute_context
         self.output.map_read()
         self.target.map_read()
-        err, mse_sum = EvaluatorMSE.compute(
-            self.output.mem, self.target.mem,
-            numpy.float32(self.batch_size), self.output.shape[0])
+        with host_compute_context(self.device):
+            err, mse_sum = EvaluatorMSE.compute(
+                self.output.mem, self.target.mem,
+                numpy.float32(self.batch_size), self.output.shape[0])
         self.err_output.map_invalidate()
         self.err_output.mem = numpy.asarray(err)
         self.mse_sum = float(mse_sum)

@@ -82,11 +82,17 @@ class KohonenForward(KohonenBase):
 
     def run(self):
         import jax
+
+        from veles_tpu.backends import host_compute_context
         if self._jit_fn_ is None:
             self._jit_fn_ = jax.jit(KohonenForward.winners)
         self.input.map_read()
         self.weights.map_read()
-        out = self._jit_fn_(self.weights.mem, self.input.mem)
+        # SOM units work on host arrays; pin the jit to the host CPU
+        # so a numpy-backend run never round-trips a remote default
+        # device per minibatch
+        with host_compute_context(self.device):
+            out = self._jit_fn_(self.weights.mem, self.input.mem)
         self.output.map_invalidate()
         self.output.mem = numpy.asarray(out)
 
@@ -130,10 +136,13 @@ class KohonenTrainer(KohonenBase):
         alpha = self.alpha * (self.alpha_decay ** self.time)
         radius = max(self.radius * (self.radius_decay ** self.time),
                      0.5)
+        from veles_tpu.backends import host_compute_context
         self.input.map_read()
         self.weights.map_read()
-        new_w = self._jit_fn_(
-            self.weights.mem, self.input.mem,
-            alpha=numpy.float32(alpha), radius=numpy.float32(radius))
+        with host_compute_context(self.device):
+            new_w = self._jit_fn_(
+                self.weights.mem, self.input.mem,
+                alpha=numpy.float32(alpha),
+                radius=numpy.float32(radius))
         self.weights.map_invalidate()
         self.weights.mem = numpy.asarray(new_w)

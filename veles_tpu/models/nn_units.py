@@ -145,10 +145,12 @@ class ForwardBase(Unit):
             jax.block_until_ready(out)
 
     def _numpy_run(self):
+        from veles_tpu.backends import host_compute_context
         params = self.params_numpy()
         self.input.map_read()
-        out = numpy.asarray(type(self).apply(
-            params, self.input.mem, **self.static_config()))
+        with host_compute_context(self.device):
+            out = numpy.asarray(type(self).apply(
+                params, self.input.mem, **self.static_config()))
         self.output.map_invalidate()
         self.output.mem = out
 
@@ -410,15 +412,17 @@ class GradientDescentBase(Unit):
             jax.block_until_ready(new_state)
 
     def _numpy_run(self):
+        from veles_tpu.backends import host_compute_context
         for arr in (self.input, self.output, self.err_output):
             arr.map_read()
-        err_input, new_state = type(self).backward(
-            self.state_numpy(), self.hyper_dict(),
-            self.input.mem, self.output.mem, self.err_output.mem,
-            solver=self.solver,
-            include_bias=self.include_bias and bool(self.bias),
-            need_err_input=self.need_err_input,
-            **self.backward_static())
+        with host_compute_context(self.device):
+            err_input, new_state = type(self).backward(
+                self.state_numpy(), self.hyper_dict(),
+                self.input.mem, self.output.mem, self.err_output.mem,
+                solver=self.solver,
+                include_bias=self.include_bias and bool(self.bias),
+                need_err_input=self.need_err_input,
+                **self.backward_static())
         if self.need_err_input and err_input is not None:
             self.err_input.map_invalidate()
             self.err_input.mem = numpy.asarray(err_input)

@@ -98,15 +98,21 @@ class RBM(Unit):
             self._jit_fn_ = jax.jit(functools.partial(
                 RBM.cd_step, cd_k=self.cd_k))
         self._step += 1
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(self.prng.seed_value or 0), self._step)
+        from veles_tpu.backends import host_compute_context
         for arr in (self.input, self.weights, self.hidden_bias,
                     self.visible_bias):
             arr.map_read()
-        new_w, new_hb, new_vb, err = self._jit_fn_(
-            key, self.weights.mem, self.hidden_bias.mem,
-            self.visible_bias.mem, self.input.mem,
-            numpy.float32(self.learning_rate))
+        # host arrays in, host arrays out: pin the jit AND the eager
+        # key construction to the host CPU so a numpy-backend run
+        # never round-trips a remote default device per minibatch
+        with host_compute_context(self.device):
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(self.prng.seed_value or 0),
+                self._step)
+            new_w, new_hb, new_vb, err = self._jit_fn_(
+                key, self.weights.mem, self.hidden_bias.mem,
+                self.visible_bias.mem, self.input.mem,
+                numpy.float32(self.learning_rate))
         self.weights.map_invalidate()
         self.weights.mem = numpy.asarray(new_w)
         self.hidden_bias.map_invalidate()
