@@ -25,8 +25,9 @@ import numpy
 
 from veles_tpu.models.nn_units import GradientDescentBase
 
-__all__ = ["LayerPlan", "build_train_step", "build_forward",
-           "workflow_plan", "extract_state", "adopt_state"]
+__all__ = ["LayerPlan", "build_train_step", "build_train_epoch",
+           "build_forward", "workflow_plan", "extract_state",
+           "adopt_state"]
 
 
 class LayerPlan(object):
@@ -142,16 +143,10 @@ def build_forward(plans):
     return forward
 
 
-def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
-                     state_shardings=None, batch_sharding=None,
-                     donate=True):
-    """Compile fn(state, x, labels_or_targets, batch_size) ->
-    (new_state, metrics).
-
-    state: list of dicts (weights/bias/accum*); metrics: {"loss", "n_err"}
-    (classification) or {"loss"} (mse).  batch_size is a traced scalar so
-    short minibatches don't retrigger compilation.
-    """
+def _build_step_fn(plans, loss):
+    """The raw (unjitted) train-step function shared by
+    build_train_step (which jits one minibatch per dispatch) and
+    build_train_epoch (which lax.scans it — one dispatch per epoch)."""
     import jax
     import jax.numpy as jnp
 
@@ -226,6 +221,23 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
                        "mse_sum": aux}
         return new_state, metrics
 
+    return step
+
+
+def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
+                     state_shardings=None, batch_sharding=None,
+                     donate=True):
+    """Compile fn(state, x, labels_or_targets, batch_size) ->
+    (new_state, metrics).
+
+    state: list of dicts (weights/bias/accum*); metrics: {"loss", "n_err"}
+    (classification) or {"loss"} (mse).  batch_size is a traced scalar so
+    short minibatches don't retrigger compilation.
+    """
+    import jax
+
+    step = _build_step_fn(plans, loss)
+
     jit_kwargs = {}
     if donate:
         jit_kwargs["donate_argnums"] = (0,)
@@ -248,3 +260,62 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
 def _labels_sharding(mesh, data_axis, loss):
     from jax.sharding import NamedSharding, PartitionSpec
     return NamedSharding(mesh, PartitionSpec(data_axis))
+
+
+def build_train_epoch(plans, batch, loss="softmax", donate=True):
+    """Compile fn(state, dataset, targets, order, key=None) ->
+    (new_state, epoch_metrics): the WHOLE epoch as one XLA dispatch.
+
+    ``lax.scan`` walks ``order`` in ``batch``-sized windows, gathering
+    each minibatch from the HBM-resident dataset (Pallas gather) and
+    applying the same train step build_train_step compiles — so on a
+    dispatch-bound model (small MLPs, remote-tunneled chips where each
+    dispatch costs ~0.2-0.8 ms) per-step cost collapses to pure
+    compute.  The per-step path remains the product default because
+    the decision unit gates per minibatch; this is the turbo path for
+    epoch-granular control (and what bench.py reports as mnist
+    ``scan_*`` rows).
+
+    ``targets``: int labels (softmax) or a float target array indexed
+    like the dataset (mse).  ``order`` (int32 (N,)) defines epoch
+    order; N // batch steps run, the tail remainder is skipped exactly
+    like a drop-last loader pass.  metrics: {"loss_mean", "n_err"}
+    (+"mse_sum" for mse), summed/averaged over the epoch's steps.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.ops.gather import gather_labels, gather_minibatch
+
+    step = _build_step_fn(plans, loss)
+
+    def epoch(state, dataset, targets, order, key=None):
+        n_steps = order.shape[0] // batch
+        if n_steps == 0:
+            # a zero-iteration scan would return mean([]) = NaN
+            # metrics with the state silently unchanged
+            raise ValueError(
+                "build_train_epoch: order holds %d indices, fewer "
+                "than one %d-sized minibatch" % (order.shape[0], batch))
+
+        def body(carry, i):
+            st = carry
+            idx = jax.lax.dynamic_slice(order, (i * batch,), (batch,))
+            x = gather_minibatch(dataset, idx)
+            if loss == "softmax":
+                y = gather_labels(targets, idx)
+            else:
+                y = gather_minibatch(targets, idx)
+            k = None if key is None else jax.random.fold_in(key, i)
+            st, m = step(st, x, y, jnp.float32(batch), k)
+            return st, m
+
+        state, ms = jax.lax.scan(body, state, jnp.arange(n_steps))
+        totals = {"loss_mean": ms["loss"].mean(),
+                  "n_err": ms["n_err"].sum()}
+        if "mse_sum" in ms:
+            totals["mse_sum"] = ms["mse_sum"].sum()
+        return state, totals
+
+    jit_kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(epoch, **jit_kwargs)
