@@ -69,12 +69,15 @@ SECTION_EST = {
     "alexnet_b256_float32": 230.0,
 }
 
-# a section whose dominant cost (the one-time server compile) mirrors
-# an already-measured sibling can shrink its estimate from the
+# a section whose dominant cost (the one-time server compile) loosely
+# tracks an already-measured sibling can shrink its estimate from the
 # sibling's actual wall time: on a quiet tunnel compiles run ~3x
 # faster than the conservative caps above, and a static estimate would
-# shed rows the window could actually fit.  Dynamic estimates only
-# ever SHRINK the static cap, never exceed it.
+# shed rows the window could actually fit.  The correlation is WEAK
+# (measured sibling ratios span 1.6-4.3x), so the dynamic estimate is
+# floored at 60% of the static cap and can only SHRINK it — the
+# worst-case overrun past the deadline stays within the ~120 s margin
+# to the driver's kill window.
 DYNAMIC_EST = {
     "alexnet_b256_float32": ("alexnet_b256_bfloat16", 1.3),
     "alexnet_b128_bfloat16": ("alexnet_b128", 1.3),
@@ -674,7 +677,7 @@ def main():
             # the shared compile cost — never shrink from it
             if measured and sibling[0] not in extras.get(
                     "section_errors", {}):
-                est = min(est, max(45.0, sibling[1] * measured))
+                est = min(est, max(0.6 * est, sibling[1] * measured))
         if not always and not small and remaining() < est:
             extras["shed"].append(name)
             return None
