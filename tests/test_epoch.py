@@ -94,3 +94,32 @@ def test_epoch_scan_donation_chains():
         st, totals = epoch(st, dataset, targets, order)
         losses.append(float(totals["loss_mean"]))
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("loss", ["softmax", "mse"])
+def test_eval_epoch_matches_direct_forward(loss):
+    from veles_tpu.compiler import build_eval_epoch, build_forward
+
+    plans, state, dataset, targets, order, batch = _setup(loss)
+    params = [{"weights": s["weights"], "bias": s["bias"]}
+              for s in state]
+    ev = build_eval_epoch(plans, batch, loss=loss)
+    got = ev(params, dataset, targets, order)
+
+    fwd = build_forward(plans)
+    n = (order.shape[0] // batch) * batch
+    idx = numpy.asarray(order)[:n]
+    x = numpy.asarray(dataset)[idx]
+    out = numpy.asarray(fwd(params, jnp.asarray(x)))
+    if loss == "softmax":
+        want = int((out.argmax(-1) != numpy.asarray(targets)[idx]).sum())
+        assert int(got["n_err"]) == want
+    else:
+        # forward output for mse plans has no softmax; match the
+        # evaluator's per-sample feature-mean sum
+        t = numpy.asarray(targets)[idx].reshape(n, -1)
+        diff = out.reshape(n, -1) - t
+        want = float((diff * diff).mean(axis=1).sum())
+        numpy.testing.assert_allclose(float(got["mse_sum"]), want,
+                                      rtol=1e-5)
+    assert int(got["samples"]) == n

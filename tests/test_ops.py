@@ -192,3 +192,54 @@ class TestXorshift:
         assert (u >= 0).all() and (u < 1).all()
         u2 = numpy.asarray(vrandom.hardware_uniform(7, (64, 128)))
         numpy.testing.assert_array_equal(u, u2)  # deterministic per seed
+
+
+def test_autotune_matmul_round_robin_picks_and_persists(tmp_path):
+    """The autotuner measures candidates round-robin (congestion drift
+    hits every tile equally), picks a majority-positive-median winner,
+    and persists it under the versioned key — or falls back to the
+    defaults WITHOUT persisting when timing jitter swamps every tile."""
+    from veles_tpu.backends import DeviceInfo
+    from veles_tpu.config import root
+    from veles_tpu.ops.matmul import (_DEFAULT_BLOCKS,
+                                       MATMUL_KERNEL_VERSION,
+                                       autotune_matmul)
+
+    saved = root.common.dirs.cache
+    root.common.dirs.cache = str(tmp_path)
+    try:
+        info = DeviceInfo("test-chip-kind")
+        key = "matmul:v%d:float32:pl0:s256" % MATMUL_KERNEL_VERSION
+        blocks = autotune_matmul(info, size=256)
+        assert len(blocks) == 3 and all(b > 0 for b in blocks)
+        if info.get(key) is not None:  # a tile was ranked
+            assert info.get(key) == list(blocks)
+        else:  # all-jitter fallback: defaults, deliberately unpersisted
+            assert blocks == _DEFAULT_BLOCKS
+    finally:
+        root.common.dirs.cache = saved
+
+
+def test_autotune_matmul_cache_hit_skips_measurement(tmp_path):
+    """A persisted entry is served verbatim — no timing runs."""
+    from veles_tpu.backends import DeviceInfo
+    from veles_tpu.config import root
+    from veles_tpu.ops.matmul import (MATMUL_KERNEL_VERSION,
+                                       autotune_matmul)
+
+    saved = root.common.dirs.cache
+    root.common.dirs.cache = str(tmp_path)
+    try:
+        info = DeviceInfo("test-chip-kind")
+        key = "matmul:v%d:float32:pl0:s256" % MATMUL_KERNEL_VERSION
+        sentinel = [128, 128, 128]  # not a real candidate: proves the
+        info.put(key, sentinel)     # value came from the cache
+        assert autotune_matmul(info, size=256) == tuple(sentinel)
+    finally:
+        root.common.dirs.cache = saved
+
+
+def test_estimate_computing_power_positive():
+    from veles_tpu.ops.benchmark import estimate_computing_power
+    power = estimate_computing_power(size=128, repeats=2)
+    assert power > 0

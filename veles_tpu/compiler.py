@@ -26,8 +26,8 @@ import numpy
 from veles_tpu.models.nn_units import GradientDescentBase
 
 __all__ = ["LayerPlan", "build_train_step", "build_train_epoch",
-           "build_forward", "workflow_plan", "extract_state",
-           "adopt_state"]
+           "build_eval_epoch", "build_forward", "workflow_plan",
+           "extract_state", "adopt_state"]
 
 
 class LayerPlan(object):
@@ -319,3 +319,55 @@ def build_train_epoch(plans, batch, loss="softmax", donate=True):
 
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
     return jax.jit(epoch, **jit_kwargs)
+
+
+def build_eval_epoch(plans, batch, loss="softmax"):
+    """Compile fn(params, dataset, targets, order) -> metrics: the
+    whole evaluation pass as one XLA dispatch.
+
+    The eval twin of :func:`build_train_epoch` — scans ``order`` in
+    ``batch``-sized windows, gathers each minibatch, runs the forward
+    (dropout layers are identity at eval), and accumulates metrics on
+    device: {"n_err", "samples"} for softmax, {"mse_sum", "samples"}
+    for mse (same definitions the evaluator units use, so epoch error
+    rates and RMSE are commensurate with the unit path).  ``params``
+    is the [{"weights", "bias"}] list build_forward consumes.  Like
+    the train scan, a tail shorter than ``batch`` is dropped — size
+    validation sets in batch multiples for exact coverage.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.ops.gather import gather_labels, gather_minibatch
+
+    def epoch(params, dataset, targets, order):
+        n_steps = order.shape[0] // batch
+        if n_steps == 0:
+            raise ValueError(
+                "build_eval_epoch: order holds %d indices, fewer "
+                "than one %d-sized minibatch" % (order.shape[0], batch))
+
+        def body(total, i):
+            idx = jax.lax.dynamic_slice(order, (i * batch,), (batch,))
+            x = gather_minibatch(dataset, idx)
+            out = _forward_for_loss(plans, params, x)
+            if loss == "softmax":
+                y = gather_labels(targets, idx)
+                valid = y >= 0
+                pred = jnp.argmax(out, axis=-1)
+                m = jnp.sum((pred != y) & valid).astype(jnp.int32)
+            else:
+                t = gather_minibatch(targets, idx)
+                diff = (out.reshape(out.shape[0], -1)
+                        - t.reshape(t.shape[0], -1))
+                m = jnp.sum(jnp.mean(diff * diff, axis=1))
+            return total + m, None
+
+        init = (jnp.zeros((), jnp.int32) if loss == "softmax"
+                else jnp.zeros((), jnp.float32))
+        total, _ = jax.lax.scan(body, init, jnp.arange(n_steps))
+        name = "n_err" if loss == "softmax" else "mse_sum"
+        return {name: total,
+                "samples": jnp.int32(n_steps * batch)}
+
+    return jax.jit(epoch)

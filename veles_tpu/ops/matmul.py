@@ -192,6 +192,24 @@ def _matmul_jit(a, b, precision_level, blocks, out_dtype, interpret):
     return unpad(out, (m, n))
 
 
+def _chain_slope(mm, a, repeats):
+    """One (chain(repeats+1) - chain(1)) / repeats slope sample over
+    dependent ``acc = mm(acc)`` chains ended by a scalar fetch — the
+    single shared definition of the matmul timing methodology (the
+    benchmark facade and the autotuner must never drift apart)."""
+    import time
+
+    def chain(n):
+        start = time.perf_counter()
+        acc = a
+        for _ in range(n):
+            acc = mm(acc)
+        float(acc[0, 0].astype(jnp.float32))
+        return time.perf_counter() - start
+
+    return (chain(repeats + 1) - chain(1)) / repeats
+
+
 def matmul_benchmark(size=3001, dtype=jnp.float32, precision_level=0,
                      repeats=10, blocks=None, samples=1):
     """Time the kernel on an NxN self-multiply — the same measurement the
@@ -211,8 +229,6 @@ def matmul_benchmark(size=3001, dtype=jnp.float32, precision_level=0,
     non-positive samples (never clamp: a floored nonsense slope once
     crowned the wrong autotune tile and published an impossible rate).
     """
-    import time
-
     import numpy
     a = jnp.asarray(
         (numpy.random.RandomState(13).rand(size, size) - 0.5) * 0.01,
@@ -224,16 +240,8 @@ def matmul_benchmark(size=3001, dtype=jnp.float32, precision_level=0,
 
     float(mm(a)[0, 0])  # compile + warmup
 
-    def chain(n):
-        start = time.perf_counter()
-        acc = a
-        for _ in range(n):
-            acc = mm(acc)
-        float(acc[0, 0])
-        return time.perf_counter() - start
-
-    slopes = sorted(
-        (chain(repeats + 1) - chain(1)) / repeats for _ in range(samples))
+    slopes = sorted(_chain_slope(mm, a, repeats)
+                    for _ in range(samples))
     mid = samples // 2
     return (slopes[mid] if samples % 2
             else (slopes[mid - 1] + slopes[mid]) / 2.0)
@@ -279,25 +287,59 @@ def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
         if clamped not in seen:
             seen.add(clamped)
             distinct.append((bm, bn, bk))
-    best, best_time = None, float("inf")
+    # ROUND-ROBIN measurement: whole-chip congestion drifts minute to
+    # minute (measured ~1.4x swings with tight within-run spreads), so
+    # timing each tile's samples back to back lets a congestion window
+    # crown the wrong tile.  Interleaving one sample of every tile per
+    # round spreads the drift across all candidates equally; the
+    # median over rounds then ranks honestly.  Operands are built once
+    # — a per-sample host->device upload would dominate the chains on
+    # a tunneled chip.
+    import numpy as _numpy
+    a = jnp.asarray(
+        (_numpy.random.RandomState(13).rand(size, size) - 0.5) * 0.01,
+        dtype=dtype)
+
+    def make_mm(blocks):
+        def mm(x):
+            return matmul(x, a, precision_level=precision_level,
+                          blocks=blocks)
+        return mm
+
+    # repeats=24: short chains (~8) can INVERT tile rankings on a
+    # tunneled chip — a config measured 192 TF over 20-step chains
+    # sustained only 86 TF over 100-step ones while the true winner
+    # sustained 135
+    repeats, rounds = 24, 5
+    mms = {}
     for blocks in distinct:
         try:
-            # repeats=24: short chains (~8) can INVERT tile rankings
-            # on a tunneled chip — a config measured 192 TF over
-            # 20-step chains sustained only 86 TF over 100-step ones
-            # while the true winner sustained 135
-            elapsed = matmul_benchmark(
-                size=size, dtype=dtype, precision_level=precision_level,
-                repeats=24, blocks=blocks, samples=5)
+            mm = make_mm(blocks)
+            float(mm(a)[0, 0].astype(jnp.float32))  # compile + warm;
+            mms[blocks] = mm   # VMEM-overflow tiles fail here
         except Exception:
             continue
-        if elapsed <= 0:
-            # tunnel jitter swamped the whole 5-sample median: this
-            # tile cannot be ranked — skip it rather than let a
-            # nonsense slope crown it (never clamp, validate)
+    samples = {blocks: [] for blocks in mms}
+    for _ in range(rounds):
+        for blocks, mm in mms.items():
+            try:
+                samples[blocks].append(_chain_slope(mm, a, repeats))
+            except Exception:
+                continue
+    best, best_time = None, float("inf")
+    for blocks, slopes in samples.items():
+        # the median runs over ALL samples and must be positive with a
+        # positive MAJORITY: filtering negatives first would let a
+        # jitter-swamped tile win on its two tiny surviving samples —
+        # the nonsense-slope crowning this function exists to prevent
+        positive = sum(1 for s in slopes if s > 0)
+        if not slopes or positive < len(slopes) // 2 + 1:
             continue
-        if elapsed < best_time:
-            best, best_time = blocks, elapsed
+        med = float(_numpy.median(slopes))
+        if med <= 0:
+            continue
+        if med < best_time:
+            best, best_time = blocks, med
     if best is None:
         import logging
         logging.getLogger("veles_tpu.autotune").warning(
