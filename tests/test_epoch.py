@@ -123,3 +123,25 @@ def test_eval_epoch_matches_direct_forward(loss):
         numpy.testing.assert_allclose(float(got["mse_sum"]), want,
                                       rtol=1e-5)
     assert int(got["samples"]) == n
+
+
+@pytest.mark.slow
+def test_digits_turbo_example_reaches_anchor_quality():
+    """The runnable three-gears example (examples/digits_turbo.py)
+    trains the real-digits anchor through the epoch-scan path to the
+    same quality class as the unit-graph workflow."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples",
+                                      "digits_turbo.py"),
+         "--backend", "cpu", "--epochs", "30"],
+        capture_output=True, text=True, timeout=300, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    last = [l for l in proc.stdout.splitlines()
+            if l.startswith("best validation error")][-1]
+    err = float(last.split()[3].rstrip("%"))
+    assert err < 4.0, last  # unit-graph anchor reaches 1.39%

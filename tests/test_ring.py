@@ -123,3 +123,18 @@ def test_ring_attention_3d_mesh_dp_sp_tp():
         qs, ks, vs, mesh, causal=True, data_axis="data",
         head_axis="model"))
     numpy.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_bf16_matches_oracle():
+    """bf16 inputs (the long-context serving dtype): the ring's f32
+    online-softmax accumulators keep it within bf16 tolerance."""
+    import jax.numpy as jnp
+    rng = numpy.random.RandomState(6)
+    q, k, v = _qkv(rng, batch=2, seq=32, heads=4, depth=8)
+    qb, kb, vb = (jnp.asarray(t, jnp.bfloat16) for t in (q, k, v))
+    mesh = make_mesh({"seq": 8})
+    want = numpy.asarray(attention_reference(
+        qb, kb, vb, causal=True).astype(jnp.float32))
+    got = numpy.asarray(ring_attention(
+        qb, kb, vb, mesh, causal=True).astype(jnp.float32))
+    numpy.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
