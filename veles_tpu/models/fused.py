@@ -14,7 +14,6 @@ every already-written config gains the fused path without changes.
 
 import numpy
 
-from veles_tpu.backends import NumpyDevice
 from veles_tpu.loader.base import TRAIN
 from veles_tpu.units import Unit
 

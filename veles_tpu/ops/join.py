@@ -8,7 +8,6 @@ expansion with Python-level metaprogramming over the kernel body.
 """
 
 import jax
-import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from veles_tpu.ops.common import interpret_for, kernel_cast

@@ -17,7 +17,6 @@ Both support causal masking with globally-correct positions and are
 exact (tested against a single-device oracle on the virtual mesh).
 """
 
-import functools
 import math
 
 import jax
