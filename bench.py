@@ -84,6 +84,53 @@ DYNAMIC_EST = {
 }
 
 
+def _compact_record(value, small, extras):
+    """The sub-500-byte sibling of the full record line.
+
+    The driver captures bench output through a byte-limited tail and
+    json-parses the LAST complete line; the full record grows past
+    4 KB by the final section and was captured mid-line two rounds
+    running (BENCH_r03/r04 ``parsed: null``).  This line carries the
+    required {metric, value, unit, vs_baseline} plus only the
+    BASELINE.md-row scalars, so the machine-readable record survives
+    any tail window >= ~500 bytes."""
+    n = 512 if small else N
+    rec = {"metric": "matmul_%dx%d_f32_avg_time" % (n, n),
+           "value": value,
+           "unit": "s",
+           "vs_baseline": (round(BASELINE_MATMUL_S / value, 2)
+                           if value and not small else None)}
+    mm = extras.get("matmul") or {}
+    bf = mm.get("bfloat16") or {}
+    if "tflops" in bf:
+        rec["bf16_tflops"] = bf["tflops"]
+    lvl1 = mm.get("float32_level1") or {}
+    if "tflops" in lvl1:
+        rec["f32_level1_tflops"] = lvl1["tflops"]
+    mn = extras.get("mnist_784_100_10") or {}
+    for src, dst in (("step_seconds", "mnist_step_s"),
+                     ("scan_step_seconds", "mnist_scan_step_s")):
+        if src in mn:
+            rec[dst] = mn[src]
+    alex = extras.get("alexnet") or {}
+    b256 = (alex.get("batch_256") or {}).get("bfloat16") or {}
+    if "images_per_sec" in b256:
+        rec["alexnet_b256_bf16_img_s"] = b256["images_per_sec"]
+    if "mfu_pct" in b256:
+        rec["alexnet_b256_bf16_mfu_pct"] = b256["mfu_pct"]
+    nat = extras.get("native_inference") or {}
+    for k in ("batch_1_rows_per_sec", "batch_256_rows_per_sec"):
+        if k in nat:
+            rec["native_" + k] = nat[k]
+    if "wall_s" in extras:
+        rec["wall_s"] = extras["wall_s"]
+    if extras.get("shed"):
+        rec["shed"] = len(extras["shed"])
+    if extras.get("section_errors"):
+        rec["errors"] = len(extras["section_errors"])
+    return rec
+
+
 class BenchError(RuntimeError):
     """A measurement failed plausibility checks after remeasurement.
 
@@ -653,9 +700,13 @@ def main():
         return deadline - time.monotonic()
 
     def emit():
-        """Print the full record line; the driver tail-parses the LAST
-        complete line, so every section makes the published record
-        strictly richer — a kill can only lose the unfinished tail."""
+        """Print the full record line, then its compact sibling.
+
+        The driver tail-parses the LAST complete line, so the compact
+        line (< 500 bytes, always whole inside any byte-limited tail)
+        is what gets machine-read; the full line right above it keeps
+        every section's detail for humans.  Both reprint after every
+        section, so a kill can only lose the unfinished tail."""
         n = 512 if small else N
         print(json.dumps({
             "metric": "matmul_%dx%d_f32_avg_time" % (n, n),
@@ -666,6 +717,8 @@ def main():
                 if result["value"] and not small else None),
             "extras": extras,
         }), flush=True)
+        print(json.dumps(_compact_record(result["value"], small,
+                                         extras)), flush=True)
 
     def section(name, fn, always=False):
         """Run one section under the deadline policy and emit."""
@@ -739,10 +792,16 @@ def main():
     else:
         section("alexnet_b256_bfloat16",
                 lambda: alex(256, "bfloat16"), always=True)
+    # floor the build-join budget at the section's own admission
+    # estimate: a section admitted under the deadline policy must get a
+    # join window consistent with that policy, not a near-zero clamp
+    # when the suite reaches here close to the deadline
     native_res = section(
         "native_inference",
-        lambda: bench_native(small, build_thread,
-                             wait_budget_s=remaining() - 30.0))
+        lambda: bench_native(
+            small, build_thread,
+            wait_budget_s=max(SECTION_EST["native_inference"],
+                              remaining() - 30.0)))
     if native_res is not None:
         extras["native_inference"] = native_res
 
