@@ -44,11 +44,11 @@ def main():
     Device(backend=args.backend)  # resolve + init backend/caches
 
     # same deterministic split the standard digits anchor trains on;
-    # the eval scan drops a sub-batch tail, so trim to batch multiples
+    # the epoch scans run sub-batch tails as masked steps, so the
+    # full validation set participates
     train_x, train_y, valid_x, valid_y = digits_arrays()
-    n_valid = (len(valid_x) // args.batch) * args.batch
-    data = numpy.concatenate([train_x, valid_x[:n_valid]])
-    labels = numpy.concatenate([train_y, valid_y[:n_valid]])
+    data = numpy.concatenate([train_x, valid_x])
+    labels = numpy.concatenate([train_y, valid_y])
     train_idx = numpy.arange(len(train_x))
     valid_idx = numpy.arange(len(train_x), len(data))
     rng = numpy.random.RandomState(2)

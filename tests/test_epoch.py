@@ -125,6 +125,86 @@ def test_eval_epoch_matches_direct_forward(loss):
     assert int(got["samples"]) == n
 
 
+@pytest.mark.parametrize("loss", ["softmax", "mse"])
+def test_epoch_scan_masked_tail_matches_stepwise(loss):
+    """Non-multiple split: the tail executes as one masked step and
+    must reproduce the per-step path run with a short final
+    minibatch — exact N-sample coverage, no drop-last."""
+    from veles_tpu.compiler import build_train_epoch, build_train_step
+    from veles_tpu.ops.gather import gather_labels, gather_minibatch
+
+    plans, state, dataset, targets, order, batch = _setup(loss)
+    n = 90  # 5 full 16-batches + a 10-sample tail
+    order = order[:n]
+    epoch = build_train_epoch(plans, batch, loss=loss, donate=False)
+    new_state, totals = epoch(state, dataset, targets, order)
+
+    step = build_train_step(plans, loss=loss, donate=False)
+    st = state
+    loss_weighted, n_err = 0.0, 0
+    for start in range(0, n, batch):
+        idx = order[start:start + batch]
+        size = int(idx.shape[0])
+        x = gather_minibatch(dataset, idx)
+        y = (gather_labels(targets, idx) if loss == "softmax"
+             else gather_minibatch(targets, idx))
+        st, m = step(st, x, y, numpy.float32(size))
+        loss_weighted += float(m["loss"]) * size
+        n_err += int(m["n_err"])
+
+    for got, want in zip(jax.tree.leaves(new_state),
+                         jax.tree.leaves(st)):
+        numpy.testing.assert_allclose(
+            numpy.asarray(got), numpy.asarray(want),
+            rtol=1e-5, atol=1e-6)
+    numpy.testing.assert_allclose(
+        float(totals["loss_mean"]), loss_weighted / n, rtol=1e-5)
+    assert int(totals["n_err"]) == n_err
+
+
+@pytest.mark.parametrize("loss", ["softmax", "mse"])
+def test_eval_epoch_masked_tail_exact_coverage(loss):
+    """Eval metrics must cover ALL N samples on a non-multiple split."""
+    from veles_tpu.compiler import build_eval_epoch, build_forward
+
+    plans, state, dataset, targets, order, batch = _setup(loss)
+    n = 90
+    order = order[:n]
+    params = [{"weights": s["weights"], "bias": s["bias"]}
+              for s in state]
+    ev = build_eval_epoch(plans, batch, loss=loss)
+    got = ev(params, dataset, targets, order)
+    assert int(got["samples"]) == n
+
+    fwd = build_forward(plans)
+    idx = numpy.asarray(order)
+    out = numpy.asarray(fwd(params, dataset[jnp.asarray(idx)]))
+    if loss == "softmax":
+        want = int((out.argmax(-1) != numpy.asarray(targets)[idx]).sum())
+        assert int(got["n_err"]) == want
+    else:
+        t = numpy.asarray(targets)[idx].reshape(n, -1)
+        diff = out.reshape(n, -1) - t
+        numpy.testing.assert_allclose(
+            float(got["mse_sum"]),
+            float((diff * diff).mean(axis=1).sum()), rtol=1e-5)
+
+
+def test_eval_epoch_samples_excludes_sentinel_labels():
+    """samples counts rows that entered the metric: sentinel (-1)
+    labels must not dilute n_err/samples (advisor r04)."""
+    from veles_tpu.compiler import build_eval_epoch
+
+    plans, state, dataset, targets, order, batch = _setup("softmax")
+    targets = numpy.asarray(targets).copy()
+    targets[:7] = -1  # 7 sentinel rows somewhere in the epoch
+    params = [{"weights": s["weights"], "bias": s["bias"]}
+              for s in state]
+    ev = build_eval_epoch(plans, batch, loss="softmax")
+    got = ev(params, dataset, jnp.asarray(targets), order)
+    assert int(got["samples"]) == order.shape[0] - 7
+
+
 @pytest.mark.slow
 def test_digits_turbo_example_reaches_anchor_quality():
     """The runnable three-gears example (examples/digits_turbo.py)

@@ -262,6 +262,27 @@ def _labels_sharding(mesh, data_axis, loss):
     return NamedSharding(mesh, PartitionSpec(data_axis))
 
 
+def _tail_schedule(order, batch, what):
+    """Static tail plan shared by the train/eval epoch scans:
+    ceil-div step count, edge-padded order (padded slots are masked
+    out by the callers), per-step valid-row counts."""
+    import jax.numpy as jnp
+
+    n = order.shape[0]
+    n_steps = -(-n // batch)
+    if n_steps == 0:
+        # a zero-iteration scan would return empty metrics with the
+        # state silently unchanged
+        raise ValueError("%s: order is empty (batch %d)" % (what, batch))
+    pad = n_steps * batch - n
+    if pad:
+        order = jnp.pad(order, (0, pad), mode="edge")
+    sizes = jnp.full((n_steps,), batch, jnp.int32)
+    if pad:
+        sizes = sizes.at[n_steps - 1].set(batch - pad)
+    return order, sizes, n_steps, n
+
+
 def build_train_epoch(plans, batch, loss="softmax", donate=True):
     """Compile fn(state, dataset, targets, order, key=None) ->
     (new_state, epoch_metrics): the WHOLE epoch as one XLA dispatch.
@@ -278,9 +299,12 @@ def build_train_epoch(plans, batch, loss="softmax", donate=True):
 
     ``targets``: int labels (softmax) or a float target array indexed
     like the dataset (mse).  ``order`` (int32 (N,)) defines epoch
-    order; N // batch steps run, the tail remainder is skipped exactly
-    like a drop-last loader pass.  metrics: {"loss_mean", "n_err"}
-    (+"mse_sum" for mse), summed/averaged over the epoch's steps.
+    order; ceil(N / batch) steps run — a tail shorter than ``batch``
+    executes as one masked step (padded slots carry sentinel labels /
+    zeroed residuals, so they contribute nothing to gradients or
+    metrics), giving exact N-sample coverage like the unit path.
+    metrics: {"loss_mean", "n_err"} (+"mse_sum" for mse); loss_mean is
+    the sample-weighted epoch mean.
     """
     import jax
     import jax.numpy as jnp
@@ -290,28 +314,30 @@ def build_train_epoch(plans, batch, loss="softmax", donate=True):
     step = _build_step_fn(plans, loss)
 
     def epoch(state, dataset, targets, order, key=None):
-        n_steps = order.shape[0] // batch
-        if n_steps == 0:
-            # a zero-iteration scan would return mean([]) = NaN
-            # metrics with the state silently unchanged
-            raise ValueError(
-                "build_train_epoch: order holds %d indices, fewer "
-                "than one %d-sized minibatch" % (order.shape[0], batch))
+        order, sizes, n_steps, n = _tail_schedule(
+            order, batch, "build_train_epoch")
+        sizes = sizes.astype(jnp.float32)  # step's batch_size arg
 
-        def body(carry, i):
+        def body(carry, scans):
             st = carry
+            i, size = scans
             idx = jax.lax.dynamic_slice(order, (i * batch,), (batch,))
             x = gather_minibatch(dataset, idx)
             if loss == "softmax":
                 y = gather_labels(targets, idx)
+                # padded slots -> sentinel label: excluded from the CE
+                # sum, n_err, and gradients by the loss's valid mask
+                y = jnp.where(jnp.arange(batch) < size, y, -1)
             else:
+                # mse loss masks rows >= batch_size itself
                 y = gather_minibatch(targets, idx)
             k = None if key is None else jax.random.fold_in(key, i)
-            st, m = step(st, x, y, jnp.float32(batch), k)
+            st, m = step(st, x, y, size, k)
             return st, m
 
-        state, ms = jax.lax.scan(body, state, jnp.arange(n_steps))
-        totals = {"loss_mean": ms["loss"].mean(),
+        state, ms = jax.lax.scan(body, state,
+                                 (jnp.arange(n_steps), sizes))
+        totals = {"loss_mean": jnp.sum(ms["loss"] * sizes) / n,
                   "n_err": ms["n_err"].sum()}
         if "mse_sum" in ms:
             totals["mse_sum"] = ms["mse_sum"].sum()
@@ -331,9 +357,11 @@ def build_eval_epoch(plans, batch, loss="softmax"):
     device: {"n_err", "samples"} for softmax, {"mse_sum", "samples"}
     for mse (same definitions the evaluator units use, so epoch error
     rates and RMSE are commensurate with the unit path).  ``params``
-    is the [{"weights", "bias"}] list build_forward consumes.  Like
-    the train scan, a tail shorter than ``batch`` is dropped — size
-    validation sets in batch multiples for exact coverage.
+    is the [{"weights", "bias"}] list build_forward consumes.  A tail
+    shorter than ``batch`` runs as one masked step, so metrics cover
+    all N samples exactly; ``samples`` counts the rows that actually
+    entered the metric (valid labels for softmax), making
+    n_err/samples an undiluted error rate even with sentinel labels.
     """
     import jax
     import jax.numpy as jnp
@@ -341,33 +369,37 @@ def build_eval_epoch(plans, batch, loss="softmax"):
     from veles_tpu.ops.gather import gather_labels, gather_minibatch
 
     def epoch(params, dataset, targets, order):
-        n_steps = order.shape[0] // batch
-        if n_steps == 0:
-            raise ValueError(
-                "build_eval_epoch: order holds %d indices, fewer "
-                "than one %d-sized minibatch" % (order.shape[0], batch))
+        order, sizes, n_steps, _ = _tail_schedule(
+            order, batch, "build_eval_epoch")
 
-        def body(total, i):
+        def body(carry, scans):
+            total, count = carry
+            i, size = scans
             idx = jax.lax.dynamic_slice(order, (i * batch,), (batch,))
             x = gather_minibatch(dataset, idx)
             out = _forward_for_loss(plans, params, x)
+            slot = jnp.arange(batch) < size
             if loss == "softmax":
                 y = gather_labels(targets, idx)
-                valid = y >= 0
+                valid = (y >= 0) & slot
                 pred = jnp.argmax(out, axis=-1)
                 m = jnp.sum((pred != y) & valid).astype(jnp.int32)
+                c = jnp.sum(valid).astype(jnp.int32)
             else:
                 t = gather_minibatch(targets, idx)
                 diff = (out.reshape(out.shape[0], -1)
                         - t.reshape(t.shape[0], -1))
+                diff = diff * slot[:, None].astype(diff.dtype)
                 m = jnp.sum(jnp.mean(diff * diff, axis=1))
-            return total + m, None
+                c = size
+            return (total + m, count + c), None
 
-        init = (jnp.zeros((), jnp.int32) if loss == "softmax"
-                else jnp.zeros((), jnp.float32))
-        total, _ = jax.lax.scan(body, init, jnp.arange(n_steps))
+        init = ((jnp.zeros((), jnp.int32) if loss == "softmax"
+                 else jnp.zeros((), jnp.float32)),
+                jnp.zeros((), jnp.int32))
+        (total, count), _ = jax.lax.scan(
+            body, init, (jnp.arange(n_steps), sizes))
         name = "n_err" if loss == "softmax" else "mse_sum"
-        return {name: total,
-                "samples": jnp.int32(n_steps * batch)}
+        return {name: total, "samples": count}
 
     return jax.jit(epoch)
