@@ -84,6 +84,18 @@ DYNAMIC_EST = {
 }
 
 
+def _headline_quadruple(value, small):
+    """The required {metric, value, unit, vs_baseline} — built in one
+    place so the full record line and its compact sibling can never
+    disagree on the headline."""
+    n = 512 if small else N
+    return {"metric": "matmul_%dx%d_f32_avg_time" % (n, n),
+            "value": value,
+            "unit": "s",
+            "vs_baseline": (round(BASELINE_MATMUL_S / value, 2)
+                            if value and not small else None)}
+
+
 def _compact_record(value, small, extras):
     """The sub-500-byte sibling of the full record line.
 
@@ -94,12 +106,7 @@ def _compact_record(value, small, extras):
     required {metric, value, unit, vs_baseline} plus only the
     BASELINE.md-row scalars, so the machine-readable record survives
     any tail window >= ~500 bytes."""
-    n = 512 if small else N
-    rec = {"metric": "matmul_%dx%d_f32_avg_time" % (n, n),
-           "value": value,
-           "unit": "s",
-           "vs_baseline": (round(BASELINE_MATMUL_S / value, 2)
-                           if value and not small else None)}
+    rec = _headline_quadruple(value, small)
     mm = extras.get("matmul") or {}
     bf = mm.get("bfloat16") or {}
     if "tflops" in bf:
@@ -707,16 +714,9 @@ def main():
         is what gets machine-read; the full line right above it keeps
         every section's detail for humans.  Both reprint after every
         section, so a kill can only lose the unfinished tail."""
-        n = 512 if small else N
-        print(json.dumps({
-            "metric": "matmul_%dx%d_f32_avg_time" % (n, n),
-            "value": result["value"],
-            "unit": "s",
-            "vs_baseline": (
-                round(BASELINE_MATMUL_S / result["value"], 2)
-                if result["value"] and not small else None),
-            "extras": extras,
-        }), flush=True)
+        full = _headline_quadruple(result["value"], small)
+        full["extras"] = extras
+        print(json.dumps(full), flush=True)
         print(json.dumps(_compact_record(result["value"], small,
                                          extras)), flush=True)
 
@@ -756,7 +756,6 @@ def main():
         time.monotonic() - t0, 1)
     extras["matmul"] = matmul_res
     result["value"] = matmul_res["float32"]["seconds"]
-    headline_passes = [matmul_res["float32"]["seconds"]]
     emit()
 
     mnist = section("mnist", lambda: bench_mnist(small), always=True)
@@ -816,7 +815,6 @@ def main():
         from veles_tpu.backends import DeviceInfo
         second = bench_matmul(small)  # in-process jit cache: no compile
         info = DeviceInfo(jax.devices()[0].device_kind)
-        headline_passes.append(second["float32"]["seconds"])
         # snapshot BOTH independent passes before the min-selection
         # below overwrites matmul_res: the ceiling ratchet must see
         # pass1 vs pass2, not winner vs itself
@@ -826,15 +824,16 @@ def main():
 
             def plausible(res):
                 return limit is None or res["tflops"] <= limit
-            candidates = [r for r in (matmul_res[dtype_name],
-                                      second[dtype_name])
-                          if plausible(r)]
+            passes = (matmul_res[dtype_name], second[dtype_name])
+            candidates = [r for r in passes if plausible(r)]
             if not candidates:  # both spiked: keep the slower
-                candidates = [max((matmul_res[dtype_name],
-                                   second[dtype_name]),
-                                  key=lambda r: r["seconds"])]
-            matmul_res[dtype_name] = min(
-                candidates, key=lambda r: r["seconds"])
+                candidates = [max(passes, key=lambda r: r["seconds"])]
+            winner = dict(min(candidates, key=lambda r: r["seconds"]))
+            # both rows publish their pass list, so the best-of choice
+            # is auditable per dtype (round-4 verdict: the bf16 number
+            # lacked the f32 row's defensibility)
+            winner["passes"] = [round(r["seconds"], 9) for r in passes]
+            matmul_res[dtype_name] = winner
         # persist the f32 ceiling from the SLOWER of two plausible
         # passes: a single congestion-free spike cannot ratchet the
         # guard, but a genuinely faster kernel (seen twice) can
@@ -851,8 +850,6 @@ def main():
                 info.put(_f32_ceiling_key(),
                          round(min(agreed, cap), 2))
         extras["matmul"] = matmul_res
-        extras["matmul"]["headline_passes"] = [
-            round(s, 9) for s in headline_passes]
         result["value"] = matmul_res["float32"]["seconds"]
         return True
 

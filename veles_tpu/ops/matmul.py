@@ -268,13 +268,15 @@ def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
     candidates = [(256, 256, 256), (512, 512, 512), (512, 512, 1024),
                   (512, 512, 2048), (256, 256, 1024), (512, 1024, 512),
                   (1024, 512, 512), (256, 512, 1024)]
-    if jnp.dtype(dtype) == jnp.float32 and precision_level == 0:
-        # taller-M / wider-N tiles for the bf16x3 f32 path only (three
-        # dots per K-step shift the VMEM/compute balance): a
-        # (768, 512, 512) tile measured ~1.25x over (512, 512, 512)
-        # at 3001^2 on v5e, round-robin-validated against congestion.
-        # Other dtypes/levels skip them — each extra tile costs a
-        # fresh compile + 5 timing samples on a cold cache.
+    if jnp.dtype(dtype) == jnp.float32 and precision_level in (0, 1):
+        # taller-M / wider-N tiles for the f32 paths (level 0's three
+        # bf16 dots per K-step and level 1's six-pass HIGHEST products
+        # + Kahan both shift the VMEM/compute balance away from the
+        # square default): a (768, 512, 512) tile measured ~1.25x over
+        # (512, 512, 512) at 3001^2 on v5e for level 0, round-robin-
+        # validated against congestion.  bf16/level 2 skip them — each
+        # extra tile costs a fresh compile + 5 timing samples on a
+        # cold cache.
         candidates += [(768, 512, 512), (640, 512, 512),
                        (512, 640, 512), (512, 640, 640)]
     # at small sizes several tiles clamp to the same effective blocks
