@@ -96,6 +96,53 @@ def _member_factory(member, seed):
     )
 
 
+def test_optimizer_farms_over_control_plane():
+    """One GA generation evaluated as control-plane jobs: a farm
+    master + 2 in-process slave workers (reference
+    genetics/optimization_workflow.py:186-221 farmed chromosome
+    evaluations to slaves)."""
+    spec = {"x": Tune(0.0, -2.0, 2.0), "y": Tune(0.0, -2.0, 2.0)}
+
+    def fitness(candidate):
+        return -((candidate["x"] - 1.0) ** 2
+                 + (candidate["y"] - 0.5) ** 2)
+
+    opt = GeneticsOptimizer(
+        spec, fitness, generations=1, population=8, farm_slaves=2,
+        rng=RandomGenerator("gfarm", seed=5))
+    best_spec, best_fitness = opt.run()
+    # every chromosome came back evaluated through the farm
+    assert all(c.fitness is not None for c in opt.population.chromosomes)
+    assert best_fitness == max(
+        c.fitness for c in opt.population.chromosomes)
+    assert -9.0 < best_fitness <= 0.0
+
+
+def test_ensemble_trains_distributed_over_control_plane(
+        tmp_path, cpu_device):
+    """4-member ensemble farmed as jobs through a master + 2
+    in-process slaves (reference ensemble/base_workflow.py:135-153
+    distributed member training the same way)."""
+    trainer = EnsembleTrainer(
+        _member_factory, size=4, directory=str(tmp_path),
+        device=cpu_device, farm_slaves=2)
+    results_path = trainer.run()
+    assert [e["id"] for e in trainer.results] == [0, 1, 2, 3]
+    assert all(e["metrics"][1] is not None for e in trainer.results)
+
+    tester = EnsembleTester(results_path, device=cpu_device)
+    wf = DummyWorkflow()
+    loader = BlobsLoader(wf, minibatch_size=64,
+                         prng=RandomGenerator("enstest2", seed=78))
+    loader.initialize(device=None)
+    x = loader.original_data.mem[64:128]
+    labels = numpy.array(
+        [loader.labels_mapping[loader.original_labels[i]]
+         for i in range(64, 128)])
+    err = tester.error_rate(x, labels)
+    assert err < 10.0, "ensemble error %.1f%%" % err
+
+
 def test_ensemble_train_and_test(tmp_path, cpu_device):
     trainer = EnsembleTrainer(
         _member_factory, size=3, directory=str(tmp_path),

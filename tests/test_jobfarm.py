@@ -1,0 +1,229 @@
+"""Control-plane job farming (veles_tpu.jobfarm): the task-parallel
+plane the reference drove through its master-slave protocol for
+genetics evaluations and ensemble member training (reference:
+ensemble/base_workflow.py:135-153,
+genetics/optimization_workflow.py:186-221)."""
+
+import threading
+import time
+
+import pytest
+
+from veles_tpu.jobfarm import (FarmJobError, JobFarm, _FarmMaster,
+                               _UNSET)
+from veles_tpu.server import SlaveDescription
+
+
+def test_farm_two_local_slaves_all_results_in_order():
+    seen = []
+    lock = threading.Lock()
+
+    def runner(spec):
+        with lock:
+            seen.append(spec)
+        return spec * spec
+
+    results = JobFarm("sq").run(range(10), runner=runner,
+                                local_slaves=2, timeout=60)
+    assert results == [i * i for i in range(10)]
+    assert sorted(set(seen)) == list(range(10))
+
+
+def test_farm_runner_error_fails_loudly():
+    def runner(spec):
+        if spec == 3:
+            raise ValueError("boom")
+        return spec
+
+    with pytest.raises(FarmJobError, match=r"job 3.*boom"):
+        JobFarm("errs").run(range(5), runner=runner,
+                            local_slaves=2, timeout=60)
+
+
+def test_farm_remote_style_worker_joins():
+    """No local slaves: a worker connects the way a remote host would
+    (same tag, address learned from the bound server)."""
+    def start_worker(server):
+        threading.Thread(
+            target=JobFarm("remote").worker,
+            args=("127.0.0.1:%d" % server.port, lambda s: s + 1),
+            daemon=True).start()
+
+    results = JobFarm("remote").run(
+        range(6), on_listening=start_worker, timeout=60)
+    assert results == [1, 2, 3, 4, 5, 6]
+
+
+def test_farm_persistent_batches_reuse_workers():
+    """start/submit/submit/shutdown: one server, several batches —
+    the GA-per-generation pattern."""
+    farm = JobFarm("persist").start(runner=lambda s: s * 2,
+                                    local_slaves=2)
+    try:
+        assert farm.submit(range(5), timeout=60) == [0, 2, 4, 6, 8]
+        assert farm.submit(range(3), timeout=60) == [0, 2, 4]
+        assert farm.submit([], timeout=60) == []
+    finally:
+        farm.shutdown()
+
+
+def test_remote_worker_survives_between_batches():
+    """A remote-style worker connected once must serve EVERY batch
+    (round-4 verdict: a server torn down per generation silently
+    lost all remote capacity after generation 0)."""
+    farm = JobFarm("persist2").start()
+    jobs_done = {}
+
+    def work():
+        jobs_done["n"] = JobFarm("persist2").worker(
+            farm.address, lambda s: s + 1)
+
+    thread = threading.Thread(target=work, daemon=True)
+    thread.start()
+    try:
+        assert farm.submit(range(4), timeout=60) == [1, 2, 3, 4]
+        assert farm.submit(range(4, 8), timeout=60) == [5, 6, 7, 8]
+    finally:
+        farm.shutdown()
+    thread.join(10)
+    assert jobs_done["n"] == 8
+
+
+def test_watchdog_speculation_rescues_wedged_job():
+    """Clients park passively (no wait-poll), so the straggler
+    threshold must be re-evaluated by the server's watchdog tick:
+    a wedged job's backup copy reaches the parked idle worker with
+    NO update traffic to trigger a release."""
+    calls = {"slow": 0}
+    lock = threading.Lock()
+    wedge = threading.Event()
+
+    def runner(spec):
+        if spec == "slow":
+            with lock:
+                calls["slow"] += 1
+                first = calls["slow"] == 1
+            if first:
+                wedge.wait(30)  # wedged until the test releases it
+            return "rescued"
+        return spec
+
+    farm = JobFarm("wedge", speculation_factor=1.0,
+                   min_speculation_s=0.6).start(runner=runner,
+                                                local_slaves=2)
+    try:
+        res = farm.submit(["a", "b", "slow"], timeout=15)
+    finally:
+        wedge.set()
+        farm.shutdown()
+    assert res == ["a", "b", "rescued"]
+    assert calls["slow"] == 2  # the backup copy actually ran
+
+
+def test_farm_timeout_reports_unfinished():
+    with pytest.raises(FarmJobError, match="2/2 jobs unfinished"):
+        JobFarm("idle").run([1, 2], timeout=0.5)  # nobody works
+
+
+def test_farm_bind_failure_raises_instead_of_hanging():
+    farm = JobFarm("bind1").start()
+    try:
+        with pytest.raises(RuntimeError, match="failed to bind"):
+            JobFarm("bind2").start(
+                address="127.0.0.1:%d" % farm.server.port)
+    finally:
+        farm.shutdown()
+
+
+def _slave(sid):
+    return SlaveDescription(sid, "mid", 0, 1.0)
+
+
+def _master(jobs, **kwargs):
+    m = _FarmMaster("c", **kwargs)
+    m.reset(jobs)
+    return m
+
+
+def test_master_speculates_only_past_straggler_threshold():
+    m = _master(["a", "b"], speculation_factor=2.0,
+                min_speculation_s=2.0)
+    e = m.epoch
+    s1, s2 = _slave("s1"), _slave("s2")
+    assert m.generate_data_for_slave(s1) == (e, 0, "a")
+    assert m.generate_data_for_slave(s2) == (e, 1, "b")
+    m.apply_data_from_slave((e, 1, ("ok", "B")), s2)
+    # completed durations exist but job 0 only just started: a fresh
+    # job is NOT re-issued...
+    m._durations.clear()
+    m._durations.append(1.0)
+    assert m.generate_data_for_slave(s2) is False
+    # ...but once it straggles past the threshold, an idle slave
+    # shadows it (backup task)
+    m._outstanding[0][s1.id] = time.time() - 100.0
+    assert m.generate_data_for_slave(s2) == (e, 0, "a")
+    # never a second copy for the same slave
+    assert m.generate_data_for_slave(s2) is False
+    # first result wins; the straggler's late duplicate is ignored
+    m.apply_data_from_slave((e, 0, ("ok", "from_s2")), s2)
+    assert m.done.is_set()
+    m.apply_data_from_slave((e, 0, ("ok", "late")), s1)
+    assert m.results == [("ok", "from_s2"), ("ok", "B")]
+
+
+def test_master_ignores_stale_epoch_updates():
+    """A duplicate surviving from a PREVIOUS batch must not land in
+    the current batch's slot (measured failure mode: a six-batch-old
+    result surfacing in a later submit)."""
+    m = _master(["a"])
+    s1 = _slave("s1")
+    old = m.epoch
+    assert m.generate_data_for_slave(s1) == (old, 0, "a")
+    m.apply_data_from_slave((old, 0, ("ok", "old")), s1)
+    m.reset(["a2"])
+    assert m.generate_data_for_slave(s1) == (old + 1, 0, "a2")
+    # the late duplicate from the previous epoch is dropped
+    m.apply_data_from_slave((old, 0, ("ok", "stale")), s1)
+    assert not m.done.is_set()
+    assert m.results == [_UNSET]
+    m.apply_data_from_slave((old + 1, 0, ("ok", "fresh")), s1)
+    assert m.results == [("ok", "fresh")]
+
+
+def test_master_never_speculates_without_completed_durations():
+    m = _master(["a", "b"])
+    e = m.epoch
+    s1, s2 = _slave("s1"), _slave("s2")
+    m.generate_data_for_slave(s1)
+    m._outstanding[0][s1.id] = time.time() - 1e6  # ancient straggler
+    # no completed job yet -> no credible mean -> no backup copies
+    assert m.generate_data_for_slave(s2) == (e, 1, "b")
+    assert m.generate_data_for_slave(s2) is False
+
+
+def test_master_requeues_when_every_copy_dies():
+    m = _master(["a"])
+    e = m.epoch
+    s1, s2 = _slave("s1"), _slave("s2")
+    assert m.generate_data_for_slave(s1) == (e, 0, "a")
+    m.drop_slave(s1)
+    assert not m.done.is_set()
+    # the orphaned job is served again to the next requester
+    assert m.generate_data_for_slave(s2) == (e, 0, "a")
+    m.apply_data_from_slave((e, 0, ("ok", 1)), s2)
+    assert m.done.is_set()
+
+
+def test_master_keeps_job_with_surviving_backup():
+    m = _master(["a"], speculation_factor=2.0, min_speculation_s=2.0)
+    e = m.epoch
+    s1, s2 = _slave("s1"), _slave("s2")
+    m.generate_data_for_slave(s1)
+    m._durations.append(0.001)
+    m._outstanding[0][s1.id] = time.time() - 100.0
+    assert m.generate_data_for_slave(s2) == (e, 0, "a")  # backup copy
+    m.drop_slave(s1)
+    # not requeued: s2 still runs its copy
+    assert not m._pending
+    m.apply_data_from_slave((e, 0, ("ok", 1)), s2)
+    assert m.done.is_set()

@@ -80,6 +80,15 @@ class Main(object):
             "--ensemble-dir", default="ensemble",
             help="ensemble output directory")
         parser.add_argument(
+            "--farm-slaves", type=int, default=0, metavar="N",
+            help="farm --optimize/--ensemble-train jobs over the "
+                 "control plane with N local workers; the bound "
+                 "address is logged so remote workers can join")
+        parser.add_argument(
+            "--farm-address", default="127.0.0.1:0", metavar="HOST:PORT",
+            help="bind address for the job-farm master (use "
+                 "0.0.0.0:PORT to accept off-host workers)")
+        parser.add_argument(
             "--frontend", nargs="?", const="8080", default=None,
             metavar="PORT",
             help="serve the web command composer instead of running "
@@ -313,7 +322,9 @@ class Main(object):
                              "fitness(spec) in the workflow module")
         optimizer = GeneticsOptimizer(
             spec_fn(), fitness, generations=int(gens),
-            population=int(pop) if pop else 12)
+            population=int(pop) if pop else 12,
+            farm_slaves=args.farm_slaves,
+            farm_address=args.farm_address)
         best_spec, best_fitness = optimizer.run()
         print("best fitness %.6f with %s" % (best_fitness, best_spec))
         if args.result_file:
@@ -335,7 +346,8 @@ class Main(object):
         trainer = EnsembleTrainer(
             factory, size=int(n), directory=args.ensemble_dir,
             train_ratio=float(ratio) if ratio else 1.0,
-            device=args.device)
+            device=args.device, farm_slaves=args.farm_slaves,
+            farm_address=args.farm_address)
         path = trainer.run()
         print("ensemble results -> %s" % path)
         return self.EXIT_SUCCESS

@@ -207,8 +207,14 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                 self._paused = False
                 continue
             if mtype == "wait":
-                await asyncio.sleep(0.1)
-                self._send(writer, {"type": "job_request"})
+                # parked server-side at a sync point; the master
+                # releases parked requesters itself (on updates, on
+                # resume, on new farm batches).  Re-requesting here
+                # would DOUBLE-SERVE: the release path and the poll
+                # both hand out jobs, the per-connection backlog grows
+                # without bound, and queued updates overrun the
+                # two-slot shm channel (measured: stale results
+                # surfacing six farm batches late)
                 continue
             if mtype == "update_ack":
                 continue

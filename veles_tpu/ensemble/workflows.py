@@ -25,10 +25,24 @@ __all__ = ["EnsembleTrainer", "EnsembleTester"]
 
 
 class EnsembleTrainer(Logger):
-    """Train ``size`` members; persist snapshots + a results JSON."""
+    """Train ``size`` members; persist snapshots + a results JSON.
+
+    ``farm_slaves`` > 0 farms member training as control-plane jobs
+    (the reference distributed members as master-slave jobs,
+    ensemble/base_workflow.py:135-153): a job farm master serves
+    member indices, ``farm_slaves`` in-process workers train them
+    concurrently, and remote hosts may join via
+    :meth:`worker` against ``farm_address``.  Snapshots land on the
+    filesystem of whichever worker trained the member — same-host
+    workers (the default) share ``directory``; cross-host setups need
+    it on a shared mount, exactly like the reference's child-process
+    result files."""
+
+    FARM_TAG = "ensemble"
 
     def __init__(self, workflow_factory, size, directory,
-                 train_ratio=1.0, device=None, base_seed=1000):
+                 train_ratio=1.0, device=None, base_seed=1000,
+                 farm_slaves=0, farm_address="127.0.0.1:0"):
         super(EnsembleTrainer, self).__init__()
         self.workflow_factory = workflow_factory
         self.size = size
@@ -36,39 +50,74 @@ class EnsembleTrainer(Logger):
         self.train_ratio = train_ratio
         self.device = device
         self.base_seed = base_seed
+        self.farm_slaves = farm_slaves
+        self.farm_address = farm_address
         self.results = []
 
     @property
     def results_path(self):
         return os.path.join(self.directory, "ensemble.json")
 
+    def train_member(self, i):
+        """Train one member end to end; returns its results entry.
+        This is the farmed job body — self-contained so any worker
+        (thread here, remote host via :meth:`worker`) can run it."""
+        seed = self.base_seed + i
+        sw = self.workflow_factory(i, seed)
+        sw.initialize(device=self.device)
+        sw.run()
+        snapshot = os.path.join(self.directory,
+                                "member_%03d.pickle" % i)
+        # atomic publish: a speculative backup copy of this job (farm
+        # straggler shadowing) may write the same path concurrently
+        tmp = "%s.%d.tmp" % (snapshot, os.getpid() ^ id(sw))
+        with open(tmp, "wb") as fout:
+            pickle.dump(sw, fout, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, snapshot)
+        entry = {
+            "id": i,
+            "seed": seed,
+            "snapshot": snapshot,
+            "EvaluationFitness": -(
+                sw.decision.best_metric
+                if sw.decision.best_metric is not None else 1e9),
+            "metrics": list(sw.decision.epoch_metrics),
+        }
+        self.info("member %d/%d trained: metrics %s", i + 1,
+                  self.size, entry["metrics"])
+        return entry
+
+    @property
+    def farm_enabled(self):
+        """Farming engages with local workers OR an explicit bind
+        address (a remote-only setup has farm_slaves=0 but a real
+        address for off-host workers to join)."""
+        return bool(self.farm_slaves) or \
+            self.farm_address != "127.0.0.1:0"
+
     def run(self):
         os.makedirs(self.directory, exist_ok=True)
-        for i in range(self.size):
-            seed = self.base_seed + i
-            sw = self.workflow_factory(i, seed)
-            sw.initialize(device=self.device)
-            sw.run()
-            snapshot = os.path.join(self.directory,
-                                    "member_%03d.pickle" % i)
-            with open(snapshot, "wb") as fout:
-                pickle.dump(sw, fout, protocol=pickle.HIGHEST_PROTOCOL)
-            entry = {
-                "id": i,
-                "seed": seed,
-                "snapshot": snapshot,
-                "EvaluationFitness": -(
-                    sw.decision.best_metric
-                    if sw.decision.best_metric is not None else 1e9),
-                "metrics": list(sw.decision.epoch_metrics),
-            }
-            self.results.append(entry)
-            self.info("member %d/%d trained: metrics %s", i + 1,
-                      self.size, entry["metrics"])
+        if self.farm_enabled:
+            from veles_tpu.jobfarm import JobFarm
+            self.results = JobFarm(self.FARM_TAG).run(
+                range(self.size), runner=self.train_member,
+                address=self.farm_address,
+                local_slaves=self.farm_slaves)
+        else:
+            self.results = [self.train_member(i)
+                            for i in range(self.size)]
         with open(self.results_path, "w") as fout:
             json.dump({"models": self.results}, fout, indent=1,
                       sort_keys=True)
         return self.results_path
+
+    def worker(self, address):
+        """Blocking remote-worker loop: train members the master at
+        ``address`` hands out (build this trainer with the SAME
+        factory/directory arguments on the worker host)."""
+        from veles_tpu.jobfarm import JobFarm
+        os.makedirs(self.directory, exist_ok=True)
+        return JobFarm(self.FARM_TAG).worker(address, self.train_member)
 
 
 class EnsembleTester(Logger):

@@ -7,9 +7,12 @@ process).  Here evaluations run through a pluggable evaluator:
 
 - in-process (default): ``fitness_fn(candidate_spec) -> float``;
 - process pool: ``workers=N`` evaluates candidates concurrently in
-  subprocesses (the task-parallelism strategy the reference used);
-- the control plane (veles_tpu.server) can farm the same callable as
-  jobs across hosts — see tests/test_genetics.py for the wiring.
+  subprocesses;
+- control plane: ``farm_slaves=N`` farms each generation's candidate
+  specs as jobs through the Server/Client stack
+  (veles_tpu.jobfarm.JobFarm) — the reference's strategy — with
+  remote hosts joining via :meth:`GeneticsOptimizer.worker`; see
+  tests/test_genetics.py::test_optimizer_farms_over_control_plane.
 
 Fitness is MAXIMIZED (use -validation_error).
 """
@@ -24,13 +27,19 @@ __all__ = ["GeneticsOptimizer"]
 
 
 class GeneticsOptimizer(Logger):
+
+    FARM_TAG = "genetics"
+
     def __init__(self, spec, fitness_fn, generations=5, population=12,
-                 workers=0, rng=None, **population_kwargs):
+                 workers=0, farm_slaves=0, farm_address="127.0.0.1:0",
+                 rng=None, **population_kwargs):
         super(GeneticsOptimizer, self).__init__()
         self.spec = spec
         self.fitness_fn = fitness_fn
         self.generations = generations
         self.workers = workers
+        self.farm_slaves = farm_slaves
+        self.farm_address = farm_address
         self.tunes = extract_tunes(spec)
         if not self.tunes:
             raise ValueError("spec contains no Tune markers")
@@ -39,14 +48,41 @@ class GeneticsOptimizer(Logger):
         self.population = Population(
             mins, maxs, size=population, rng=rng, **population_kwargs)
         self.history = []  # (generation, best_fitness, best_spec)
+        self._farm = None
 
     def candidate_spec(self, chromosome):
         return apply_values(self.spec, self.tunes, chromosome.values)
 
+    def worker(self, address):
+        """Blocking remote-worker loop: evaluate candidate specs the
+        optimizing master at ``address`` hands out (the worker quotes
+        the same fitness_fn)."""
+        from veles_tpu.jobfarm import JobFarm
+        return JobFarm(self.FARM_TAG).worker(address, self.fitness_fn)
+
+    @property
+    def farm_enabled(self):
+        """Farming engages with local workers OR an explicit bind
+        address (a remote-only setup has farm_slaves=0 but a real
+        address for off-host workers to join)."""
+        return bool(self.farm_slaves) or \
+            self.farm_address != "127.0.0.1:0"
+
     def _evaluate_all(self):
         pending = self.population.unevaluated()
         specs = [self.candidate_spec(c) for c in pending]
-        if self.workers and len(pending) > 1:
+        if self.farm_enabled and specs:
+            # ONE farm for the whole optimization: remote workers stay
+            # connected between generations (a fresh server per batch
+            # would disconnect them after generation 0)
+            if self._farm is None:
+                from veles_tpu.jobfarm import JobFarm
+                self._farm = JobFarm(self.FARM_TAG).start(
+                    runner=self.fitness_fn,
+                    address=self.farm_address,
+                    local_slaves=self.farm_slaves)
+            fits = self._farm.submit(specs)
+        elif self.workers and len(pending) > 1:
             with concurrent.futures.ProcessPoolExecutor(
                     max_workers=self.workers) as pool:
                 fits = list(pool.map(self.fitness_fn, specs))
@@ -57,14 +93,19 @@ class GeneticsOptimizer(Logger):
 
     def run(self):
         """Returns (best_spec, best_fitness)."""
-        for gen in range(self.generations):
-            self._evaluate_all()
-            best = self.population.best
-            self.history.append(
-                (gen, best.fitness, self.candidate_spec(best)))
-            self.info("generation %d best fitness %.4f", gen,
-                      best.fitness)
-            if gen < self.generations - 1:
-                self.population.evolve()
+        try:
+            for gen in range(self.generations):
+                self._evaluate_all()
+                best = self.population.best
+                self.history.append(
+                    (gen, best.fitness, self.candidate_spec(best)))
+                self.info("generation %d best fitness %.4f", gen,
+                          best.fitness)
+                if gen < self.generations - 1:
+                    self.population.evolve()
+        finally:
+            if self._farm is not None:
+                self._farm.shutdown()
+                self._farm = None
         best = self.population.best
         return self.candidate_spec(best), best.fitness

@@ -1,0 +1,332 @@
+"""Task farming over the master-slave control plane.
+
+The reference ran two task-parallel meta-workflows through its
+master-slave protocol: genetics chromosome evaluations
+(reference: genetics/optimization_workflow.py:186-221) and ensemble
+member training (reference: ensemble/base_workflow.py:135-153), each
+job a self-contained model run.  :class:`JobFarm` is that plane here:
+a list of picklable job specs is served through the SAME
+Server/Client stack the data-parallel trainer uses — checksum
+handshake, timeout watchdog, drop/requeue, shm bypass — with results
+collected in job order.
+
+One-shot::
+
+    results = JobFarm("my-tag").run(jobs, runner=fn, local_slaves=4)
+
+Persistent (several batches over one set of workers — a GA farms one
+batch per generation and remote workers must survive between them)::
+
+    farm = JobFarm("my-tag").start(runner=fn, local_slaves=4)
+    for generation in ...:
+        fits = farm.submit(specs)
+    farm.shutdown()
+
+``local_slaves`` spawns in-process Client worker threads — the
+single-host convenience (and the test harness).  Real scale-out runs
+``JobFarm("my-tag").worker(address, fn)`` on other hosts against the
+master's logged address; both modes mix, and workers stay connected
+across submit() batches: an idle worker parks PASSIVELY at the
+control plane's sync point, and the server pushes work to it — on
+updates, on ``submit()`` (which resumes parked workers), and on the
+watchdog tick that retries requeued/speculative work.  The tag takes
+the place of the trainer's source checksum: master and workers must
+quote the same one.
+
+Straggler/failure semantics: a job whose slave dies is requeued
+(Server drop -> ``drop_slave``); once a job has run longer than
+``speculation_factor`` x the mean completed-job time, an idle slave
+re-executes it speculatively (first result wins — the MapReduce
+backup-task move, threshold included), so one slow worker cannot
+stall the tail.  A runner exception travels back as a result and
+fails the batch loudly at collection time — a silently dropped job
+would skew a GA's selection or an ensemble's vote invisibly.
+Farmed jobs are whole model runs, so the Server's adaptive drop
+watchdog gets a week-long default timeout here instead of the
+trainer's 60 s (override with ``job_timeout=``).
+"""
+
+import hashlib
+import threading
+import time
+from collections import deque
+
+from veles_tpu.logger import Logger
+
+__all__ = ["JobFarm", "FarmJobError"]
+
+#: farmed jobs are full trainings with wildly varying durations; the
+#: trainer plane's 60 s watchdog default would drop (and blacklist!)
+#: every realistic worker mid-job
+DEFAULT_JOB_TIMEOUT = 7 * 24 * 3600.0
+
+
+class FarmJobError(RuntimeError):
+    """One or more farmed jobs raised on their worker, or the batch
+    timed out with jobs unfinished."""
+
+
+_UNSET = object()
+
+
+class _FarmMaster(object):
+    """Workflow-contract adapter the Server drives on the master.
+
+    Holds at most one active batch; ``reset(jobs)`` arms the next one.
+    With no active batch every requester parks passively; the next
+    ``submit()`` resumes them through the server's parked-requester
+    release (clients never poll — see client.py's 'wait' handling)."""
+
+    def __init__(self, checksum, speculation_factor=2.0,
+                 min_speculation_s=5.0):
+        self.checksum = checksum
+        self.speculation_factor = speculation_factor
+        self.min_speculation_s = min_speculation_s
+        self._lock = threading.Lock()
+        self._specs = []
+        self._pending = deque()
+        self._outstanding = {}      # job index -> {slave id: t0}
+        self._durations = deque(maxlen=200)
+        self.epoch = 0              # batch counter; stamps every job
+        self.results = []
+        self.done = threading.Event()
+        self.done.set()
+
+    def reset(self, jobs):
+        with self._lock:
+            if not self.done.is_set():
+                raise RuntimeError("previous batch still running")
+            self.epoch += 1
+            self._specs = list(jobs)
+            self._pending = deque(enumerate(self._specs))
+            self._outstanding = {}
+            self.results = [_UNSET] * len(self._specs)
+            if self._specs:
+                self.done.clear()
+
+    # -- Server-side workflow contract ---------------------------------
+
+    def generate_initial_data_for_slave(self, slave):
+        return None
+
+    def generate_data_for_slave(self, slave):
+        with self._lock:
+            if self._pending:
+                i, spec = self._pending.popleft()
+                self._outstanding.setdefault(i, {})[slave.id] = \
+                    time.time()
+                return (self.epoch, i, spec)
+            # nothing fresh: maybe shadow a straggler (backup task;
+            # first result wins).  Only once the job has run longer
+            # than speculation_factor x the mean completed duration
+            # (with an absolute floor: millisecond-scale jobs would
+            # otherwise speculate the whole batch tail) — immediate
+            # re-issue would duplicate every tail job
+            if not self._durations:
+                return False
+            threshold = max(
+                self.speculation_factor *
+                sum(self._durations) / len(self._durations),
+                self.min_speculation_s)
+            now = time.time()
+            for i, copies in self._outstanding.items():
+                if (slave.id not in copies
+                        and self.results[i] is _UNSET
+                        and now - min(copies.values()) > threshold):
+                    copies[slave.id] = now
+                    return (self.epoch, i, self._specs[i])
+            return False            # park until an update frees work
+
+    def apply_data_from_slave(self, update, slave):
+        epoch, i, result = update
+        with self._lock:
+            if epoch != self.epoch:
+                # a late duplicate from a PREVIOUS batch (its job was
+                # requeued or speculated and both copies eventually
+                # reported): without this stamp it would silently
+                # land in the current batch's slot i
+                return True
+            copies = self._outstanding.get(i)
+            t0 = None
+            if copies is not None and slave is not None:
+                t0 = copies.pop(slave.id, None)
+            if t0 is not None:
+                self._durations.append(time.time() - t0)
+            if self.results[i] is not _UNSET:
+                return True         # a backup copy finished first
+            self.results[i] = result
+            self._outstanding.pop(i, None)
+            finished = all(r is not _UNSET for r in self.results)
+        if finished:
+            self.done.set()
+        return True
+
+    def drop_slave(self, slave):
+        with self._lock:
+            for i in list(self._outstanding):
+                copies = self._outstanding[i]
+                copies.pop(slave.id, None)
+                if not copies and self.results[i] is _UNSET:
+                    # no other copy in flight: requeue at the front so
+                    # the oldest failure is retried first
+                    del self._outstanding[i]
+                    self._pending.appendleft((i, self._specs[i]))
+
+    def apply_initial_data_from_master(self, data):  # pragma: no cover
+        raise AssertionError("master adapter used as a slave")
+
+
+class _FarmSlave(object):
+    """Workflow-contract adapter the Client drives on a worker."""
+
+    def __init__(self, checksum, runner):
+        self.checksum = checksum
+        self.runner = runner
+
+    def apply_initial_data_from_master(self, initial):
+        pass
+
+    def do_job(self, data, update, callback):
+        epoch, i, spec = data
+        try:
+            callback((epoch, i, ("ok", self.runner(spec))))
+        except Exception as exc:  # travels back; farm fails loudly
+            callback((epoch, i, ("err", repr(exc))))
+
+
+class JobFarm(Logger):
+    """Farm independent picklable jobs across control-plane workers."""
+
+    def __init__(self, tag, codec=None, speculation_factor=2.0,
+                 min_speculation_s=5.0,
+                 job_timeout=DEFAULT_JOB_TIMEOUT, **server_kwargs):
+        super(JobFarm, self).__init__()
+        self.tag = tag
+        self.codec = codec
+        self.speculation_factor = speculation_factor
+        self.min_speculation_s = min_speculation_s
+        self.job_timeout = job_timeout
+        self.server_kwargs = server_kwargs
+        self.server = None
+        self._master = None
+        self._clients = []
+        self._threads = []
+
+    @property
+    def checksum(self):
+        """Stands in for the trainer's source checksum: master and
+        workers agree on the job TYPE, not on a workflow file."""
+        return hashlib.sha1(
+            ("jobfarm:%s" % self.tag).encode()).hexdigest()
+
+    @property
+    def address(self):
+        """host:port remote workers join (valid once started)."""
+        if self.server is None:
+            return None
+        return "%s:%d" % (self.server.host, self.server.port)
+
+    # -- master side ----------------------------------------------------
+
+    def start(self, runner=None, address="127.0.0.1:0",
+              local_slaves=0):
+        """Bind the farm master and spawn ``local_slaves`` in-process
+        workers (``runner`` required then).  Remote workers can join
+        ``self.address`` any time.  Returns self."""
+        from veles_tpu.client import Client
+        from veles_tpu.server import Server
+
+        if self.server is not None:
+            raise RuntimeError("farm already started")
+        if local_slaves and runner is None:
+            raise ValueError("local_slaves > 0 requires a runner")
+        self._master = _FarmMaster(self.checksum,
+                                   self.speculation_factor,
+                                   self.min_speculation_s)
+        self.server = Server(address, self._master, codec=self.codec,
+                             job_timeout=self.job_timeout,
+                             **self.server_kwargs)
+        self.server.start_background()
+        if not self.server.wait_listening(10):
+            exc = self.server.bind_error
+            self.server = None
+            raise RuntimeError(
+                "farm master failed to bind %s: %r" % (address, exc))
+        self.info("farm '%s' serving at %s (join remote workers with "
+                  "JobFarm(%r).worker(%r, runner))",
+                  self.tag, self.address, self.tag, self.address)
+        for _ in range(local_slaves):
+            client = Client(self.address,
+                            _FarmSlave(self.checksum, runner),
+                            codec=self.codec)
+            self._clients.append(client)
+            self._threads.append(client.start_background())
+        return self
+
+    def submit(self, jobs, timeout=None):
+        """Serve one batch until every result is in; return them in
+        job order.  ``timeout`` (seconds) bounds the batch; on expiry
+        a :class:`FarmJobError` reports what was unfinished."""
+        if self.server is None:
+            raise RuntimeError("start() the farm first")
+        jobs = list(jobs)
+        if not jobs:
+            return []
+        master = self._master
+        master.reset(jobs)
+        # workers park passively between batches; release them
+        self.server.resume()
+        if not master.done.wait(timeout):
+            missing = [i for i, r in enumerate(master.results)
+                       if r is _UNSET]
+            raise FarmJobError(
+                "farm timed out after %ss with %d/%d jobs unfinished "
+                "(indices %s)" % (timeout, len(missing), len(jobs),
+                                  missing[:10]))
+        errors = [(i, r[1]) for i, r in enumerate(master.results)
+                  if r[0] == "err"]
+        if errors:
+            raise FarmJobError(
+                "%d/%d farmed jobs raised on their workers: %s" % (
+                    len(errors), len(jobs),
+                    "; ".join("job %d: %s" % e for e in errors[:5])))
+        return [r[1] for r in master.results]
+
+    def shutdown(self):
+        """Stop the master; local and remote workers exit their loops."""
+        if self.server is None:
+            return
+        self.server.stop()
+        self.server._done.wait(10)
+        for thread in self._threads:
+            thread.join(10)
+        self.server = None
+        self._master = None
+        self._clients = []
+        self._threads = []
+
+    def run(self, jobs, runner=None, address="127.0.0.1:0",
+            local_slaves=0, timeout=None, on_listening=None):
+        """One-shot convenience: start -> submit -> shutdown.
+        ``on_listening`` (optional) receives the bound Server before
+        jobs are served — e.g. to launch workers against its port."""
+        self.start(runner=runner, address=address,
+                   local_slaves=local_slaves)
+        try:
+            if on_listening is not None:
+                on_listening(self.server)
+            return self.submit(jobs, timeout=timeout)
+        finally:
+            self.shutdown()
+
+    # -- worker side ----------------------------------------------------
+
+    def worker(self, address, runner, **client_kwargs):
+        """Blocking worker loop for a remote host: execute farmed jobs
+        until the master shuts down.  Quote the master's tag."""
+        from veles_tpu.client import Client
+
+        client = Client(address, _FarmSlave(self.checksum, runner),
+                        codec=self.codec, **client_kwargs)
+        client.run()
+        return client.jobs_done

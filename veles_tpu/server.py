@@ -123,6 +123,8 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         self._paused = False
         self._stop_event = None
         self._done = threading.Event()
+        self._listening = threading.Event()
+        self.bind_error = None
         self.jobs_dispatched = 0
         self.updates_applied = 0
 
@@ -136,6 +138,15 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         thread = threading.Thread(target=self.run, daemon=True)
         thread.start()
         return thread
+
+    def wait_listening(self, timeout=10.0):
+        """Block until the socket accepts connections.  Returns True
+        when listening; False on bind failure (see ``bind_error``) or
+        timeout — a background server that failed to bind would
+        otherwise die silently on its daemon thread."""
+        if not self._listening.wait(timeout):
+            return False
+        return self.bind_error is None
 
     def on_workflow_finished(self):
         self._finishing = True
@@ -185,9 +196,21 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         self._stop_event = asyncio.Event()
         if self._finishing:
             self._stop_event.set()
-        self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port)
+        try:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.host, self.port)
+        except OSError as exc:
+            # surface the failure to waiters (start_background callers
+            # can only see it through bind_error) instead of dying
+            # silently on a daemon thread
+            self.bind_error = exc
+            self.error("failed to bind %s:%s: %s", self.host,
+                       self.port, exc)
+            self._listening.set()
+            self._done.set()
+            return
         self.port = self._server.sockets[0].getsockname()[1]
+        self._listening.set()
         self.info("master listening on %s:%d", self.host, self.port)
         watchdog = asyncio.ensure_future(self._watchdog())
         try:
@@ -339,9 +362,19 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                 await self._serve_job(parked)
 
     async def _watchdog(self):
-        """Adaptive per-slave job timeout -> drop + blacklist."""
+        """Adaptive per-slave job timeout -> drop + blacklist; also
+        the periodic parked-requester retry."""
         while True:
             await asyncio.sleep(0.5)
+            # clients park PASSIVELY on 'wait' (no re-poll: a client-
+            # side poll double-serves against the update-driven
+            # release and grows per-connection backlogs without
+            # bound).  Updates release parked requesters immediately;
+            # this tick covers the update-free cases — work freed by a
+            # dropped slave's requeue and stragglers crossing the
+            # speculation threshold
+            if not self._paused:
+                await self._release_parked()
             threshold = self._timeout_threshold()
             now = time.time()
             for conn in list(self.slaves.values()):
@@ -375,6 +408,12 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             self.workflow.drop_slave(conn.slave)
         except Exception:
             self.exception("drop_slave failed")
+        # the requeue may have freed work for parked requesters; with
+        # passive clients nobody else would wake them until the next
+        # update (which, with every other slave parked, never comes)
+        if not self._paused:
+            self._loop.call_soon_threadsafe(
+                lambda: asyncio.ensure_future(self._release_parked()))
         if self.respawn_hook is not None and not self._finishing:
             delay = min(2.0 ** len(self.blacklist), 30.0)
             self._loop.call_later(
