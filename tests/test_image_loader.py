@@ -91,6 +91,74 @@ def test_distortion_composition_inflates_train(tmp_path, cpu_device):
     numpy.testing.assert_allclose(base[:, ::-1], mirrored, atol=1e-6)
 
 
+def test_colorspace_matches_cv2_oracle():
+    """The numpy conversions follow cv2's conventions exactly, so
+    either backend yields interchangeable tensors."""
+    from veles_tpu.loader import colorspace
+
+    rng = numpy.random.RandomState(3)
+    u8 = (rng.rand(9, 11, 3) * 255).astype(numpy.uint8)
+    for dst, code in (("RGB", cv2.COLOR_BGR2RGB),
+                      ("GRAY", cv2.COLOR_BGR2GRAY),
+                      ("YCR_CB", cv2.COLOR_BGR2YCrCb)):
+        ours = colorspace.convert(u8, "BGR", dst)
+        want = cv2.cvtColor(u8, code)
+        assert ours.dtype == numpy.uint8
+        assert ours.shape == want.shape
+        diff = numpy.abs(ours.astype(int) - want.astype(int))
+        assert diff.max() <= 1, (dst, diff.max())
+    # HSV: hue is circular (0 == 180 in uint8 encoding)
+    ours = colorspace.convert(u8, "BGR", "HSV")
+    want = cv2.cvtColor(u8, cv2.COLOR_BGR2HSV)
+    dh = numpy.abs(ours[..., 0].astype(int) - want[..., 0].astype(int))
+    assert numpy.minimum(dh, 180 - dh).max() <= 1
+    assert numpy.abs(ours[..., 1:].astype(int)
+                     - want[..., 1:].astype(int)).max() <= 1
+    # float path round-trips through every 3-channel space
+    f32 = rng.rand(7, 5, 3).astype(numpy.float32)
+    for space in ("HSV", "YCR_CB", "BGR"):
+        there = colorspace.convert(f32, "RGB", space)
+        back = colorspace.convert(there, space, "RGB")
+        numpy.testing.assert_allclose(back, f32, atol=1e-5)
+    # the hub makes indirect pairs work too (no direct cv2 code)
+    gray_hsv = colorspace.convert(
+        (rng.rand(4, 4) * 255).astype(numpy.uint8), "GRAY", "HSV")
+    assert gray_hsv.shape == (4, 4, 3)
+    assert (gray_hsv[..., 1] == 0).all()  # gray pixels have S == 0
+
+
+def test_loader_color_tree_roundtrips_in_two_spaces(tmp_path,
+                                                    cpu_device):
+    """The same color tree loaded in two color spaces (reference
+    loader/image.py:111-125 color_space kwarg): converting the HSV
+    tensors back to RGB reproduces the RGB load."""
+    from veles_tpu.loader import colorspace
+
+    train = _write_tree(tmp_path / "train")
+
+    def load(space):
+        wf = DummyWorkflow()
+        loader = FileImageLoader(
+            wf.workflow, train_dir=str(train), color_space=space,
+            minibatch_size=4,
+            prng=RandomGenerator("col_%s" % space, seed=1))
+        loader.initialize(device=cpu_device)
+        loader.original_data.map_read()
+        return loader.original_data.mem.copy()
+
+    rgb = load("RGB")
+    hsv = load("HSV")
+    assert rgb.shape == hsv.shape
+    assert not numpy.allclose(rgb, hsv)  # genuinely different spaces
+    # loaders store uint8/255; undo that, convert HSV -> RGB, compare
+    # (uint8 HSV quantizes hue to 2-degree steps -> small tolerance)
+    for i in range(len(rgb)):
+        back = colorspace.convert(
+            (hsv[i] * 255).round().astype(numpy.uint8), "HSV", "RGB")
+        numpy.testing.assert_allclose(
+            back / 255.0, rgb[i], atol=0.04)
+
+
 def test_image_mse_class_targets(tmp_path, cpu_device):
     """class_target_paths: one target image per label (the reference's
     class_targets mapping, fullbatch_image.py:200-222)."""

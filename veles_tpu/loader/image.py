@@ -2,7 +2,8 @@
 
 Counterpart of reference veles/loader/image.py:106 + file_image.py +
 fullbatch_image.py: scale / crop / rotate / mirror augmentation, color
-space conversion through OpenCV, directory-scanning file loaders, and a
+space conversion (numpy, cv2-convention compatible — see
+veles_tpu.loader.colorspace), directory-scanning file loaders, and a
 fullbatch composition that lands the whole image set in HBM.
 
 Augmentation happens at load/refresh time on host (CPU, numpy/cv2);
@@ -28,8 +29,10 @@ IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".pgm",
 class ImageAugmentation(object):
     """scale: output (w, h); crop: (w, h) random window; mirror:
     False | True (random) | "always"; rotations: list of degrees to
-    sample from; color_space: target cv2 space name (e.g. "GRAY",
-    "HSV") from BGR source."""
+    sample from; color_space: target space name from
+    :data:`veles_tpu.loader.colorspace.SPACES` (e.g. "GRAY", "HSV",
+    "YCR_CB"); the source is what the reader produced (BGR for color
+    cv2.imread, override with ``apply(img, source_space=...)``)."""
 
     def __init__(self, scale=None, crop=None, mirror=False,
                  rotations=(0,), color_space=None, prng=None):
@@ -41,11 +44,13 @@ class ImageAugmentation(object):
         self.color_space = color_space
         self.prng = prng or prng_module.get("image_augmentation")
 
-    def apply(self, img):
+    def apply(self, img, source_space="BGR"):
         import cv2
+
+        from veles_tpu.loader import colorspace
         if self.color_space:
-            code = getattr(cv2, "COLOR_BGR2%s" % self.color_space)
-            img = cv2.cvtColor(img, code)
+            img = colorspace.convert(img, source_space,
+                                     self.color_space)
         if self.scale:
             img = cv2.resize(img, tuple(self.scale),
                              interpolation=cv2.INTER_AREA)
@@ -117,6 +122,9 @@ class FullBatchImageLoader(FullBatchLoader):
 
     kwargs: test_paths / validation_paths / train_paths: lists of
     (path, label); augmentation: ImageAugmentation; grayscale: bool;
+    color_space: target space from colorspace.SPACES (reference
+    loader/image.py:111-125 ``color_space`` kwarg; None keeps the
+    reader's space — BGR for color files, GRAY with grayscale=True);
     distortion composition via mirror=True + rotations=(0, 15, -15):
     every TRAIN sample is materialized once per (mirror, rotation)
     combination (samples_inflation, reference DistortionIterator).
@@ -129,6 +137,7 @@ class FullBatchImageLoader(FullBatchLoader):
                             kwargs.get("train_paths", ()))
         self.augmentation = kwargs.get("augmentation")
         self.grayscale = kwargs.get("grayscale", False)
+        self.color_space = kwargs.get("color_space")
         self.mirror = kwargs.get("mirror", False)
         self.rotations = tuple(kwargs.get("rotations", (0,)))
 
@@ -144,8 +153,13 @@ class FullBatchImageLoader(FullBatchLoader):
         img = cv2.imread(path, flag)
         if img is None:
             raise LoaderError("cannot read image %s" % path)
+        space = "GRAY" if self.grayscale else "BGR"
         if self.augmentation is not None:
-            img = self.augmentation.apply(img)
+            img = self.augmentation.apply(img, source_space=space)
+            space = self.augmentation.color_space or space
+        if self.color_space and self.color_space != space:
+            from veles_tpu.loader import colorspace
+            img = colorspace.convert(img, space, self.color_space)
         if img.ndim == 2:
             img = img[..., None]
         return img
