@@ -1,0 +1,268 @@
+"""Conv-backward scheduling experiments on the real chip.
+
+Round-4 verdict: the AlexNet fused step runs forward at ~71 % MFU but
+backward+update at ~36 %; the dominant costs are the dgrad/wgrad of
+the 5x5/3x3 conv layers.  This script microbenches each conv layer's
+backward under alternative formulations so the winning one can become
+a custom_vjp in models/conv.py:
+
+  autodiff   - jax.vjp of the forward conv (what the step uses today)
+  explicit   - hand-written dgrad (transposed conv via lhs_dilation) +
+               wgrad (batch-as-contraction conv via dimension numbers)
+  wgrad_f32  - explicit, with preferred_element_type=f32 on the wgrad
+  im2col     - wgrad as conv_general_dilated_patches + one big matmul
+
+Timing: dependent-chain slope (two chain lengths, scalar fetch each)
+so tunnel latency cancels — bench.py's methodology.
+
+Usage:  python scripts/bwd_experiments.py [--layers 2,5] [--repeats 20]
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy
+
+# AlexNet conv layer configs at batch 256 (name, in_shape, kernels,
+# k, stride, pad)
+LAYERS = {
+    "0": ((256, 227, 227, 3), 96, 11, 4, 0),
+    "2": ((256, 27, 27, 96), 256, 5, 1, 2),
+    "4": ((256, 13, 13, 256), 384, 3, 1, 1),
+    "5": ((256, 13, 13, 384), 384, 3, 1, 1),
+    "6": ((256, 13, 13, 384), 256, 3, 1, 1),
+}
+
+
+def conv_fwd(x, w, stride, pad):
+    from jax import lax
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=((pad, pad), (pad, pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def explicit_dgrad(dy, w, x_shape, stride, pad):
+    """dX via transposed conv: dilate dy by the stride, convolve with
+    the spatially-flipped kernel, I/O swapped."""
+    from jax import lax
+    k = w.shape[0]
+    h = x_shape[1]
+    hout = dy.shape[1]
+    lo = k - 1 - pad
+    hi = h - (hout - 1) * stride - 1 + pad
+    w_t = w[::-1, ::-1].swapaxes(2, 3)  # flip spatial, swap I/O
+    return lax.conv_general_dilated(
+        dy, w_t, window_strides=(1, 1),
+        padding=((lo, hi), (lo, hi)),
+        lhs_dilation=(stride, stride),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def explicit_wgrad(x, dy, k, stride, pad, pet=None):
+    """dW via batch-as-contraction conv: lhs batch <- channels,
+    contraction <- batch, rhs dilation <- forward stride."""
+    from jax import lax
+    h = x.shape[1]
+    hout = dy.shape[1]
+    hi = (hout - 1) * stride + k - h - pad
+    return lax.conv_general_dilated(
+        x, dy, window_strides=(1, 1),
+        padding=((pad, hi), (pad, hi)),
+        rhs_dilation=(stride, stride),
+        dimension_numbers=("CHWN", "IHWO", "HWNC"),
+        preferred_element_type=pet)
+
+
+def im2col_wgrad(x, dy, k, stride, pad):
+    """dW as patch extraction + one matmul on the MXU."""
+    import jax.numpy as jnp
+    from jax import lax
+    n, h, w_sp, c = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, (k, k), (stride, stride), ((pad, pad), (pad, pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # patches: (N, Hout, Wout, C*k*k) with feature order C-major
+    hout, wout = patches.shape[1], patches.shape[2]
+    pm = patches.reshape(n * hout * wout, -1)
+    dm = dy.reshape(n * hout * wout, -1)
+    dw = jnp.dot(pm.T, dm, preferred_element_type=jnp.float32)
+    # feature order of patches is (C, kh, kw) -> reshape + transpose
+    dw = dw.reshape(c, k, k, dy.shape[3]).transpose(1, 2, 0, 3)
+    return dw.astype(x.dtype)
+
+
+def make_chained(core, x0):
+    """Wrap ``core(x) -> pytree`` as jitted ``x -> x`` whose output
+    carries a data dependency on EVERY output leaf.
+
+    Two lazy-tunnel gotchas this defends against (both produced
+    fictitious sub-roofline timings in the first run of this script):
+    ``block_until_ready`` does not force execution — only a value
+    fetch does; and INDEPENDENT repeated calls are not all forced by
+    fetching the last one — the chain must be dependent.  The
+    summed-leaves perturbation (scaled to underflow) creates the
+    dependency without changing x."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        outs = core(x)
+        s = sum(jnp.sum(leaf.astype(jnp.float32))
+                for leaf in jax.tree.leaves(outs))
+        return x + (s * 1e-30).astype(x.dtype)
+
+    return jax.jit(step)
+
+
+def slope_sample(fn, x0, n2):
+    """One dependent-chain slope sample, ended by a scalar fetch
+    (bench.py's methodology).  Caller must have warmed fn."""
+    import jax.numpy as jnp
+
+    def chain(m):
+        start = time.perf_counter()
+        x = x0
+        for _ in range(m):
+            x = fn(x)
+        float(x.ravel()[0].astype(jnp.float32))
+        return time.perf_counter() - start
+
+    t1 = chain(1)
+    t2 = chain(n2 + 1)
+    return (t2 - t1) / n2
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--layers", default="2,5")
+    parser.add_argument("--repeats", type=int, default=100,
+                        help="chain length per slope sample (>=100: "
+                             "short chains invert rankings on this "
+                             "tunnel)")
+    parser.add_argument("--rounds", type=int, default=3,
+                        help="round-robin sampling rounds")
+    parser.add_argument(
+        "--variants",
+        default="fwd,autodiff_bwd,explicit_bwd",
+        help="comma list from fwd,autodiff_bwd,explicit_bwd,"
+             "explicit_bwd_f32wg,im2col_bwd")
+    parser.add_argument("--dtype", default="bfloat16")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dtype = getattr(jnp, args.dtype)
+    rng = numpy.random.RandomState(0)
+    report = {}
+    for name in args.layers.split(","):
+        in_shape, kernels, k, stride, pad = LAYERS[name.strip()]
+        c_in = in_shape[3]
+        x = jax.device_put(
+            (rng.rand(*in_shape) - 0.5).astype(numpy.float32) * 0.1
+        ).astype(dtype)
+        w = jax.device_put(
+            (rng.rand(k, k, c_in, kernels) - 0.5).astype(
+                numpy.float32) * 0.05).astype(dtype)
+        y = conv_fwd(x, w, stride, pad)
+        dy = (y * 0 + jnp.asarray(
+            rng.rand(*y.shape).astype(numpy.float32) * 0.01,
+            dtype)).astype(dtype)
+        dy = jax.block_until_ready(dy)
+        flops_fwd = 2.0 * numpy.prod(y.shape) * k * k * c_in
+        row = {"in": list(in_shape), "kernels": kernels, "k": k,
+               "stride": stride,
+               "fwd_gflops": round(flops_fwd / 1e9, 1)}
+
+        fwd = jax.jit(functools.partial(conv_fwd, stride=stride,
+                                        pad=pad))
+
+        def autodiff_bwd(x, w, dy):
+            _, vjp = jax.vjp(lambda xx, ww: fwd(xx, ww), x, w)
+            return vjp(dy)
+
+        auto = jax.jit(autodiff_bwd)
+
+        expl = jax.jit(lambda x, w, dy: (
+            explicit_dgrad(dy, w, x.shape, stride, pad),
+            explicit_wgrad(x, dy, k, stride, pad)))
+        im2 = jax.jit(lambda x, w, dy: (
+            explicit_dgrad(dy, w, x.shape, stride, pad),
+            im2col_wgrad(x, dy, k, stride, pad)))
+
+        # numeric parity before timing anything
+        a_dx, a_dw = auto(x, w, dy)
+        for label, fn in (("explicit", expl), ("im2col", im2)):
+            e_dx, e_dw = fn(x, w, dy)
+            err_dx = float(jnp.max(jnp.abs(
+                a_dx.astype(jnp.float32) - e_dx.astype(jnp.float32))))
+            err_dw = float(jnp.max(jnp.abs(
+                a_dw.astype(jnp.float32) - e_dw.astype(jnp.float32))))
+            scale = float(jnp.max(jnp.abs(
+                a_dw.astype(jnp.float32)))) or 1.0
+            row["%s_max_rel_err_dw" % label] = round(err_dw / scale, 5)
+            row.setdefault("parity", {})[label] = {
+                "dx": round(err_dx, 5), "dw": round(err_dw, 5)}
+
+        all_variants = {
+            "fwd": lambda xx: fwd(xx, w),
+            "autodiff_bwd": lambda xx: autodiff_bwd(xx, w, dy),
+            "explicit_bwd": lambda xx: (
+                explicit_dgrad(dy, w, xx.shape, stride, pad),
+                explicit_wgrad(xx, dy, k, stride, pad)),
+            "explicit_bwd_f32wg": lambda xx: (
+                explicit_dgrad(dy, w, xx.shape, stride, pad),
+                explicit_wgrad(xx, dy, k, stride, pad,
+                               pet=jnp.float32)),
+            "im2col_bwd": lambda xx: (
+                explicit_dgrad(dy, w, xx.shape, stride, pad),
+                im2col_wgrad(xx, dy, k, stride, pad)),
+        }
+        chosen = {lbl: make_chained(core, x)
+                  for lbl, core in all_variants.items()
+                  if lbl in args.variants.split(",")}
+        # sequential warmup (concurrent first-execs serialize anyway),
+        # then ROUND-ROBIN interleaved sampling: congestion drifts
+        # minute to minute, so per-variant sequential sampling is not
+        # comparable — one slope sample of every variant per round,
+        # median over all rounds
+        import jax.numpy as _jnp
+        for lbl, fn in chosen.items():
+            float(fn(x).ravel()[0].astype(_jnp.float32))
+        samples = {lbl: [] for lbl in chosen}
+        for _ in range(args.rounds):
+            for lbl, fn in chosen.items():
+                try:
+                    samples[lbl].append(
+                        slope_sample(fn, x, args.repeats))
+                except Exception as exc:
+                    row[lbl + "_error"] = repr(exc)
+        for lbl, vals in samples.items():
+            positive = [v for v in vals if v > 0]
+            if not positive or len(positive) < len(vals) // 2 + 1:
+                row[lbl + "_ms"] = None
+                row[lbl + "_samples_ms"] = [round(v * 1e3, 3)
+                                            for v in vals]
+                continue
+            med = float(numpy.median(vals))
+            row[lbl + "_ms"] = round(med * 1e3, 3)
+            row[lbl + "_samples_ms"] = [round(v * 1e3, 3)
+                                        for v in vals]
+            flops = flops_fwd if lbl == "fwd" else 2.0 * flops_fwd
+            row[lbl + "_tflops"] = round(flops / med / 1e12, 1)
+        report["layer_%s" % name] = row
+        print(json.dumps({("layer_%s" % name): row}), flush=True)
+
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Whole-step A/B: custom conv VJP vs jax-autodiff conv gradients.
+
+Cross-run throughput on this tunnel swings ~1.4x with congestion, so
+the ONLY honest comparison is two programs interleaved in one
+process: build the full AlexNet fused train step twice (tracing with
+models.conv.USE_CUSTOM_VJP on/off), warm both, then round-robin
+dependent-chain slope samples, median per arm.
+
+Usage: python scripts/step_ab.py [--batch 256] [--rounds 4]
+                                 [--chain 40] [--model alexnet]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy
+
+
+def _custom_vjp_conv2d():
+    """A conv2d with hand-scheduled gradients (dgrad = lhs-dilated
+    conv of the flipped/IO-swapped kernel; wgrad = batch-as-
+    contraction conv via ("CHWN", "IHWO", "HWNC") with the forward
+    stride as rhs dilation).  Numerically exact vs autodiff; measured
+    perf-neutral on the whole step (the receipt models/conv.py's
+    docstring cites) — kept here so the A/B stays re-runnable."""
+    import functools
+
+    import jax
+    from jax import lax
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+    def conv2d(x, w, strides, padding, pet=None):
+        return lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=pet)
+
+    def fwd(x, w, strides, padding, pet):
+        return conv2d(x, w, strides, padding, pet), (x, w)
+
+    def bwd(strides, padding, pet, res, dy):
+        x, w = res
+        sy, sx = strides
+        (pt, _pb), (pl, _pr) = padding
+        k_h, k_w = w.shape[0], w.shape[1]
+        h, w_sp = x.shape[1], x.shape[2]
+        hout, wout = dy.shape[1], dy.shape[2]
+        dy = dy.astype(x.dtype)
+        dx = lax.conv_general_dilated(
+            dy, w[::-1, ::-1].swapaxes(2, 3),
+            window_strides=(1, 1),
+            padding=((k_h - 1 - pt, h - 1 + pt - (hout - 1) * sy),
+                     (k_w - 1 - pl, w_sp - 1 + pl - (wout - 1) * sx)),
+            lhs_dilation=(sy, sx),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        dw = lax.conv_general_dilated(
+            x, dy, window_strides=(1, 1),
+            padding=((pt, (hout - 1) * sy + k_h - h - pt),
+                     (pl, (wout - 1) * sx + k_w - w_sp - pl)),
+            rhs_dilation=(sy, sx),
+            dimension_numbers=("CHWN", "IHWO", "HWNC"))
+        return dx, dw.astype(w.dtype)
+
+    conv2d.defvjp(fwd, bwd)
+    return conv2d
+
+
+def build_step(specs, input_shape, batch, dtype_name, classes):
+    """One jitted step + chained runner over the real gather path
+    (mirrors bench._train_step_images_per_sec)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _setup_training
+    from veles_tpu.compiler import build_train_step
+    from veles_tpu.ops.gather import gather_labels, gather_minibatch
+
+    setup = _setup_training(specs, input_shape, batch, 1024,
+                            dtype_name, classes)
+    plans, state, dataset, labels_all, order, dup, has_dropout = setup
+    step = build_train_step(plans, donate=False)
+    key = jax.random.PRNGKey(0) if has_dropout else None
+
+    def one(state, dataset, labels_all, order, offset):
+        # device buffers ride as ARGUMENTS: a closed-over dataset
+        # would inline as a 300+ MB constant and blow the remote
+        # compile service's request limit
+        idx = jax.lax.dynamic_slice(order, (offset,), (batch,))
+        x = gather_minibatch(dataset, idx)
+        y = gather_labels(labels_all, idx)
+        return step(state, x, y, jnp.float32(batch), key)
+
+    one = jax.jit(one)
+    st, m = one(state, dataset, labels_all, order, 0)
+    float(m["loss"].astype(jnp.float32))  # warm (fetch, not block)
+
+    def chain(n):
+        start = time.perf_counter()
+        st = state
+        metrics = None
+        for i in range(n):
+            st, metrics = one(st, dataset, labels_all, order,
+                              (i * batch) % (1024 - batch))
+        float(metrics["loss"].astype(jnp.float32))
+        return time.perf_counter() - start
+
+    return chain
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=256)
+    parser.add_argument("--rounds", type=int, default=4)
+    parser.add_argument("--chain", type=int, default=40)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--model", default="alexnet")
+    args = parser.parse_args()
+
+    from veles_tpu.models import conv
+    from veles_tpu.models.zoo import alexnet_layers, vgg_layers
+
+    if args.model == "alexnet":
+        specs, ishape = alexnet_layers(classes=1000), (227, 227, 3)
+    else:
+        specs, ishape = (vgg_layers(classes=1000, config="D"),
+                         (224, 224, 3))
+
+    stock_conv2d = conv.conv2d
+    chains = {}
+    for label, conv2d_impl in (("custom_vjp", _custom_vjp_conv2d()),
+                               ("autodiff", stock_conv2d)):
+        conv.conv2d = conv2d_impl  # trace-time swap
+        try:
+            chains[label] = build_step(specs, ishape, args.batch,
+                                       args.dtype, 1000)
+        finally:
+            conv.conv2d = stock_conv2d
+        print("warmed %s" % label, flush=True)
+
+    samples = {label: [] for label in chains}
+    for r in range(args.rounds):
+        for label, chain in chains.items():
+            t1 = chain(1)
+            t2 = chain(args.chain + 1)
+            sec = (t2 - t1) / args.chain
+            samples[label].append(sec)
+            print("round %d %s: %.3f ms/step" % (r, label, sec * 1e3),
+                  flush=True)
+
+    out = {}
+    for label, vals in samples.items():
+        med = float(numpy.median([v for v in vals if v > 0] or vals))
+        out[label] = {"ms_per_step": round(med * 1e3, 3),
+                      "images_per_sec": round(args.batch / med, 1),
+                      "samples_ms": [round(v * 1e3, 3) for v in vals]}
+    if out["autodiff"]["ms_per_step"] and \
+            out["custom_vjp"]["ms_per_step"]:
+        out["speedup"] = round(
+            out["autodiff"]["ms_per_step"]
+            / out["custom_vjp"]["ms_per_step"], 3)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

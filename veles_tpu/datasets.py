@@ -94,11 +94,23 @@ def _fetch(filename, data_dir, download=True):
     target = os.path.join(data_dir, filename)
     if download:
         for base in MNIST_URLS:
-            if _download_file(base + filename, target):
-                return target
+            if not _download_file(base + filename, target):
+                continue
+            try:
+                load_idx(target)  # a mirror's HTTP-200 error page
+            except Exception:    # must not poison the cache forever
+                try:
+                    os.remove(target)
+                except OSError:
+                    pass
+                continue
+            return target
     raise DatasetNotFound(
-        "MNIST file %s not found under %s and download failed; place "
-        "the idx files there or set $VELES_DATA" % (filename, data_dir))
+        "MNIST file %s not found under %s and %s; place the idx files "
+        "there or set $VELES_DATA" % (
+            filename, data_dir,
+            "download failed" if download
+            else "downloads are disabled for validation"))
 
 
 def mnist_arrays(data_dir=None, download=True):
@@ -137,8 +149,14 @@ def _load_openml_npz(npz):
         z = numpy.load(npz)
         arrays = (z["train_x"], z["train_y"], z["test_x"], z["test_y"])
         if arrays[0].shape != (60000, 784) or \
-                arrays[2].shape != (10000, 784):
+                arrays[2].shape != (10000, 784) or \
+                arrays[1].shape != (60000,) or \
+                arrays[3].shape != (10000,):
             raise ValueError("wrong shapes")
+        for labels in (arrays[1], arrays[3]):
+            if not numpy.issubdtype(labels.dtype, numpy.integer) or \
+                    labels.min() < 0 or labels.max() > 9:
+                raise ValueError("bad labels")
         return arrays
     except Exception:
         try:
@@ -174,10 +192,14 @@ def _mnist_openml(data_dir, idx_err, download=True):
             "range [%s, %s]" % (x.shape, y.min(), y.max()))
     arrays = (x[:60000], y[:60000], x[60000:], y[60000:])
     tmp = npz + ".part.npz"
-    numpy.savez_compressed(
-        tmp, train_x=arrays[0], train_y=arrays[1],
-        test_x=arrays[2], test_y=arrays[3])
-    os.replace(tmp, npz)  # atomic: a killed write must not poison
+    try:
+        os.makedirs(data_dir, exist_ok=True)
+        numpy.savez_compressed(
+            tmp, train_x=arrays[0], train_y=arrays[1],
+            test_x=arrays[2], test_y=arrays[3])
+        os.replace(tmp, npz)  # atomic: a killed write must not poison
+    except OSError:
+        pass  # cache write failure must not discard the fetched data
     return arrays
 
 
