@@ -226,9 +226,16 @@ def main():
                 explicit_dgrad(dy, w, xx.shape, stride, pad),
                 im2col_wgrad(xx, dy, k, stride, pad)),
         }
+        wanted = [v.strip() for v in args.variants.split(",")
+                  if v.strip()]
+        unknown = [v for v in wanted if v not in all_variants]
+        if unknown:
+            raise SystemExit(
+                "unknown variants %s (choose from %s)" % (
+                    unknown, ", ".join(all_variants)))
         chosen = {lbl: make_chained(core, x)
                   for lbl, core in all_variants.items()
-                  if lbl in args.variants.split(",")}
+                  if lbl in wanted}
         # sequential warmup (concurrent first-execs serialize anyway),
         # then ROUND-ROBIN interleaved sampling: congestion drifts
         # minute to minute, so per-variant sequential sampling is not

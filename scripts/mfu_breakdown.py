@@ -294,17 +294,21 @@ def main():
         bwd = report.get("backward_attribution")
         if fwd and bwd and fwd.get("mfu_pct"):
             split = (
-                "  Measured split: forward runs at %.0f%% MFU "
-                "(near-roofline), backward+update at %.0f%% — the "
-                "gap is XLA's conv gradient (dgrad/wgrad) schedules, "
-                "not our step formulation."
+                "  Measured split: forward %.0f%% MFU, "
+                "backward+update %.0f%%."
                 % (fwd["mfu_pct"], bwd.get("bwd_mfu_pct", 0)))
         alexnet_note = (
-            "  AlexNet cross-checks: an interleaved plain-SGD A/B "
-            "measured within 0.3 ms of the product step, and the "
-            "same step spanned 18.2 ms (43%% MFU) to 12.9 ms "
-            "(~61%% MFU) between runs." if args.model == "alexnet"
-            else "")
+            "  Round-5 attribution (interleaved A/B receipts in "
+            "scripts/bwd_experiments.py, step_ab.py, "
+            "pool_bwd_experiment.py): isolated conv gradients run at "
+            "~190 TF/s (near peak) under plain autodiff, an exact "
+            "hand-scheduled conv VJP changes the whole step by 0.1%, "
+            "pool select-and-scatter beats a patches formulation 6x, "
+            "and plain-SGD vs product step differ by 0.3 ms — the "
+            "gap between a congested-run backward MFU and forward "
+            "MFU is congestion arithmetic plus composition slack, "
+            "not any one op's schedule."
+            if args.model == "alexnet" else "")
         report["conclusion"] = (
             "The roofline is MXU-bound (%.0fus mxu vs %.0fus hbm; "
             "top costs: %s)%s.%s%s  Caveat: tunnel/chip congestion "

@@ -1,0 +1,112 @@
+"""Max-pool backward scheduling experiment (chip).
+
+XLA:TPU lowers the autodiff max-pool gradient to select-and-scatter,
+a historically slow op.  Candidate: pool via dilated patches + argmax
+one-hot, whose backward is a conv-style gather.  Interleaved
+round-robin dependent chains (see bwd_experiments.py for the
+methodology rules).
+
+Usage: python scripts/pool_bwd_experiment.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy
+
+from bwd_experiments import make_chained, slope_sample  # noqa: E402
+
+# AlexNet pools at batch 256: (in_shape, k, stride); all exact-fit
+POOLS = {
+    "1": ((256, 55, 55, 96), 3, 2),
+    "3": ((256, 27, 27, 256), 3, 2),
+    "7": ((256, 13, 13, 256), 3, 2),
+}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = numpy.random.RandomState(0)
+    report = {}
+    for name, (in_shape, k, s) in POOLS.items():
+        in_shape = tuple(in_shape)
+        x = jax.device_put(
+            rng.rand(*in_shape).astype(numpy.float32)).astype(
+                jnp.bfloat16)
+
+        def pool_rw(xx):
+            return lax.reduce_window(
+                xx, -numpy.inf, lax.max,
+                window_dimensions=(1, k, k, 1),
+                window_strides=(1, s, s, 1),
+                padding=((0, 0), (0, 0), (0, 0), (0, 0)))
+
+        def pool_patches(xx):
+            n, h, w, c = xx.shape
+            p = lax.conv_general_dilated_patches(
+                xx, (k, k), (s, s), ((0, 0), (0, 0)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            oh, ow = p.shape[1], p.shape[2]
+            p = p.reshape(n, oh, ow, c, k * k)
+            return p.max(axis=-1)
+
+        y = pool_rw(x)
+        dy = jax.device_put(
+            rng.rand(*y.shape).astype(numpy.float32)).astype(
+                jnp.bfloat16)
+
+        def bwd(pool):
+            def f(xx):
+                _, vjp = jax.vjp(pool, xx)
+                return vjp(dy)
+            return f
+
+        # parity: both formulations route the same gradients
+        ga = jax.jit(bwd(pool_rw))(x)[0]
+        gp = jax.jit(bwd(pool_patches))(x)[0]
+        err = float(jnp.max(jnp.abs(
+            ga.astype(jnp.float32) - gp.astype(jnp.float32))))
+        row = {"in": list(in_shape), "k": k, "stride": s,
+               "parity_max_abs_err": round(err, 5)}
+
+        variants = {
+            "fwd_rw": pool_rw,
+            "fwd_patches": pool_patches,
+            "bwd_selectscatter": bwd(pool_rw),
+            "bwd_patches": bwd(pool_patches),
+        }
+        chained = {lbl: make_chained(fn, x)
+                   for lbl, fn in variants.items()}
+        for lbl, fn in chained.items():
+            float(fn(x).ravel()[0].astype(jnp.float32))  # warm
+        samples = {lbl: [] for lbl in chained}
+        for _ in range(4):
+            for lbl, fn in chained.items():
+                samples[lbl].append(slope_sample(fn, x, 100))
+        for lbl, vals in samples.items():
+            # positive MAJORITY gate (bwd_experiments rule): a noise-
+            # dominated sample set must report None, not a median of
+            # negatives
+            positive = [v for v in vals if v > 0]
+            ok = len(positive) >= len(vals) // 2 + 1
+            med = float(numpy.median(vals)) if ok else None
+            row[lbl + "_ms"] = (round(med * 1e3, 3)
+                                if med and med > 0 else None)
+            row[lbl + "_samples_ms"] = [round(v * 1e3, 3)
+                                        for v in vals]
+        report["pool_%s" % name] = row
+        print(json.dumps({("pool_%s" % name): row}), flush=True)
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()

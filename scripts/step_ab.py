@@ -2,8 +2,9 @@
 
 Cross-run throughput on this tunnel swings ~1.4x with congestion, so
 the ONLY honest comparison is two programs interleaved in one
-process: build the full AlexNet fused train step twice (tracing with
-models.conv.USE_CUSTOM_VJP on/off), warm both, then round-robin
+process: build the full AlexNet fused train step twice — tracing once
+with models.conv.conv2d swapped for the custom-VJP build below and
+once with the stock autodiff conv — warm both, then round-robin
 dependent-chain slope samples, median per arm.
 
 Usage: python scripts/step_ab.py [--batch 256] [--rounds 4]
@@ -81,7 +82,8 @@ def build_step(specs, input_shape, batch, dtype_name, classes):
     from veles_tpu.compiler import build_train_step
     from veles_tpu.ops.gather import gather_labels, gather_minibatch
 
-    setup = _setup_training(specs, input_shape, batch, 1024,
+    dataset_size = max(1024, batch * 2)
+    setup = _setup_training(specs, input_shape, batch, dataset_size,
                             dtype_name, classes)
     plans, state, dataset, labels_all, order, dup, has_dropout = setup
     step = build_train_step(plans, donate=False)
@@ -106,7 +108,7 @@ def build_step(specs, input_shape, batch, dtype_name, classes):
         metrics = None
         for i in range(n):
             st, metrics = one(st, dataset, labels_all, order,
-                              (i * batch) % (1024 - batch))
+                              (i * batch) % (dataset_size - batch))
         float(metrics["loss"].astype(jnp.float32))
         return time.perf_counter() - start
 

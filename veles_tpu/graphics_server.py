@@ -2,9 +2,11 @@
 
 Reference veles/graphics_server.py:65-174 bound inproc + ipc + EPGM
 multicast endpoints and launched a matplotlib client subprocess; here
-the PUB socket binds inproc + ipc + tcp (EPGM multicast needs pgm-built
-zmq, absent), and the client (veles_tpu.graphics_client) renders to PNG
-files or an interactive backend.
+the PUB socket binds inproc + ipc + tcp and attempts the reference's
+EPGM multicast endpoint too — engaged automatically on pgm-built zmq,
+skipped with a log line on pgm-less builds (this image's zmq).  The
+client (veles_tpu.graphics_client) renders to PNG files or an
+interactive backend.
 """
 
 import os
@@ -39,6 +41,20 @@ class GraphicsServer(Logger):
             self.endpoints["inproc"] = inproc
         except Exception:
             pass
+        # EPGM multicast (reference graphics_server.py:100-142): bound
+        # when the zmq build ships pgm support; on pgm-less builds
+        # (this image) the bind raises "protocol not supported" and
+        # the capability is skipped — tcp/ipc/inproc carry the plots
+        from veles_tpu.config import root
+        mcast = root.common.graphics.get("multicast_address")
+        if mcast:
+            epgm = "epgm://%s:5555" % mcast
+            try:
+                self.socket.bind(epgm)
+                self.endpoints["epgm"] = epgm
+            except Exception as exc:
+                self.debug("EPGM multicast unavailable (%s): %s",
+                           epgm, exc)
         if launcher is not None:
             launcher.graphics_server = self
         self.published = 0
