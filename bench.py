@@ -466,7 +466,14 @@ def _train_step_images_per_sec(specs, input_shape, batch, dataset_size,
     # double-buffering them.  The dataset/labels/order ride as ARGUMENTS
     # — closing over them would bake hundreds of MB of constants into
     # the program, which a remote-compile service has to swallow whole.
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    # compiler_options must sit on THIS top-level jit: the same
+    # per-chip XLA options the product's fused trainer applies (tuned
+    # scoped-VMEM entry in the device DB), so the row measures what
+    # users get.
+    from veles_tpu.compiler import step_compiler_options
+
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       compiler_options=step_compiler_options())
     def one(state, offset, dataset, labels_all, order):
         idx = jax.lax.dynamic_slice(order, (offset,), (batch,))
         x = gather_minibatch(dataset, idx)

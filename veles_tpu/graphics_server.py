@@ -53,8 +53,8 @@ class GraphicsServer(Logger):
                 self.socket.bind(epgm)
                 self.endpoints["epgm"] = epgm
             except Exception as exc:
-                self.debug("EPGM multicast unavailable (%s): %s",
-                           epgm, exc)
+                self.info("EPGM multicast unavailable (%s): %s — "
+                          "plots ride tcp/ipc/inproc", epgm, exc)
         if launcher is not None:
             launcher.graphics_server = self
         self.published = 0

@@ -49,13 +49,14 @@ class FusedTrainer(Unit):
         import jax
 
         from veles_tpu.compiler import (
-            build_train_step, extract_state, workflow_plan)
+            build_forward, build_train_step, extract_state,
+            step_compiler_options, workflow_plan)
         plans = workflow_plan(self.sw)
         self._plans = plans
         self._step_fn = build_train_step(
-            plans, loss=self.loss, donate=True)
-        forward = __import__("veles_tpu.compiler", fromlist=["x"]) \
-            .build_forward(plans)
+            plans, loss=self.loss, donate=True,
+            compiler_options=step_compiler_options())
+        forward = build_forward(plans)
 
         # eval metrics fused INTO the forward dispatch: one async call
         # per eval minibatch, no eager ops (each eager op costs a
