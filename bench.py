@@ -64,6 +64,7 @@ PEAK_BF16_TFLOPS = (
 SECTION_EST = {
     "native_inference": 25.0,
     "matmul_pass2": 40.0,
+    "alexnet_b128": 100.0,
     "alexnet_b128_bfloat16": 95.0,
     "matmul_f32_level1": 80.0,
     "alexnet_b256_float32": 230.0,
@@ -791,13 +792,21 @@ def main():
         return row
 
     b = alexnet["batch"]
-    section("alexnet_b128", lambda: alex(b, "float32"), always=True)
     if small:
+        section("alexnet_b128", lambda: alex(b, "float32"),
+                always=True)
         section("alexnet_b32_bfloat16", lambda: alex(b, "bfloat16"),
                 always=True)
     else:
+        # the BASELINE throughput/MFU row (b256 bf16) runs FIRST: a
+        # congested run whose compiles eat the budget must lose the
+        # historical b128 f32 comparison row (sheddable, and its
+        # f32-vs-bf16 conclusion is carried by precision_note), never
+        # the headline — a 2x-congested round-5 run spent 240 s on
+        # the b128 first-exec and was killed mid-b256
         section("alexnet_b256_bfloat16",
                 lambda: alex(256, "bfloat16"), always=True)
+        section("alexnet_b128", lambda: alex(b, "float32"))
     # floor the build-join budget at the section's own admission
     # estimate: a section admitted under the deadline policy must get a
     # join window consistent with that policy, not a near-zero clamp

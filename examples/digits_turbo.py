@@ -70,8 +70,11 @@ def main():
     labels_dev = jax.device_put(labels.astype(numpy.int32))
     valid_order = jax.device_put(valid_idx.astype(numpy.int32))
 
-    train = build_train_epoch(plans, args.batch)
-    evaluate = build_eval_epoch(plans, args.batch)
+    from veles_tpu.compiler import step_compiler_options
+    opts = step_compiler_options()  # per-chip tuned XLA options
+    train = build_train_epoch(plans, args.batch, compiler_options=opts)
+    evaluate = build_eval_epoch(plans, args.batch,
+                                compiler_options=opts)
 
     best_err, best_epoch = float("inf"), -1
     for epoch in range(args.epochs):

@@ -69,19 +69,38 @@ def main():
                 return vjp(dy)
             return f
 
-        # parity caveat: the two formulations TIE-BREAK differently
-        # (select-and-scatter routes a tied window's gradient to one
-        # element, jnp.max's VJP splits it evenly), and bf16's coarse
-        # mantissa guarantees ties.  Compare per-window routed SUMS
-        # in f32 instead — identical routing up to tie distribution.
-        xf = x.astype(jnp.float32)
-        dyf = dy.astype(jnp.float32)
+        # Fidelity check on a TIE-FREE f32 input (shuffled arange/n at
+        # batch 8: every value distinct and distinctly
+        # f32-representable), comparing elementwise in f32 so
+        # differing tie-breaks can't masquerade as routing errors.
+        # Measured finding: the patches path is NOT value-exact — the
+        # extraction conv (and its transpose in the backward) runs
+        # through bf16-class precision, quantizing forward values
+        # (err ~2e-3 where it bites, e.g. 0.904321 -> 0.90625) and
+        # perturbing the routed gradient values.  That makes
+        # select-and-scatter the winner on BOTH axes: ~6x faster AND
+        # exact; the rows below record both deltas
+        # (bwd_value_delta_fraction counts elements whose gradient
+        # differs by >1e-7 — quantization of routed values and/or
+        # mis-routed windows).
+        pshape = (8,) + in_shape[1:]
+        n_el = int(numpy.prod(pshape))
+        xf = jnp.asarray(
+            (rng.permutation(n_el).astype(numpy.float32) / n_el)
+            .reshape(pshape))
+        yf_rw = pool_rw(xf)
+        yf_p = pool_patches(xf)
+        row = {"in": list(in_shape), "k": k, "stride": s,
+               "patches_fwd_quantization_err": round(float(
+                   jnp.max(jnp.abs(yf_rw - yf_p))), 6)}
+        dyf = jnp.asarray(rng.rand(
+            *yf_rw.shape).astype(numpy.float32))
         ga = jax.jit(lambda xx: jax.vjp(pool_rw, xx)[1](dyf)[0])(xf)
         gp = jax.jit(lambda xx: jax.vjp(
             pool_patches, xx)[1](dyf)[0])(xf)
-        err = float(jnp.abs(jnp.sum(ga) - jnp.sum(gp)))
-        row = {"in": list(in_shape), "k": k, "stride": s,
-               "parity_routed_sum_abs_err": round(err, 4)}
+        mismatch = float(jnp.mean(
+            (jnp.abs(ga - gp) > 1e-7).astype(jnp.float32)))
+        row["bwd_value_delta_fraction"] = round(mismatch, 6)
 
         variants = {
             "fwd_rw": pool_rw,

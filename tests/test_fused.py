@@ -230,3 +230,25 @@ print("RESUME_OK", err_before, err_after)
     assert proc.returncode == 0, (proc.stdout[-2000:],
                                   proc.stderr[-2000:])
     assert "RESUME_OK" in proc.stdout, proc.stdout[-2000:]
+
+
+def test_step_compiler_options_gated_on_device_db(monkeypatch):
+    """step_compiler_options returns None on untuned device kinds
+    (the CPU test mesh) and the XLA flag dict when the device DB
+    carries a tuned scoped-VMEM entry."""
+    from veles_tpu import backends
+    from veles_tpu.compiler import step_compiler_options
+
+    assert step_compiler_options() is None  # cpu: no tuned entry
+
+    class TunedInfo(object):
+        def __init__(self, kind):
+            self.kind = kind
+
+        def get(self, key, default=None):
+            return 98304 if key == "train_step:scoped_vmem_kib" \
+                else default
+
+    monkeypatch.setattr(backends, "DeviceInfo", TunedInfo)
+    assert step_compiler_options() == {
+        "xla_tpu_scoped_vmem_limit_kib": "98304"}

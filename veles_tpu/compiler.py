@@ -310,7 +310,8 @@ def _tail_schedule(order, batch, what):
     return order, sizes, n_steps, n
 
 
-def build_train_epoch(plans, batch, loss="softmax", donate=True):
+def build_train_epoch(plans, batch, loss="softmax", donate=True,
+                      compiler_options=None):
     """Compile fn(state, dataset, targets, order, key=None) ->
     (new_state, epoch_metrics): the WHOLE epoch as one XLA dispatch.
 
@@ -371,10 +372,13 @@ def build_train_epoch(plans, batch, loss="softmax", donate=True):
         return state, totals
 
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
+    if compiler_options:
+        jit_kwargs["compiler_options"] = compiler_options
     return jax.jit(epoch, **jit_kwargs)
 
 
-def build_eval_epoch(plans, batch, loss="softmax"):
+def build_eval_epoch(plans, batch, loss="softmax",
+                     compiler_options=None):
     """Compile fn(params, dataset, targets, order) -> metrics: the
     whole evaluation pass as one XLA dispatch.
 
@@ -429,4 +433,4 @@ def build_eval_epoch(plans, batch, loss="softmax"):
         name = "n_err" if loss == "softmax" else "mse_sum"
         return {name: total, "samples": count}
 
-    return jax.jit(epoch)
+    return jax.jit(epoch, compiler_options=compiler_options or None)
