@@ -27,7 +27,7 @@ the last complete line, so a kill loses only the unfinished tail, never
 the whole record; (b) checks a deadline (env VELES_BENCH_DEADLINE_S,
 default 480 s) before each optional section and sheds the lowest
 evidence-per-second first — core sections (headline matmul, MNIST,
-AlexNet f32@128 + bf16@256) always run, then native, the second
+AlexNet bf16@256) always run, then f32@128, native, the second
 headline pass, bf16@128, the level-1 true-f32 row, and f32@256 run
 richest-first as time allows; (c) runs the native C++ build on a host
 thread concurrently with the TPU sections.
@@ -771,13 +771,13 @@ def main():
         extras["mnist_784_100_10"] = mnist
 
     # AlexNet rows, one program (= one ~60-200 s server compile) each.
-    # Batch 128 f32 = the historical comparison row (what SCALING.json
-    # projects from); batch 256 bf16 = the throughput/MFU sweet spot —
-    # both are core evidence and always run.  The remaining rows are
-    # ordered by evidence-per-second and shed from the back: bf16@128
-    # (cross-round history), the level-1 true-f32 matmul anchor, and
-    # f32@256 (the 1.5x partner row — its conclusion is carried by
-    # precision_note when shed).
+    # Batch 256 bf16 = the throughput/MFU sweet spot and the only
+    # always-run row; batch 128 f32 = the historical comparison row
+    # (what SCALING.json projects from), sheddable under congestion.
+    # The remaining rows are ordered by evidence-per-second and shed
+    # from the back: bf16@128 (cross-round history), the level-1
+    # true-f32 matmul anchor, and f32@256 (the 1.5x partner row — its
+    # conclusion is carried by precision_note when shed).
     peak = _peak_bf16(matmul_res["device_kind"])
     alexnet = {"batch": 32 if small else 128}
 

@@ -62,6 +62,26 @@ _WF = textwrap.dedent('''
 
     def fitness(spec):
         return -(spec["x"] - 0.5) ** 2
+
+
+    # --ensemble-train / --ensemble-test hooks
+    def member_factory(member, seed):
+        from veles_tpu.dummy import DummyWorkflow
+        wf = DummyWorkflow()
+        return build(wf.workflow)
+
+
+    def ensemble_test_data():
+        from veles_tpu.dummy import DummyWorkflow
+        wf = DummyWorkflow()
+        loader = CliBlobs(wf, minibatch_size=32,
+                          prng=RandomGenerator("etd", seed=9))
+        loader.initialize(device=None)
+        x = loader.original_data.mem[:32]
+        labels = numpy.array(
+            [loader.labels_mapping[loader.original_labels[i]]
+             for i in range(32)])
+        return x, labels
 ''')
 
 
@@ -97,6 +117,29 @@ def test_cli_dump_graph(wf_file, tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     text = open(dot).read()
     assert "digraph" in text and "CliBlobs" in text
+
+
+def test_cli_ensemble_train_then_farmed_test(wf_file, tmp_path):
+    """--ensemble-train then --ensemble-test with farmed member
+    evaluation through the CLI (the reference's two-phase ensemble
+    flow, cmdline.py:182-204)."""
+    ens_dir = str(tmp_path / "ens")
+    proc = _run_cli(wf_file, "-", "--ensemble-train", "2",
+                    "--ensemble-dir", ens_dir,
+                    "root.cli_test.max_epochs=2")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    results = os.path.join(ens_dir, "ensemble.json")
+    assert os.path.exists(results)
+
+    result_file = str(tmp_path / "enstest.json")
+    proc = _run_cli(wf_file, "-", "--ensemble-test", results,
+                    "--farm-slaves", "2",
+                    "--result-file", result_file)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ensemble error rate" in proc.stdout
+    report = json.load(open(result_file))
+    assert report["members"] == 2
+    assert 0.0 <= report["ensemble_error_pct"] <= 100.0
 
 
 def test_cli_optimize(wf_file, tmp_path):

@@ -81,9 +81,10 @@ class Main(object):
             help="ensemble output directory")
         parser.add_argument(
             "--farm-slaves", type=int, default=0, metavar="N",
-            help="farm --optimize/--ensemble-train jobs over the "
-                 "control plane with N local workers; the bound "
-                 "address is logged so remote workers can join")
+            help="farm --optimize/--ensemble-train/--ensemble-test "
+                 "jobs over the control plane with N local workers; "
+                 "the bound address is logged so remote workers can "
+                 "join")
         parser.add_argument(
             "--farm-address", default="127.0.0.1:0", metavar="HOST:PORT",
             help="bind address for the job-farm master (use "
@@ -265,7 +266,7 @@ class Main(object):
         if args.ensemble_train:
             return self._run_ensemble_train(module, args)
         if args.ensemble_test:
-            return self._run_ensemble_test(args)
+            return self._run_ensemble_test(module, args)
         run_fn = getattr(module, "run", None)
         if run_fn is None:
             raise SystemExit(
@@ -352,10 +353,35 @@ class Main(object):
         print("ensemble results -> %s" % path)
         return self.EXIT_SUCCESS
 
-    def _run_ensemble_test(self, args):
+    def _run_ensemble_test(self, module, args):
+        """--ensemble-test RESULTS_JSON: evaluate the stored members
+        (reference ensemble/test_workflow.py reran snapshots and
+        aggregated outputs).  The workflow module supplies the data
+        via ``ensemble_test_data() -> (x, labels)``; with
+        --farm-slaves/--farm-address the member evaluations run as
+        control-plane jobs."""
         from veles_tpu.ensemble import EnsembleTester
-        tester = EnsembleTester(args.ensemble_test, device=args.device)
+        tester = EnsembleTester(
+            args.ensemble_test, device=args.device,
+            farm_slaves=args.farm_slaves,
+            farm_address=args.farm_address)
         print("loaded %d ensemble members" % len(tester.results))
+        data_fn = getattr(module, "ensemble_test_data", None)
+        if data_fn is None:
+            print("(module defines no ensemble_test_data(); "
+                  "nothing evaluated)")
+            return self.EXIT_SUCCESS
+        x, labels = data_fn()
+        err = tester.error_rate(x, labels)
+        print("ensemble error rate: %.2f%% over %d samples"
+              % (err, len(labels)))
+        if args.result_file:
+            import json
+            with open(args.result_file, "w") as fout:
+                json.dump({"ensemble_error_pct": err,
+                           "samples": len(labels),
+                           "members": len(tester.results)}, fout,
+                          indent=1)
         return self.EXIT_SUCCESS
 
 

@@ -89,11 +89,8 @@ class EnsembleTrainer(Logger):
 
     @property
     def farm_enabled(self):
-        """Farming engages with local workers OR an explicit bind
-        address (a remote-only setup has farm_slaves=0 but a real
-        address for off-host workers to join)."""
-        return bool(self.farm_slaves) or \
-            self.farm_address != "127.0.0.1:0"
+        from veles_tpu.jobfarm import farm_enabled
+        return farm_enabled(self.farm_slaves, self.farm_address)
 
     def run(self):
         os.makedirs(self.directory, exist_ok=True)
@@ -121,14 +118,68 @@ class EnsembleTrainer(Logger):
 
 
 class EnsembleTester(Logger):
-    """Load trained members; average their outputs on given data."""
+    """Load trained members; average their outputs on given data.
 
-    def __init__(self, results_path, device=None):
+    ``farm_slaves``/``farm_address``: evaluate members as control-plane
+    jobs instead of in-process (the reference's ``--ensemble-test``
+    reran stored snapshots as jobs the same way,
+    ensemble/test_workflow.py); workers need the snapshot files
+    visible at the recorded paths (same host or shared mount)."""
+
+    FARM_TAG = "ensemble-test"
+
+    def __init__(self, results_path, device=None, farm_slaves=0,
+                 farm_address="127.0.0.1:0"):
         super(EnsembleTester, self).__init__()
         with open(results_path) as fin:
             self.results = json.load(fin)["models"]
         self.device = device
+        self.farm_slaves = farm_slaves
+        self.farm_address = farm_address
         self._members = None
+
+    @property
+    def farm_enabled(self):
+        from veles_tpu.jobfarm import farm_enabled
+        return farm_enabled(self.farm_slaves, self.farm_address)
+
+    def _device_spec(self):
+        """Picklable device identity for job specs (workers rebuild
+        their own Device from the backend name)."""
+        if self.device is None or isinstance(self.device, str):
+            return self.device
+        return getattr(self.device, "backend", None)
+
+    @staticmethod
+    def _forward_outputs(sw, x):
+        """One member's forward pass — the single definition both the
+        in-process and farmed paths run, so they cannot diverge."""
+        from veles_tpu.compiler import (
+            build_forward, extract_state, workflow_plan)
+        plans = workflow_plan(sw)
+        state = extract_state(sw)
+        params = [{"weights": s["weights"], "bias": s["bias"]}
+                  for s in state]
+        return numpy.asarray(build_forward(plans)(params, x))
+
+    @staticmethod
+    def predict_member(spec, x):
+        """Farmed job body: load one snapshot, run its forward on the
+        context-shipped batch ``x``; returns (B, classes) numpy."""
+        from veles_tpu.dummy import DummyLauncher
+        snapshot, device_spec = spec
+        with open(snapshot, "rb") as fin:
+            sw = pickle.load(fin)
+        sw.workflow = DummyLauncher()
+        sw.initialize(device=device_spec)
+        return EnsembleTester._forward_outputs(sw, x)
+
+    def worker(self, address):
+        """Blocking remote-worker loop for distributed ensemble
+        evaluation."""
+        from veles_tpu.jobfarm import JobFarm
+        return JobFarm(self.FARM_TAG).worker(address,
+                                             self.predict_member)
 
     @property
     def members(self):
@@ -145,15 +196,21 @@ class EnsembleTester(Logger):
 
     def predict(self, x):
         """Average member outputs: (B, classes)."""
-        from veles_tpu.compiler import (
-            build_forward, extract_state, workflow_plan)
-        outputs = []
-        for sw in self.members:
-            plans = workflow_plan(sw)
-            state = extract_state(sw)
-            params = [{"weights": s["weights"], "bias": s["bias"]}
-                      for s in state]
-            outputs.append(numpy.asarray(build_forward(plans)(params, x)))
+        if self.farm_enabled:
+            from veles_tpu.jobfarm import JobFarm
+            device_spec = self._device_spec()
+            # the batch ships ONCE per worker as farm context, not
+            # inside every member's job spec
+            outputs = JobFarm(self.FARM_TAG,
+                              context=numpy.asarray(x)).run(
+                [(entry["snapshot"], device_spec)
+                 for entry in self.results],
+                runner=self.predict_member,
+                address=self.farm_address,
+                local_slaves=self.farm_slaves)
+            return numpy.mean(outputs, axis=0)
+        outputs = [self._forward_outputs(sw, x)
+                   for sw in self.members]
         return numpy.mean(outputs, axis=0)
 
     def error_rate(self, x, labels):

@@ -62,11 +62,8 @@ class GeneticsOptimizer(Logger):
 
     @property
     def farm_enabled(self):
-        """Farming engages with local workers OR an explicit bind
-        address (a remote-only setup has farm_slaves=0 but a real
-        address for off-host workers to join)."""
-        return bool(self.farm_slaves) or \
-            self.farm_address != "127.0.0.1:0"
+        from veles_tpu.jobfarm import farm_enabled
+        return farm_enabled(self.farm_slaves, self.farm_address)
 
     def _evaluate_all(self):
         pending = self.population.unevaluated()

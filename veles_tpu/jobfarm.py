@@ -69,6 +69,14 @@ class FarmJobError(RuntimeError):
 _UNSET = object()
 
 
+def farm_enabled(farm_slaves, farm_address):
+    """The one enablement rule for farm-riding classes: local workers
+    requested, OR an explicit bind address (the "127.0.0.1:0" default
+    is the no-farming sentinel; remote-only setups pass a real
+    address)."""
+    return bool(farm_slaves) or farm_address != "127.0.0.1:0"
+
+
 class _FarmMaster(object):
     """Workflow-contract adapter the Server drives on the master.
 
@@ -78,10 +86,11 @@ class _FarmMaster(object):
     release (clients never poll — see client.py's 'wait' handling)."""
 
     def __init__(self, checksum, speculation_factor=2.0,
-                 min_speculation_s=5.0):
+                 min_speculation_s=5.0, context=None):
         self.checksum = checksum
         self.speculation_factor = speculation_factor
         self.min_speculation_s = min_speculation_s
+        self.context = context
         self._lock = threading.Lock()
         self._specs = []
         self._pending = deque()
@@ -107,7 +116,12 @@ class _FarmMaster(object):
     # -- Server-side workflow contract ---------------------------------
 
     def generate_initial_data_for_slave(self, slave):
-        return None
+        # shared context ships ONCE per worker at handshake (e.g. the
+        # eval batch every ensemble-test job reads) instead of riding
+        # inside every job spec
+        if self.context is None:
+            return None
+        return ("ctx", self.context)
 
     def generate_data_for_slave(self, slave):
         with self._lock:
@@ -177,19 +191,31 @@ class _FarmMaster(object):
 
 
 class _FarmSlave(object):
-    """Workflow-contract adapter the Client drives on a worker."""
+    """Workflow-contract adapter the Client drives on a worker.
+
+    When the master ships a shared context, the runner is called as
+    ``runner(spec, context)``; otherwise ``runner(spec)``."""
+
+    _NO_CTX = object()
 
     def __init__(self, checksum, runner):
         self.checksum = checksum
         self.runner = runner
+        self.context = self._NO_CTX
 
     def apply_initial_data_from_master(self, initial):
-        pass
+        if isinstance(initial, tuple) and len(initial) == 2 \
+                and initial[0] == "ctx":
+            self.context = initial[1]
 
     def do_job(self, data, update, callback):
         epoch, i, spec = data
         try:
-            callback((epoch, i, ("ok", self.runner(spec))))
+            if self.context is self._NO_CTX:
+                result = self.runner(spec)
+            else:
+                result = self.runner(spec, self.context)
+            callback((epoch, i, ("ok", result)))
         except Exception as exc:  # travels back; farm fails loudly
             callback((epoch, i, ("err", repr(exc))))
 
@@ -198,13 +224,14 @@ class JobFarm(Logger):
     """Farm independent picklable jobs across control-plane workers."""
 
     def __init__(self, tag, codec=None, speculation_factor=2.0,
-                 min_speculation_s=5.0,
+                 min_speculation_s=5.0, context=None,
                  job_timeout=DEFAULT_JOB_TIMEOUT, **server_kwargs):
         super(JobFarm, self).__init__()
         self.tag = tag
         self.codec = codec
         self.speculation_factor = speculation_factor
         self.min_speculation_s = min_speculation_s
+        self.context = context
         self.job_timeout = job_timeout
         self.server_kwargs = server_kwargs
         self.server = None
@@ -242,7 +269,8 @@ class JobFarm(Logger):
             raise ValueError("local_slaves > 0 requires a runner")
         self._master = _FarmMaster(self.checksum,
                                    self.speculation_factor,
-                                   self.min_speculation_s)
+                                   self.min_speculation_s,
+                                   context=self.context)
         self.server = Server(address, self._master, codec=self.codec,
                              job_timeout=self.job_timeout,
                              **self.server_kwargs)
