@@ -78,15 +78,18 @@ def _write_cifar10_drop(data_dir, rng):
     return base
 
 
-def _write_mnist_drop(data_dir, rng):
+def _write_mnist_drop(data_dir, rng=None):
     """Canonical-shaped synthetic MNIST idx files (uncompressed names;
-    _fetch accepts the .gz name minus .gz)."""
+    _fetch accepts the .gz name minus .gz).  ``rng=None`` writes
+    all-zero files — same shapes, much faster for ingest tests."""
     from veles_tpu.datasets import MNIST_FILES
     for key, filename in MNIST_FILES.items():
         count = 60000 if key.startswith("train") else 10000
-        if key.endswith("images"):
-            arr = rng.randint(0, 256, (count, 28, 28),
-                              dtype=numpy.uint8)
+        shape = (count, 28, 28) if key.endswith("images") else (count,)
+        if rng is None:
+            arr = numpy.zeros(shape, numpy.uint8)
+        elif key.endswith("images"):
+            arr = rng.randint(0, 256, shape, dtype=numpy.uint8)
         else:
             arr = rng.randint(0, 10, count, dtype=numpy.uint8)
         _write_idx(data_dir / filename[:-3], arr)
@@ -130,16 +133,6 @@ def test_selfcheck_reports_missing_when_no_drop(tmp_path):
     assert report["stl10"]["status"] == "missing"
 
 
-def _write_zero_mnist_drop(drop):
-    """Canonical-SHAPED (all-zero, fast) idx files into ``drop``."""
-    from veles_tpu.datasets import MNIST_FILES
-    for key, filename in MNIST_FILES.items():
-        count = 60000 if key.startswith("train") else 10000
-        shape = (count, 28, 28) if key.endswith("images") else (count,)
-        _write_idx(drop / filename[:-3],
-                   numpy.zeros(shape, numpy.uint8))
-
-
 def test_ingest_stages_drop_and_selfchecks(tmp_path):
     """The one-command data drop (VERDICT r04 task 3): canonical-format
     files anywhere under a directory land in the cache, parse, and
@@ -152,7 +145,7 @@ def test_ingest_stages_drop_and_selfchecks(tmp_path):
     drop.mkdir(parents=True)
     cache = tmp_path / "cache"
     cache.mkdir()
-    _write_zero_mnist_drop(drop)
+    _write_mnist_drop(drop)
     cdir = drop / "cifar-10-batches-py"
     cdir.mkdir()
     batch = {b"data": numpy.zeros((10000, 3072), numpy.uint8),
@@ -184,7 +177,7 @@ def test_ingest_cli_command(tmp_path):
     drop.mkdir()
     cache = tmp_path / "cache"
     cache.mkdir()
-    _write_zero_mnist_drop(drop)
+    _write_mnist_drop(drop)
     env = dict(os.environ, JAX_PLATFORMS="cpu", VELES_BACKEND="cpu")
     proc = subprocess.run(
         [sys.executable, "-m", "veles_tpu.datasets", "ingest",

@@ -512,21 +512,23 @@ def selfcheck(data_dir=None):
     return report
 
 
-#: artifact name -> (dataset, destination subdir under the cache);
-#: everything the one-command ingest recognizes in a user's drop dir
-_INGEST_FILES = {}
-for _name in MNIST_FILES.values():
-    _INGEST_FILES[_name] = ("mnist", "")
-    _INGEST_FILES[_name[:-3]] = ("mnist", "")       # uncompressed idx
-_INGEST_FILES[_OPENML_NPZ] = ("mnist", "")
-for _i in list(range(1, 6)):
-    _INGEST_FILES["data_batch_%d" % _i] = (
-        "cifar10", "cifar-10-batches-py")
-_INGEST_FILES["test_batch"] = ("cifar10", "cifar-10-batches-py")
-_INGEST_FILES["batches.meta"] = ("cifar10", "cifar-10-batches-py")
-for _name in ("train_X.bin", "train_y.bin", "test_X.bin",
-              "test_y.bin", "unlabeled_X.bin", "class_names.txt"):
-    _INGEST_FILES[_name] = ("stl10", "stl10_binary")
+def _ingest_table():
+    """artifact name -> (dataset, destination subdir under the cache);
+    everything the one-command ingest recognizes in a drop dir."""
+    table = {_OPENML_NPZ: ("mnist", "")}
+    for name in MNIST_FILES.values():
+        table[name] = ("mnist", "")
+        table[name[:-3]] = ("mnist", "")            # uncompressed idx
+    for name in ["data_batch_%d" % i for i in range(1, 6)] + [
+            "test_batch", "batches.meta"]:
+        table[name] = ("cifar10", "cifar-10-batches-py")
+    for name in ("train_X.bin", "train_y.bin", "test_X.bin",
+                 "test_y.bin", "unlabeled_X.bin", "class_names.txt"):
+        table[name] = ("stl10", "stl10_binary")
+    return table
+
+
+_INGEST_FILES = _ingest_table()
 _INGEST_TARBALLS = {
     "cifar-10-python.tar.gz": "cifar10",
     "stl10_binary.tar.gz": "stl10",

@@ -98,6 +98,7 @@ class _FarmMaster(object):
         self._durations = deque(maxlen=200)
         self.epoch = 0              # batch counter; stamps every job
         self.results = []
+        self._remaining = 0
         self.done = threading.Event()
         self.done.set()
 
@@ -110,6 +111,7 @@ class _FarmMaster(object):
             self._pending = deque(enumerate(self._specs))
             self._outstanding = {}
             self.results = [_UNSET] * len(self._specs)
+            self._remaining = len(self._specs)
             if self._specs:
                 self.done.clear()
 
@@ -170,7 +172,8 @@ class _FarmMaster(object):
                 return True         # a backup copy finished first
             self.results[i] = result
             self._outstanding.pop(i, None)
-            finished = all(r is not _UNSET for r in self.results)
+            self._remaining -= 1
+            finished = self._remaining == 0
         if finished:
             self.done.set()
         return True
