@@ -218,6 +218,35 @@ def test_ensemble_remote_worker_entrypoint(tmp_path, cpu_device):
     assert [e["id"] for e in master.results] == [0, 1]
 
 
+def test_ensemble_train_ratio_reaches_three_arg_factories(tmp_path,
+                                                          cpu_device):
+    """--ensemble-train N:r semantics: factories that accept a third
+    parameter receive the per-member train fraction; two-arg
+    factories keep working."""
+    seen = []
+
+    def factory3(member, seed, train_ratio):
+        seen.append((member, train_ratio))
+        return _member_factory(member, seed)
+
+    trainer = EnsembleTrainer(
+        factory3, size=2, directory=str(tmp_path),
+        train_ratio=0.5, device=cpu_device)
+    trainer.run()
+    assert seen == [(0, 0.5), (1, 0.5)]
+
+    # a **kwargs-only third "parameter" must NOT be fed a positional
+    kw_calls = []
+
+    def factory_kw(member, seed, **opts):
+        kw_calls.append(opts)
+        return _member_factory(member, seed)
+
+    EnsembleTrainer(factory_kw, size=1, directory=str(tmp_path),
+                    train_ratio=0.5, device=cpu_device).run()
+    assert kw_calls == [{}]
+
+
 def test_ensemble_train_and_test(tmp_path, cpu_device):
     trainer = EnsembleTrainer(
         _member_factory, size=3, directory=str(tmp_path),

@@ -72,7 +72,9 @@ class Main(object):
         parser.add_argument(
             "--ensemble-train", default=None, metavar="N[:RATIO]",
             help="train an N-model ensemble; the module must expose "
-                 "member_factory(index, seed)")
+                 "member_factory(index, seed[, train_ratio]) — the "
+                 "optional third parameter receives RATIO (the "
+                 "per-member train-set fraction, default 1.0)")
         parser.add_argument(
             "--ensemble-test", default=None, metavar="RESULTS_JSON",
             help="test a trained ensemble from its results file")

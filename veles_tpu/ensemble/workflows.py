@@ -61,9 +61,31 @@ class EnsembleTrainer(Logger):
     def train_member(self, i):
         """Train one member end to end; returns its results entry.
         This is the farmed job body — self-contained so any worker
-        (thread here, remote host via :meth:`worker`) can run it."""
+        (thread here, remote host via :meth:`worker`) can run it.
+
+        The reference's ``--ensemble-train N:r`` trained each member
+        on a random r-fraction of the train set; factories that take
+        a third argument receive ``train_ratio`` to apply it (the
+        two-argument ``factory(index, seed)`` form stays valid)."""
+        import inspect
         seed = self.base_seed + i
-        sw = self.workflow_factory(i, seed)
+        takes_ratio = False
+        try:
+            params = inspect.signature(
+                self.workflow_factory).parameters.values()
+            positional = sum(
+                1 for p in params
+                if p.kind in (p.POSITIONAL_ONLY,
+                              p.POSITIONAL_OR_KEYWORD))
+            var_positional = any(
+                p.kind == p.VAR_POSITIONAL for p in params)
+            takes_ratio = positional >= 3 or var_positional
+        except (TypeError, ValueError):
+            pass
+        if takes_ratio:
+            sw = self.workflow_factory(i, seed, self.train_ratio)
+        else:
+            sw = self.workflow_factory(i, seed)
         sw.initialize(device=self.device)
         sw.run()
         snapshot = os.path.join(self.directory,
