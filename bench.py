@@ -68,6 +68,9 @@ SECTION_EST = {
     "alexnet_b128_bfloat16": 95.0,
     "matmul_f32_level1": 80.0,
     "alexnet_b256_float32": 230.0,
+    # two small MLP programs (MNIST-784 head + an AlexNet-shaped input
+    # head), each compiled once and A/B'd with the pipeline on/off
+    "pipeline_ab": 90.0,
 }
 
 # a section whose dominant cost (the one-time server compile) loosely
@@ -130,6 +133,11 @@ def _compact_record(value, small, extras):
     for k in ("batch_1_rows_per_sec", "batch_256_rows_per_sec"):
         if k in nat:
             rec["native_" + k] = nat[k]
+    pipe = extras.get("pipeline_ab") or {}
+    for src, dst in (("mnist_784", "pipe_mnist_speedup"),
+                     ("alexnet_input", "pipe_alex_in_speedup")):
+        if "speedup" in (pipe.get(src) or {}):
+            rec[dst] = pipe[src]["speedup"]
     if "wall_s" in extras:
         rec["wall_s"] = extras["wall_s"]
     if extras.get("shed"):
@@ -612,6 +620,115 @@ def bench_mnist(small):
     return row
 
 
+def _pipeline_workflow(input_shape, hidden, classes, batch, train_n,
+                       valid_n, pipeline):
+    """The real product path for the pipeline A/B: StandardWorkflow +
+    host-resident FullBatchLoader (host fill + H2D every serve) +
+    fused trainer, with the async input pipeline on or off."""
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from veles_tpu.prng import RandomGenerator
+
+    class SynthLoader(FullBatchLoader):
+        def load_data(self):
+            self.class_lengths[:] = [0, valid_n, train_n]
+            self._calc_class_end_offsets()
+            self.create_originals(input_shape)
+            rng = numpy.random.RandomState(3)
+            flat = self.original_data.mem.reshape(self.total_samples, -1)
+            flat[:] = rng.rand(*flat.shape) * 0.5
+            for i in range(self.total_samples):
+                self.original_labels[i] = i % classes
+
+    prng.get().seed(42)
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": hidden,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": classes,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: SynthLoader(
+            w, minibatch_size=batch, on_device=False,
+            prng=RandomGenerator("bench_pipe", seed=7)),
+        decision_config=dict(max_epochs=10 ** 6),
+    )
+    sw.fuse(pipeline=pipeline)
+    sw.initialize(device=Device(backend=None))
+    return sw
+
+
+def _pipeline_ab_row(input_shape, hidden, classes, batch, train_n,
+                     valid_n, chain_lens):
+    """One A/B row: per-step slope of loader.run+trainer.run with the
+    pipeline off, then on, over the SAME synthetic workload."""
+    row = {}
+    for key, pipeline in (("off", False), ("on", True)):
+        sw = _pipeline_workflow(input_shape, hidden, classes, batch,
+                                train_n, valid_n, pipeline)
+        loader, trainer = sw.loader, sw.fused_trainer
+        # warm past the whole validation class so BOTH programs (eval
+        # forward + train step) compile outside the timed chains
+        for _ in range(valid_n // batch + 1):
+            loader.run()
+            trainer.run()
+        float(trainer.last_loss or 0.0)
+
+        def chain(k):
+            start = time.perf_counter()
+            for _ in range(k):
+                loader.run()
+                trainer.run()
+            if trainer.last_loss is not None:
+                float(trainer.last_loss)
+            trainer.device.sync()
+            return time.perf_counter() - start
+
+        n1, n2 = chain_lens
+        per_step, samples = _robust_slope(
+            chain, n1, n2, dispatch_floor_seconds(),
+            "pipeline_%s_%s" % ("x".join(map(str, input_shape)), key))
+        row["%s_step_s" % key] = round(per_step, 9)
+        row["%s_spread" % key] = _spread(samples)
+        if pipeline and trainer._prefetcher is not None:
+            stats = trainer._prefetcher.stats
+            serves = max(1, stats["serves"])
+            row["fill_s_per_serve"] = round(stats["fill_s"] / serves, 9)
+            row["h2d_s_per_serve"] = round(stats["h2d_s"] / serves, 9)
+            applied = max(1, stats["applied"])
+            row["wait_s_per_step"] = round(stats["wait_s"] / applied, 9)
+        sw.stop()  # joins the prefetch worker
+    row["speedup"] = round(row["off_step_s"] / row["on_step_s"], 3)
+    return row
+
+
+def bench_pipeline(small):
+    """A/B of the async double-buffered input pipeline: with pipeline=on
+    the host fill and H2D of minibatch k+1 overlap step k, so the step
+    slope should approach max(fill, h2d, compute) instead of their sum.
+
+    Two rows through the REAL workflow path (loader unit -> fused
+    trainer): the MNIST-784 head, and an AlexNet-shaped input path
+    (227x227x3 images through a host fill + ~12 MB/batch H2D)."""
+    rows = {}
+    if small:
+        rows["mnist_784"] = _pipeline_ab_row(
+            (784,), 100, 10, 100, 500, 100, (2, 12))
+        rows["alexnet_input"] = _pipeline_ab_row(
+            (67, 67, 3), 64, 10, 32, 96, 32, (2, 8))
+    else:
+        rows["mnist_784"] = _pipeline_ab_row(
+            (784,), 100, 10, 100, 2000, 200, (5, 105))
+        rows["alexnet_input"] = _pipeline_ab_row(
+            (227, 227, 3), 64, 10, 64, 192, 64, (2, 22))
+    return rows
+
+
 def bench_alexnet_row(batch, dtype_name, small, peak):
     """One AlexNet throughput row (one distinct program = one
     unavoidable ~60 s server-side compile on a tunneled chip)."""
@@ -769,6 +886,13 @@ def main():
     mnist = section("mnist", lambda: bench_mnist(small), always=True)
     if mnist is not None:
         extras["mnist_784_100_10"] = mnist
+
+    # async input pipeline A/B (small MLP programs, cheap compiles):
+    # records the overlap win of fill/H2D/step pipelining on the MNIST
+    # fused step and an AlexNet-shaped input path
+    pipeline_res = section("pipeline_ab", lambda: bench_pipeline(small))
+    if pipeline_res is not None:
+        extras["pipeline_ab"] = pipeline_res
 
     # AlexNet rows, one program (= one ~60-200 s server compile) each.
     # Batch 256 bf16 = the throughput/MFU sweet spot and the only

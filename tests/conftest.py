@@ -23,6 +23,29 @@ if not os.environ.get("VELES_TEST_TPU"):
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _no_nondaemon_thread_leaks():
+    """Fail any test leaking a live NON-daemon thread: such a thread
+    outlives pytest and hangs CI.  Guards the input-pipeline prefetch
+    worker and every other thread_pool.py user — worker pools must be
+    shut down (joined) by the code under test, not abandoned."""
+    import threading
+    import time
+
+    before = set(threading.enumerate())
+    yield
+    deadline = time.time() + 3.0
+    leaked = []
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive() and not t.daemon]
+        if not leaked:
+            return
+        time.sleep(0.05)  # give wind-downs in progress a moment
+    pytest.fail("leaked non-daemon thread(s): %s" %
+                ", ".join(sorted(t.name for t in leaked)))
+
+
 @pytest.fixture
 def cpu_device():
     from veles_tpu.backends import Device

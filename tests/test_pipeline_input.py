@@ -1,0 +1,376 @@
+"""Async double-buffered input pipeline (veles_tpu/pipeline_input.py):
+parity with the synchronous serve, short-tail handling, clean shutdown,
+the Array staging/prefetch dirty-bit machinery, and per-run stats."""
+
+import io
+import re
+import threading
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.memory import Array
+from veles_tpu.prng import RandomGenerator
+from tests.test_models import BlobsLoader
+
+
+def _build_fused(device, pipeline, max_epochs=4, on_device=True,
+                 loader_cls=BlobsLoader, minibatch_size=64):
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    prng.get().seed(1234)  # identical layer-init streams across builds
+    wf = DummyWorkflow()
+    sw = StandardWorkflow(
+        wf.workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: loader_cls(
+            w, minibatch_size=minibatch_size, on_device=on_device,
+            prng=RandomGenerator("pipe", seed=7)),
+        decision_config=dict(max_epochs=max_epochs),
+    )
+    sw.fuse(pipeline=pipeline)
+    sw.initialize(device=device)
+    return sw
+
+
+@pytest.mark.parametrize("on_device", [True, False],
+                         ids=["device-gather", "host-fill"])
+def test_pipeline_bit_identical_to_sync(cpu_device, on_device):
+    """Epoch metrics AND final weights must match the synchronous path
+    bit for bit: the pipeline serves the same minibatches in the same
+    order, staged through the same device_put bytes."""
+    sync = _build_fused(cpu_device, pipeline=False, on_device=on_device)
+    sync.run()
+    pipe = _build_fused(cpu_device, pipeline=True, on_device=on_device)
+    assert pipe.fused_trainer._prefetcher is not None
+    pipe.run()
+
+    assert sync.decision.epoch_metrics == pipe.decision.epoch_metrics
+    assert sync.fused_trainer.run_calls == pipe.fused_trainer.run_calls
+    sync.fused_trainer.sync()
+    pipe.fused_trainer.sync()
+    for fwd_s, fwd_p in zip(sync.forwards, pipe.forwards):
+        fwd_s.weights.map_read()
+        fwd_p.weights.map_read()
+        numpy.testing.assert_array_equal(fwd_s.weights.mem,
+                                         fwd_p.weights.mem)
+    # workers joined at run end: nothing non-daemon left behind
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("prefetch")]
+
+
+class TailBlobsLoader(BlobsLoader):
+    """Class sizes deliberately NOT divisible by the minibatch size:
+    validation 10 (tail 10), train 70 (tails 32+32+6)."""
+
+    def load_data(self):
+        self.class_lengths[:] = [0, 10, 70]
+        self._calc_class_end_offsets()
+        self.create_originals((16,))
+        rng = numpy.random.RandomState(99)
+        centers = rng.randn(4, 16) * 2.0
+        for i in range(self.total_samples):
+            label = i % 4
+            self.original_data.mem[i] = (
+                centers[label] + rng.randn(16) * 0.3)
+            self.original_labels[i] = label
+
+
+@pytest.mark.parametrize("on_device", [True, False],
+                         ids=["device-gather", "host-fill"])
+def test_pipeline_short_tail_minibatches(cpu_device, on_device):
+    """Short-tail minibatches (size < max) keep the exact synchronous
+    sequence of (class, size, offset, flags) and the same zero/-1
+    padding semantics."""
+    def serve_sequence(pipeline, steps=12):
+        sw = _build_fused(cpu_device, pipeline=pipeline, on_device=on_device,
+                          loader_cls=TailBlobsLoader, minibatch_size=32)
+        loader, trainer = sw.loader, sw.fused_trainer
+        seq = []
+        for _ in range(steps):
+            loader.run()
+            pf = trainer._prefetcher
+            if pf is not None:
+                x = numpy.asarray(pf.current.data)
+                y = numpy.asarray(pf.current.labels)
+            else:
+                x = numpy.asarray(
+                    loader.minibatch_data.device_array(trainer.device))
+                y = numpy.asarray(
+                    loader.minibatch_labels.device_array(trainer.device))
+            seq.append((loader.minibatch_class, loader.minibatch_size,
+                        loader.minibatch_offset,
+                        bool(loader.last_minibatch),
+                        bool(loader.epoch_ended), loader.epoch_number,
+                        x.tobytes(), y.tobytes()))
+            trainer.run()
+        sw.stop()
+        return seq
+
+    sync_seq = serve_sequence(False)
+    pipe_seq = serve_sequence(True)
+    assert sync_seq == pipe_seq
+    sizes = [s[1] for s in pipe_seq]
+    assert 6 in sizes and 10 in sizes  # the short tails really occurred
+    # tail padding: beyond minibatch_size the batch is zeroed / -1
+    for cls, size, _off, _lmb, _ee, _en, xb, yb in pipe_seq:
+        if size == 6:
+            x = numpy.frombuffer(xb, numpy.float32).reshape(32, 16)
+            y = numpy.frombuffer(yb, numpy.int32)
+            assert not x[6:].any()
+            assert (y[6:] == -1).all()
+
+
+def test_pipeline_stop_mid_epoch_joins_worker(cpu_device):
+    """Workflow.stop() mid-epoch must leave no live worker threads, and
+    a later run must restart the pipeline cleanly."""
+    sw = _build_fused(cpu_device, pipeline=True)
+    loader, trainer = sw.loader, sw.fused_trainer
+    for _ in range(3):  # mid-epoch: train class not finished
+        loader.run()
+        trainer.run()
+    prefetcher = trainer._prefetcher
+    assert prefetcher._pool is not None
+    sw.stop()
+    assert prefetcher._pool is None
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("prefetch") and t.is_alive()]
+    # restart: a full run completes and joins its fresh worker again
+    sw.run()
+    assert bool(sw.decision.complete)
+    assert prefetcher._pool is None
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("prefetch") and t.is_alive()]
+
+
+def test_pipeline_never_drops_served_ahead_minibatches(cpu_device):
+    """Serving ahead must not lose data: the not-yet-consumed serve
+    keeps its pending record, a mid-run snapshot requeues it into
+    failed_minibatches, and shutdown does the same in-process."""
+    import time
+
+    sw = _build_fused(cpu_device, pipeline=True)
+    loader, trainer = sw.loader, sw.fused_trainer
+    for _ in range(3):
+        loader.run()
+        trainer.run()
+    # wait for the served-ahead minibatch to land in the results queue
+    # (its pending record is appended during the serve)
+    prefetcher = trainer._prefetcher
+    deadline = time.time() + 10.0
+    while prefetcher._results.empty() and time.time() < deadline:
+        time.sleep(0.01)
+    # depth 1: exactly one serve is ahead and unconsumed
+    state = loader.__getstate__()  # the snapshotter's view, mid-run
+    assert len(state["failed_minibatches"]) == 1
+    sw.stop()
+    assert len(loader.failed_minibatches) == 1
+    offset, size, mb_class = loader.failed_minibatches[0][:3]
+    assert size > 0 and mb_class in (0, 1, 2)
+    # consume-time accounting: only CONSUMED samples were counted, so a
+    # replay of the requeued record cannot double-count
+    assert loader.samples_served == 3 * 64
+
+
+def test_loader_setstate_migrates_legacy_serving_fields(cpu_device):
+    """Snapshots written when minibatch_class/epoch_number were plain
+    attributes must still restore now that they are properties."""
+    sw = _build_fused(cpu_device, pipeline=False)
+    loader = sw.loader
+    state = loader.__getstate__()
+    # simulate a pre-property snapshot
+    state["minibatch_class"] = 2
+    state["epoch_number"] = 5
+    state.pop("_minibatch_class", None)
+    state.pop("_epoch_number", None)
+    restored = object.__new__(type(loader))
+    restored.__setstate__(state)
+    assert restored.minibatch_class == 2
+    assert restored.epoch_number == 5
+
+
+def test_pipeline_worker_failure_propagates(cpu_device):
+    """A crash inside the worker's serve must surface in the graph
+    thread (not hang the run), and still wind the worker down."""
+    sw = _build_fused(cpu_device, pipeline=True)
+    loader = sw.loader
+    loader.run()  # primes the pipeline
+    sw.fused_trainer.run()
+
+    def boom():
+        raise RuntimeError("fill exploded")
+    loader.fill_indices = lambda *a: boom()
+    with pytest.raises(RuntimeError, match="fill exploded"):
+        for _ in range(4):  # inflight items may drain first
+            loader.run()
+    assert sw.fused_trainer._prefetcher._pool is None
+
+
+# -- memory.Array staging + prefetch dirty-bit machinery -------------------
+
+
+def test_array_staging_ping_pong(cpu_device):
+    arr = Array(numpy.zeros((4, 3), numpy.float32))
+    arr.stage_init(2)
+    assert arr.staged
+    bufs = arr._stage_bufs_
+    assert bufs[0] is arr.mem
+
+    arr.stage_begin(0)
+    arr.mem[:] = 1.0
+    dev0 = arr.stage_put(cpu_device)
+    arr.stage_begin(1)
+    assert arr.mem is bufs[1]
+    arr.mem[:] = 2.0
+    dev1 = arr.stage_put(cpu_device)
+    # refilling slot 0 must not corrupt the already-transferred batch
+    arr.stage_begin(0)
+    arr.mem[:] = 3.0
+    numpy.testing.assert_array_equal(numpy.asarray(dev0), 1.0)
+    numpy.testing.assert_array_equal(numpy.asarray(dev1), 2.0)
+    # while staged, host state is authoritative: map_read cannot
+    # replace the slot buffer with a device fetch mid-fill
+    arr.map_read()
+    assert arr.mem is bufs[0]
+
+    # a wholesale buffer swap drops the staging slots
+    arr.mem = numpy.zeros((2, 2), numpy.float32)
+    assert not arr.staged
+
+
+def test_array_staged_capture_prefers_device_path(cpu_device):
+    arr = Array(numpy.zeros(3, numpy.float32))
+    dev = cpu_device.put(numpy.arange(3, dtype=numpy.float32))
+    arr.set_device_array(dev, cpu_device)
+    assert arr.staged_capture(cpu_device) is dev  # adopted, no re-put
+    arr.detach_device()
+    arr.mem = numpy.full(3, 7.0, numpy.float32)
+    out = numpy.asarray(arr.staged_capture(cpu_device))
+    numpy.testing.assert_array_equal(out, 7.0)  # falls back to a put
+
+
+class _PlainDevArray(object):
+    """Device-array stand-in WITHOUT copy_to_host_async."""
+
+    def __init__(self, value):
+        self._value = value
+        self.shape = value.shape
+        self.dtype = value.dtype
+
+    def __array__(self, dtype=None):
+        return (self._value if dtype is None
+                else self._value.astype(dtype))
+
+
+def test_prefetch_host_eager_fallback_dirty_bits():
+    """Satellite: prefetch_host on a backend array without
+    copy_to_host_async must fetch eagerly (state -> IN_SYNC with the
+    device bytes local), not silently no-op."""
+    from veles_tpu import memory
+    arr = Array(numpy.zeros(4, numpy.float32))
+    fake = _PlainDevArray(numpy.arange(4, dtype=numpy.float32))
+    arr.set_device_array(fake)
+    assert arr._state_ == memory._DEVICE_DIRTY
+    arr.prefetch_host()
+    assert arr._state_ == memory._IN_SYNC  # eager fetch happened NOW
+    numpy.testing.assert_array_equal(
+        arr.mem, numpy.arange(4, dtype=numpy.float32))
+    arr.map_read()  # no-op, stays in sync
+    assert arr._state_ == memory._IN_SYNC
+
+    # detach after prefetch: host stays authoritative and readable
+    arr.detach_device()
+    assert arr._devmem_ is None
+    numpy.testing.assert_array_equal(
+        arr.mem, numpy.arange(4, dtype=numpy.float32))
+
+
+def test_prefetch_host_async_path_keeps_device_dirty(cpu_device):
+    """With copy_to_host_async available the state must STAY
+    device-dirty (the async copy is a hint, map_read still syncs)."""
+    from veles_tpu import memory
+    arr = Array(numpy.zeros(3, numpy.float32))
+    arr.set_device_array(
+        cpu_device.put(numpy.arange(3, dtype=numpy.float32)), cpu_device)
+    arr.prefetch_host()
+    assert arr._state_ == memory._DEVICE_DIRTY
+    arr.map_read()
+    assert arr._state_ == memory._IN_SYNC
+    numpy.testing.assert_array_equal(
+        arr.mem, numpy.arange(3, dtype=numpy.float32))
+
+
+def test_cpu_device_put_owns_its_buffer(cpu_device):
+    """Regression: XLA:CPU device_put adopts aligned host buffers
+    zero-copy without keeping them valid, which made training over
+    recycled gather-window/minibatch buffers nondeterministic.
+    CPUDevice.put must return an XLA-owned array."""
+    buf = numpy.ones((64, 16), numpy.float32)
+    dev = cpu_device.put(buf)
+    buf[:] = 3.0
+    numpy.testing.assert_array_equal(numpy.asarray(dev), 1.0)
+
+
+def test_stage_put_decouples_from_host_buffer(cpu_device):
+    """Regression: XLA:CPU device_put adopts aligned host buffers
+    zero-copy (immutable semantics), so refilling a staging slot
+    silently corrupted the already-'transferred' minibatch.
+    stage_put must return an array decoupled from the host buffer."""
+    arr = Array(numpy.ones((64, 16), numpy.float32))
+    arr.stage_init(2)
+    arr.stage_begin(0)
+    arr.mem[:] = 1.0
+    dev = arr.stage_put(cpu_device)
+    dev.block_until_ready()
+    arr.mem[:] = 3.0  # refill the same slot buffer
+    numpy.testing.assert_array_equal(numpy.asarray(dev), 1.0)
+
+
+# -- per-run workflow stats (print_stats deltas) ---------------------------
+
+
+def _stats_runs(sw, unit_name, **kwargs):
+    buf = io.StringIO()
+    sw.print_stats(out=buf, **kwargs)
+    text = buf.getvalue()
+    match = re.search(r"%s \((\d+) runs\)" % unit_name, text)
+    assert match, text
+    return int(match.group(1)), text
+
+
+def test_print_stats_reports_per_run_deltas(cpu_device):
+    sw = _build_fused(cpu_device, pipeline=False, max_epochs=2)
+    sw.run()
+    first_runs, _ = _stats_runs(sw, "FusedTrainer")
+    total_after_first = sw.fused_trainer.run_calls
+    assert first_runs == total_after_first
+
+    sw.decision.complete <<= False
+    sw.decision.max_epochs = 4
+    sw.run()
+    second_runs, text = _stats_runs(sw, "FusedTrainer")
+    # per-run delta: ONLY the second run's calls, not the accumulation
+    assert second_runs == sw.fused_trainer.run_calls - total_after_first
+    assert "(this run)" in text
+    cumulative_runs, text = _stats_runs(sw, "FusedTrainer",
+                                        cumulative=True)
+    assert cumulative_runs == sw.fused_trainer.run_calls
+    assert "(this run)" not in text
+
+
+def test_print_stats_surfaces_pipeline_stage_timers(cpu_device):
+    sw = _build_fused(cpu_device, pipeline=True, max_epochs=2,
+                      on_device=False)
+    sw.run()
+    buf = io.StringIO()
+    sw.print_stats(out=buf)
+    text = buf.getvalue()
+    assert "pipeline_fill" in text
+    assert "pipeline_h2d" in text
+    assert "depth 1" in text

@@ -318,6 +318,26 @@ class CPUDevice(_JaxDevice):
     PRIORITY = 20
     PLATFORM = "cpu"
 
+    def put(self, array):
+        """XLA:CPU ``device_put`` adopts aligned host buffers ZERO-COPY
+        with immutable semantics, and does NOT keep them valid against
+        later reuse (measured: a post-put write to the numpy buffer
+        changes the jax.Array's contents, and training over recycled
+        gather-window/minibatch buffers was nondeterministic).  Take a
+        device-side copy and block until it has read the source, so the
+        returned array is XLA-owned and the caller may reuse or free
+        its buffer immediately — matching real-transfer backends.
+        (Handing ``device_put`` a TEMPORARY numpy copy instead
+        reproducibly corrupted the process heap — glibc "corrupted
+        double-linked list" — so the source must stay alive, which the
+        caller guarantees for the duration of this call.)"""
+        import jax
+        dev = jax.device_put(array, self.jax_device)
+        if isinstance(array, numpy.ndarray):
+            dev = jax.numpy.copy(dev)
+            dev.block_until_ready()
+        return dev
+
 
 class NumpyDevice(Device):
     """Pure numpy pseudo-device; always available."""

@@ -179,6 +179,12 @@ root.common.update({
         # resolved device is a TPU.  Set VELES_AUTO_FUSE=0 (or the CLI
         # --no-fuse) to keep the per-unit graph for debugging.
         "auto_fuse": os.environ.get("VELES_AUTO_FUSE", "1") != "0",
+        # Async double-buffered input pipeline riding on the fused
+        # step (pipeline_input.Prefetcher): host fill + H2D of
+        # minibatch k+1 overlap step k.  Applies to the auto-fused
+        # path; VELES_PIPELINE_INPUT=0 opts out.
+        "pipeline_input": os.environ.get(
+            "VELES_PIPELINE_INPUT", "1") != "0",
     },
     "trace": {
         "run": False,

@@ -24,6 +24,7 @@ Subclasses implement ``load_data`` / ``create_minibatch_data`` /
 ``fill_minibatch`` exactly as in the reference's ILoader.
 """
 
+import threading
 import time
 from collections import defaultdict
 
@@ -44,6 +45,36 @@ CLASS_NAME = ["test", "validation", "train"]
 
 class LoaderError(Exception):
     pass
+
+
+class ServeShadow(object):
+    """Thread-private view of a loader's public serving fields.
+
+    While an input pipeline worker serves minibatches AHEAD of the unit
+    graph (veles_tpu/pipeline_input.py), the fields downstream units
+    gate on — minibatch class/size/offset, epoch_number, and the four
+    end-of-class Bools — must keep describing the minibatch currently
+    being CONSUMED.  The worker therefore reads and writes this shadow
+    instead (keyed on its thread identity), and the graph thread
+    applies the shadow snapshot captured with each minibatch when that
+    minibatch is popped.  See docs/pipeline_input.md.
+    """
+
+    __slots__ = ("thread", "values")
+
+    #: the public flags routed through the shadow
+    FLAGS = ("last_minibatch", "epoch_ended", "train_ended", "test_ended")
+
+    def __init__(self, loader, thread):
+        self.thread = thread
+        self.values = {
+            "minibatch_class": loader.minibatch_class,
+            "minibatch_size": loader.minibatch_size,
+            "minibatch_offset": loader.minibatch_offset,
+            "epoch_number": loader.epoch_number,
+        }
+        for name in self.FLAGS:
+            self.values[name] = bool(getattr(loader, name))
 
 
 class Loader(Unit):
@@ -98,17 +129,55 @@ class Loader(Unit):
         # the global offset AS OF that job's serve (the loader may have
         # served ahead under async pipelining); None -> live offset.
         self._flags_global_offset_ = None
+        # async input pipeline hookup (veles_tpu/pipeline_input.py):
+        # both transient — a restored loader serves synchronously until
+        # a FusedTrainer re-attaches its Prefetcher at initialize
+        self._serve_shadow_ = None
+        self._pipeline_ = None
 
     # -- pickling: pending -> failed (reference loader/base.py:216-232) ----
 
     def __getstate__(self):
+        pipeline = self._pipeline_
+        if pipeline is not None:
+            # a mid-run snapshot must not observe a half-applied serve
+            # (the worker mutates pending/failed between these reads)
+            with pipeline.quiescent():
+                return self._getstate_quiesced()
+        return self._getstate_quiesced()
+
+    def _getstate_quiesced(self):
         state = super(Loader, self).__getstate__()
         if not self.stopped:
             failed = list(state.get("failed_minibatches", []))
             for pmb in self.pending_minibatches_.values():
-                failed.extend(pmb)
+                # reversed: serve_next_minibatch replays failed jobs
+                # LIFO, so requeueing newest-first preserves the
+                # original serve order on restore (the pipeline can
+                # hold several served-ahead records here)
+                failed.extend(reversed(pmb))
             state["failed_minibatches"] = failed
+        if self._pipeline_ is not None:
+            # pickle serializes the state dict AFTER the quiescent lock
+            # is released, while the pipeline worker keeps serving — an
+            # epoch-wrap shuffle would tear shuffled_indices/prng mid-
+            # serialization, so snapshot the worker-owned mutables NOW
+            import copy
+            state["shuffled_indices"] = copy.deepcopy(
+                self.shuffled_indices)
+            state["prng"] = copy.deepcopy(self.prng)
         return state
+
+    def __setstate__(self, state):
+        # minibatch_class / epoch_number became properties (shadow-aware
+        # serving fields); migrate snapshots written when they were
+        # plain attributes, which would otherwise be shadowed by the
+        # class-level descriptors
+        for legacy, backing in (("minibatch_class", "_minibatch_class"),
+                                ("epoch_number", "_epoch_number")):
+            if legacy in state and backing not in state:
+                state[backing] = state.pop(legacy)
+        super(Loader, self).__setstate__(state)
 
     # -- the ILoader contract ---------------------------------------------
 
@@ -159,22 +228,90 @@ class Loader(Unit):
     def max_minibatch_size(self):
         return self._max_minibatch_size
 
+    # -- serving fields, shadow-aware under async pipelining ----------------
+    #
+    # A pipeline worker thread (pipeline_input.Prefetcher) serves ahead
+    # of the unit graph; its reads/writes of the PUBLIC serving fields
+    # go to its thread-private ServeShadow so the graph thread keeps
+    # seeing the values of the minibatch currently being consumed.
+
+    def _shadow_for_current_thread(self):
+        shadow = self._serve_shadow_
+        if shadow is not None and \
+                threading.current_thread() is shadow.thread:
+            return shadow
+        return None
+
+    def _set_flag(self, name, value):
+        """Write a public Bool flag; a pipeline worker's write lands in
+        its shadow and is applied when its minibatch is consumed."""
+        shadow = self._shadow_for_current_thread()
+        if shadow is not None:
+            shadow.values[name] = bool(value)
+        else:
+            flag = getattr(self, name)
+            flag <<= value
+
     @property
     def minibatch_offset(self):
+        shadow = self._shadow_for_current_thread()
+        if shadow is not None:
+            return shadow.values["minibatch_offset"]
         return self._minibatch_offset_
 
     @minibatch_offset.setter
     def minibatch_offset(self, value):
-        self._minibatch_offset_ = value
+        shadow = self._shadow_for_current_thread()
+        if shadow is not None:
+            shadow.values["minibatch_offset"] = value
+        else:
+            self._minibatch_offset_ = value
         self._update_flags()
 
     @property
     def minibatch_size(self):
+        shadow = self._shadow_for_current_thread()
+        if shadow is not None:
+            return shadow.values["minibatch_size"]
         return self._minibatch_size_
 
     @minibatch_size.setter
     def minibatch_size(self, value):
-        self._minibatch_size_ = value
+        shadow = self._shadow_for_current_thread()
+        if shadow is not None:
+            shadow.values["minibatch_size"] = value
+        else:
+            self._minibatch_size_ = value
+
+    @property
+    def minibatch_class(self):
+        shadow = self._shadow_for_current_thread()
+        if shadow is not None:
+            return shadow.values["minibatch_class"]
+        return self._minibatch_class
+
+    @minibatch_class.setter
+    def minibatch_class(self, value):
+        shadow = self._shadow_for_current_thread()
+        if shadow is not None:
+            shadow.values["minibatch_class"] = value
+        else:
+            self._minibatch_class = value
+
+    @property
+    def epoch_number(self):
+        shadow = self._shadow_for_current_thread()
+        if shadow is not None:
+            return shadow.values["epoch_number"]
+        return self._epoch_number
+
+    @epoch_number.setter
+    def epoch_number(self, value):
+        shadow = self._shadow_for_current_thread()
+        if shadow is not None:
+            shadow.values["epoch_number"] = value
+        else:
+            self._epoch_number = value
 
     @property
     def pending_minibatches_count(self):
@@ -238,9 +375,26 @@ class Loader(Unit):
         return True
 
     def run(self):
+        pipeline = self._pipeline_
+        if pipeline is not None:
+            pipeline.step()
+            return
         self.pending_minibatches_.pop(None, None)
         self.serve_next_minibatch(None)
         self._on_successful_serve()
+
+    def stop(self):
+        pipeline = self._pipeline_
+        if pipeline is not None:
+            pipeline.shutdown()
+        super(Loader, self).stop()
+
+    def on_workflow_finish(self):
+        """End of a run: wind the pipeline worker down (a later run
+        lazily restarts it)."""
+        pipeline = self._pipeline_
+        if pipeline is not None:
+            pipeline.shutdown()
 
     # -- distributed contract (reference loader/base.py:631-687) ------------
 
@@ -468,14 +622,14 @@ class Loader(Unit):
                        (not self.pending_minibatches_count or
                         not self.is_master) and
                        not self.failed_minibatches)
-        self.last_minibatch <<= last_mb
-        self.epoch_ended <<= last_mb and (
+        self._set_flag("last_minibatch", last_mb)
+        self._set_flag("epoch_ended", last_mb and (
             self.minibatch_class == VALID or
             (self.minibatch_class == TEST and
              self.class_lengths[TRAIN] == self.class_lengths[VALID] == 0) or
             (self.minibatch_class == TEST and self.testing) or
             (self.minibatch_class == TRAIN and
-             self.class_lengths[VALID] == 0))
+             self.class_lengths[VALID] == 0)))
 
     def _advance_global_offset(self):
         if self.is_slave:
@@ -487,10 +641,10 @@ class Loader(Unit):
             self.global_offset)
         size = min(remainder, self.max_minibatch_size)
         self.global_offset += size
-        self.train_ended <<= (
-            self.global_offset >= self.effective_total_samples)
-        self.test_ended <<= (
-            self.global_offset >= self.class_end_offsets[TEST])
+        self._set_flag("train_ended",
+                       self.global_offset >= self.effective_total_samples)
+        self._set_flag("test_ended",
+                       self.global_offset >= self.class_end_offsets[TEST])
         return self.global_offset, size
 
     def _on_successful_serve(self):

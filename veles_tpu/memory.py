@@ -82,6 +82,11 @@ class Array(Pickleable):
         self._state_ = _HOST_ONLY
         self._lock_ = threading.RLock()
         self._watched_nbytes_ = 0  # exactly what we told Watcher.add
+        # ping-pong host staging (see stage_init); transient by design:
+        # a restored Array re-stages lazily on the first pipelined serve
+        self._stage_bufs_ = None
+        self._stage_pending_ = None
+        self._stage_slot_ = 0
 
     # -- basic container behaviour ----------------------------------------
 
@@ -95,6 +100,10 @@ class Array(Pickleable):
             self.reset()
             return
         self._mem = numpy.ascontiguousarray(value)
+        # a wholesale buffer swap invalidates the staging slots (their
+        # shape/identity no longer matches); re-staged lazily
+        self._stage_bufs_ = None
+        self._stage_pending_ = None
         if self._device_ is not None:
             self._state_ = _HOST_DIRTY
 
@@ -188,6 +197,82 @@ class Array(Pickleable):
             self._mem = None
             self._devmem_ = None
             self._state_ = _HOST_ONLY
+            self._stage_bufs_ = None
+            self._stage_pending_ = None
+
+    # -- ping-pong host staging (async input pipeline) ----------------------
+    #
+    # Ownership rules (docs/pipeline_input.md): between stage_begin(slot)
+    # and the next stage_begin on the SAME slot, that slot's host buffer
+    # belongs to the producer thread; consumers must read the minibatch
+    # through the device array returned by stage_put / staged_capture,
+    # never through ``mem``.
+
+    @property
+    def staged(self):
+        return self._stage_bufs_ is not None
+
+    def stage_init(self, nslots=2):
+        """Allocate ``nslots`` host staging buffers; slot 0 adopts the
+        existing host buffer, the rest are fresh allocations of the
+        same shape/dtype."""
+        with self._lock_:
+            if self._mem is None:
+                raise ValueError("stage_init() before mem is allocated")
+            self._stage_bufs_ = [self._mem] + [
+                numpy.empty_like(self._mem) for _ in range(nslots - 1)]
+            self._stage_pending_ = [None] * nslots
+            self._stage_slot_ = 0
+
+    def stage_begin(self, slot):
+        """Point ``mem`` at ``slot``'s host buffer for a staged fill
+        (producer thread).  Blocks until the slot's previous async
+        host->device transfer has finished reading the buffer, so an
+        in-flight DMA is never overwritten.  No-op when unstaged."""
+        with self._lock_:
+            if self._stage_bufs_ is None:
+                return
+            pending = self._stage_pending_[slot]
+            self._stage_pending_[slot] = None
+        if pending is not None and hasattr(pending, "block_until_ready"):
+            try:
+                pending.block_until_ready()
+            except Exception:
+                pass  # a deleted/donated buffer cannot be in flight
+        with self._lock_:
+            if self._stage_bufs_ is None:
+                return
+            self._mem = self._stage_bufs_[slot]
+            self._stage_slot_ = slot
+            # the upcoming fill makes the host buffer authoritative; it
+            # also guarantees map_read/map_write cannot replace _mem
+            # with a device fetch mid-fill
+            self._state_ = (_HOST_DIRTY if self._device_ is not None
+                            else _HOST_ONLY)
+
+    def stage_put(self, device):
+        """Start the async host->device transfer of the CURRENT host
+        buffer and return the resulting device array immediately (JAX
+        transfers are asynchronous).  The coherence state is NOT
+        touched: the caller owns the returned array, and the host
+        buffer must not be refilled before ``stage_begin`` is called
+        again on the same slot."""
+        with self._lock_:
+            dev = device.put(self._mem)
+            if self._stage_bufs_ is not None:
+                self._stage_pending_[self._stage_slot_] = dev
+            self._track_device_bytes(self._mem.nbytes)
+            return dev
+
+    def staged_capture(self, device):
+        """Device-side array for the just-served minibatch: the adopted
+        device buffer when a device path already produced one
+        (set_device_array), else an async ``stage_put`` of the staged
+        host fill."""
+        with self._lock_:
+            if self._state_ == _DEVICE_DIRTY and self._devmem_ is not None:
+                return self._devmem_
+        return self.stage_put(device)
 
     # -- coherence protocol ------------------------------------------------
 
@@ -262,12 +347,19 @@ class Array(Pickleable):
         (a whole-workflow snapshot over a tunneled chip measured
         ~1.9 s/pickle from serialized per-array fetches)."""
         with self._lock_:
-            if self._state_ == _DEVICE_DIRTY and hasattr(
-                    self._devmem_, "copy_to_host_async"):
+            if self._state_ != _DEVICE_DIRTY:
+                return
+            if hasattr(self._devmem_, "copy_to_host_async"):
                 try:
                     self._devmem_.copy_to_host_async()
+                    return
                 except Exception:
-                    pass  # best effort: map_read stays correct
+                    pass  # fall through to the eager fetch
+            # backend without async D2H (or a failed async start): fetch
+            # eagerly NOW so the caller's later map_read is still local
+            # instead of silently degrading to N sequential round trips
+            self._mem = numpy.asarray(self._devmem_)
+            self._state_ = _IN_SYNC
 
     # -- pickling ----------------------------------------------------------
 

@@ -34,6 +34,11 @@ class FusedTrainer(Unit):
         self._state = None
         self._dropout_base_key = kwargs.get("dropout_seed", 0)
         self._iteration = 0
+        #: async input pipeline knob (pipeline_input.Prefetcher): serve
+        #: minibatch k+1 (host fill + async H2D) while step k runs
+        self.pipeline = kwargs.get("pipeline", False)
+        self.pipeline_depth = kwargs.get("pipeline_depth", 1)
+        self._prefetcher = None
         # evaluator-compatible surface for DecisionGD / DecisionMSE
         self.n_err = 0
         self.mse_sum = 0.0
@@ -42,6 +47,14 @@ class FusedTrainer(Unit):
 
     def initialize(self, device=None, **kwargs):
         self.device = device
+        if (self.pipeline and self._prefetcher is None
+                and device is not None
+                and getattr(device, "exists", False)
+                and self.sw.workflow_mode == "standalone"):
+            from veles_tpu.pipeline_input import Prefetcher
+            self._prefetcher = Prefetcher(
+                self.sw.loader, device,
+                depth=self.pipeline_depth).attach()
         super(FusedTrainer, self).initialize(**kwargs)
         return True
 
@@ -95,11 +108,20 @@ class FusedTrainer(Unit):
         if self._step_fn is None:
             self._compile()
         loader = self.sw.loader
-        x = loader.minibatch_data.device_array(self.device)
-        if self.loss == "softmax":
-            target = loader.minibatch_labels.device_array(self.device)
+        prefetched = (self._prefetcher.current
+                      if self._prefetcher is not None else None)
+        if prefetched is not None:
+            # pipelined path: the worker already filled + H2D'd this
+            # minibatch one step ahead; its device arrays ARE the input
+            x = prefetched.data
+            target = (prefetched.labels if self.loss == "softmax"
+                      else prefetched.targets)
         else:
-            target = loader.minibatch_targets.device_array(self.device)
+            x = loader.minibatch_data.device_array(self.device)
+            if self.loss == "softmax":
+                target = loader.minibatch_labels.device_array(self.device)
+            else:
+                target = loader.minibatch_targets.device_array(self.device)
         batch_size = numpy.float32(loader.minibatch_size)
 
         if loader.minibatch_class == TRAIN:
@@ -150,6 +172,8 @@ class FusedTrainer(Unit):
         state["_state"] = None
         state["_eval_metrics"] = None
         state["_plans"] = None
+        # re-created (and re-attached to the loader) at initialize
+        state["_prefetcher"] = None
         # concretize lazy device metrics for the pickle
         state["n_err"] = int(self.n_err)
         state["mse_sum"] = float(self.mse_sum)
@@ -158,13 +182,19 @@ class FusedTrainer(Unit):
         return state
 
 
-def fuse_standard_workflow(sw, dropout_seed=0):
+def fuse_standard_workflow(sw, dropout_seed=0, pipeline=False,
+                           pipeline_depth=1):
     """Rewire a StandardWorkflow: loader -> FusedTrainer -> decision.
 
     The forward/GD units stay constructed (they own the param Arrays and
-    the snapshot format) but leave the control graph.
+    the snapshot format) but leave the control graph.  ``pipeline=True``
+    additionally overlaps host fill + H2D of minibatch k+1 with step k
+    (pipeline_input.Prefetcher); it falls back to the synchronous serve
+    on devices without real hardware or in distributed modes.
     """
-    trainer = FusedTrainer(sw, sw, dropout_seed=dropout_seed)
+    trainer = FusedTrainer(sw, sw, dropout_seed=dropout_seed,
+                           pipeline=pipeline,
+                           pipeline_depth=pipeline_depth)
     # detach the old chain from control flow
     for unit in sw.forwards + [sw.evaluator] + sw.gds:
         unit.unlink_all()

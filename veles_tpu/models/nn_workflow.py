@@ -164,7 +164,9 @@ class StandardWorkflow(Workflow):
 
     def fuse(self, **kwargs):
         """Swap the per-unit chain for the single-dispatch fused train
-        step (veles_tpu.models.fused); call before initialize()."""
+        step (veles_tpu.models.fused); call before initialize().
+        ``pipeline=True`` additionally overlaps host fill + H2D of the
+        next minibatch with the running step."""
         from veles_tpu.models.fused import fuse_standard_workflow
         return fuse_standard_workflow(self, **kwargs)
 
@@ -253,5 +255,11 @@ class StandardWorkflow(Workflow):
                 self.info("TPU device: fusing the train loop into one "
                           "dispatch per minibatch (--no-fuse to keep "
                           "the per-unit debug path)")
-                self.fuse()
+                # async input pipeline rides along by default on real
+                # hardware: host fill + H2D of minibatch k+1 overlap
+                # step k (VELES_PIPELINE_INPUT=0 / engine.pipeline_input
+                # opts out; the trainer falls back to the synchronous
+                # serve automatically where pipelining is unsupported)
+                self.fuse(pipeline=root.common.engine.get(
+                    "pipeline_input", True))
         return device

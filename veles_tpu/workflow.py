@@ -56,6 +56,9 @@ class Workflow(Unit):
         self._run_time_ = 0.0
         self._stop_requested_ = False
         self.restored_from_snapshot_ = False
+        # stats as of the CURRENT run's start, so print_stats reports
+        # per-run deltas instead of misattributing earlier runs' time
+        self._stats_baseline_ = None
 
     # -- container behavior ------------------------------------------------
 
@@ -178,6 +181,15 @@ class Workflow(Unit):
             with unit._gate_lock_:
                 for key in unit._links_from:
                     unit._links_from[key] = False
+        # unit/method timers accumulate across runs; snapshot them so
+        # print_stats can report THIS run (timers hold all keys a unit
+        # accumulates, e.g. the input pipeline's per-stage times)
+        self._stats_baseline_ = {
+            "run_time": self._run_time_,
+            "methods": dict(self._method_timers),
+            "units": {id(u): (dict(u.timers), u.run_calls)
+                      for u in self._units if u is not self},
+        }
         start = time.time()
         self.event("run", "begin")
         try:
@@ -199,6 +211,18 @@ class Workflow(Unit):
         return True
 
     def on_workflow_finished(self):
+        # per-unit end-of-run hook (e.g. the input pipeline joins its
+        # prefetch worker so no thread outlives the run)
+        for unit in self._units:
+            if unit is self:
+                continue
+            hook = getattr(unit, "on_workflow_finish", None)
+            if hook is not None:
+                try:
+                    hook()
+                except Exception:
+                    self.exception("on_workflow_finish failed for %s",
+                                   unit)
         self._finished_.set()
         self._stopped <<= True
         launcher = self.launcher
@@ -330,21 +354,57 @@ class Workflow(Unit):
         lines.append("}")
         return "\n".join(lines)
 
-    def print_stats(self, top_number=5, out=None):
+    def print_stats(self, top_number=5, out=None, cumulative=False):
+        """Report where the LAST run's time went (per-run deltas
+        against the snapshot taken at ``run()`` start; pass
+        ``cumulative=True`` for lifetime totals)."""
         out = out or sys.stdout
-        timed = sorted(((u.timers.get("run", 0.0), u)
+        base = None if cumulative else self._stats_baseline_
+
+        def base_unit(unit):
+            if base is None:
+                return {}, 0
+            return base["units"].get(id(unit), ({}, 0))
+
+        def unit_time(unit, key="run"):
+            return unit.timers.get(key, 0.0) - \
+                base_unit(unit)[0].get(key, 0.0)
+
+        timed = sorted(((unit_time(u), u)
                         for u in self._units if u is not self),
                        key=lambda pair: -pair[0])
         total = sum(t for t, _ in timed) or 1e-12
-        out.write("---- Workflow run time: %.3f s ----\n" % self._run_time_)
+        run_time = self._run_time_ - (base["run_time"] if base else 0.0)
+        out.write("---- Workflow run time: %.3f s%s ----\n" % (
+            run_time, "" if cumulative else " (this run)"))
         for elapsed, unit in timed[:top_number]:
             out.write("  %6.2f%%  %8.3f s  %s (%d runs)\n" % (
                 100.0 * elapsed / total, elapsed, unit.name,
-                unit.run_calls))
+                unit.run_calls - base_unit(unit)[1]))
+        for unit in self._units:
+            # extra per-unit timer keys (e.g. the input pipeline's
+            # pipeline_wait / pipeline_fill / pipeline_h2d stages)
+            extra = [(k, unit_time(unit, k))
+                     for k in sorted(unit.timers) if k != "run"]
+            extra = [(k, v) for k, v in extra if v > 0.0]
+            if extra:
+                pipeline = getattr(unit, "_pipeline_", None)
+                depth = ("depth %d, " % pipeline.depth
+                         if pipeline is not None else "")
+                out.write("  %s stage timers (%s):\n    %s\n" % (
+                    unit.name, depth.rstrip(", ") or "per-run",
+                    ", ".join("%s %.3f s" % (k, v)
+                              for k, v in extra)))
         if self._method_timers:
-            out.write("  distributed methods:\n")
-            for name, elapsed in sorted(self._method_timers.items()):
-                out.write("    %8.3f s  %s\n" % (elapsed, name))
+            deltas = sorted(
+                (name, elapsed - (base["methods"].get(name, 0.0)
+                                  if base else 0.0))
+                for name, elapsed in self._method_timers.items())
+            deltas = [(n, e) for n, e in deltas if e > 0.0]
+            if deltas:
+                out.write("  distributed methods:\n")
+                for name, elapsed in deltas:
+                    out.write("    %8.3f s  %s\n" % (elapsed, name))
 
     def gather_results(self):
         """Collect metrics from every IResultProvider-like unit
