@@ -23,27 +23,64 @@ if not os.environ.get("VELES_TEST_TPU"):
 import pytest  # noqa: E402
 
 
+def _open_shm_channels():
+    """Not-yet-closed ShmChannel segments, without importing the module
+    into tests that never touched the network layer."""
+    import sys
+    mod = sys.modules.get("veles_tpu.network_common")
+    if mod is None:
+        return set()
+    return mod.ShmChannel.open_channels()
+
+
 @pytest.fixture(autouse=True)
-def _no_nondaemon_thread_leaks():
-    """Fail any test leaking a live NON-daemon thread: such a thread
-    outlives pytest and hangs CI.  Guards the input-pipeline prefetch
-    worker and every other thread_pool.py user — worker pools must be
-    shut down (joined) by the code under test, not abandoned."""
+def _no_resource_leaks():
+    """Fail any test leaking a live NON-daemon thread (it outlives
+    pytest and hangs CI) or an open ShmChannel shared-memory segment
+    (an abandoned creator-side segment survives as a /dev/shm file
+    past process death).  Guards the input-pipeline prefetch worker,
+    every thread_pool.py user, and the control plane's same-host
+    payload bypass — resources must be released by the code under
+    test, not abandoned."""
     import threading
     import time
 
     before = set(threading.enumerate())
+    shm_before = _open_shm_channels()
     yield
     deadline = time.time() + 3.0
     leaked = []
+    leaked_shm = []
     while time.time() < deadline:
         leaked = [t for t in threading.enumerate()
                   if t not in before and t.is_alive() and not t.daemon]
-        if not leaked:
+        leaked_shm = [c for c in _open_shm_channels()
+                      if c not in shm_before]
+        if not leaked and not leaked_shm:
             return
         time.sleep(0.05)  # give wind-downs in progress a moment
-    pytest.fail("leaked non-daemon thread(s): %s" %
-                ", ".join(sorted(t.name for t in leaked)))
+    problems = []
+    if leaked:
+        problems.append("non-daemon thread(s): %s" %
+                        ", ".join(sorted(t.name for t in leaked)))
+    if leaked_shm:
+        # close them so one leak does not cascade into later tests
+        names = sorted(c.name for c in leaked_shm)
+        for chan in leaked_shm:
+            chan.close()
+        problems.append("ShmChannel segment(s): %s" % ", ".join(names))
+    pytest.fail("leaked " + "; ".join(problems))
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_bleed():
+    """A fault plan left installed by a failing chaos test must never
+    inject faults into unrelated tests."""
+    yield
+    import sys
+    mod = sys.modules.get("veles_tpu.chaos")
+    if mod is not None and mod.plan is not None:
+        mod.uninstall()
 
 
 @pytest.fixture

@@ -214,11 +214,11 @@ class Main(object):
             root.update(config)
         launcher = Launcher()
         if snapshot:
-            from veles_tpu.snapshotter import SnapshotterBase
-            workflow = SnapshotterBase.import_file(snapshot)
-            workflow.workflow = launcher
-            workflow.restored_from_snapshot_ = True
+            from veles_tpu.workflow import restore_workflow
+            workflow = restore_workflow(snapshot, launcher)
         else:
+            # --resume (root.common.snapshot.resume) is honored inside
+            # launcher.initialize via Launcher._maybe_resume
             workflow = workflow_class(launcher, **kwargs)
         launcher.initialize(device=device)
         launcher.run()
@@ -281,14 +281,19 @@ class Main(object):
 
         def load(workflow_class, **kwargs):
             if args.snapshot:
-                from veles_tpu.snapshotter import SnapshotterBase
-                workflow = SnapshotterBase.import_file(args.snapshot)
-                workflow.workflow = launcher
-                workflow.restored_from_snapshot_ = True
-                state["workflow"] = workflow
-                return workflow, True
-            state["workflow"] = workflow_class(launcher, **kwargs)
-            return state["workflow"], False
+                from veles_tpu.workflow import restore_workflow
+                state["workflow"] = restore_workflow(args.snapshot,
+                                                     launcher)
+                return state["workflow"], True
+            workflow_class(launcher, **kwargs)
+            # --resume auto|PATH: one resume implementation — the
+            # launcher's (idempotent: initialize() calling it again is
+            # a no-op); it swaps the restored workflow in for the one
+            # just constructed
+            launcher._maybe_resume()
+            state["workflow"] = launcher.workflow
+            return (state["workflow"],
+                    state["workflow"].restored_from_snapshot_)
 
         def main(**kwargs):
             if args.dump_graph:

@@ -14,6 +14,7 @@ import os
 import random
 import threading
 
+from veles_tpu import chaos
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
@@ -75,6 +76,10 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
         self.jobs_done = 0
         self.reject_reason = None
         self.shm_sends = 0
+        #: successful handshakes over this client's lifetime
+        self.sessions_established = 0
+        self._handshaken = False
+        self._session_progress = False
         self._stopping = False
         self._paused = False
         self._pending_update = None
@@ -134,20 +139,42 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
         self._loop = asyncio.get_running_loop()
         attempts = 0
         while not self._stopping and attempts <= self.reconnect_limit:
+            self._handshaken = False
+            self._session_progress = False
             try:
                 await self._session()
                 return
             except ProtocolError as exc:
-                # authentication failure is not transient: don't retry
-                self.reject_reason = str(exc)
-                self.error("protocol failure: %s", exc)
-                self._stopping = True
-                return
+                if not self._handshaken:
+                    # authentication/handshake failure is not
+                    # transient: don't retry
+                    self.reject_reason = str(exc)
+                    self.error("protocol failure: %s", exc)
+                    self._stopping = True
+                    return
+                # mid-session protocol violation (e.g. a corrupted
+                # frame rejected by the HMAC check): the address and
+                # secret are proven good, treat like a connection loss
+                attempts = 1 if self._session_progress else attempts + 1
+                self.warning("session protocol failure (%s); "
+                             "reconnecting (retry %d/%d)", exc,
+                             attempts, self.reconnect_limit)
             except (ConnectionError, OSError) as exc:
-                attempts += 1
+                # a session that made real progress (handshake + at
+                # least one job) RESETS the budget: it bounds
+                # consecutive unproductive attempts, so a long run
+                # never exhausts a lifetime allowance on unrelated
+                # blips — while a slave that dies on every job (or a
+                # flapping master) still runs out
+                attempts = 1 if self._session_progress else attempts + 1
                 self.warning("connection lost (%s); retry %d/%d", exc,
                              attempts, self.reconnect_limit)
-                await asyncio.sleep(min(0.2 * 2 ** attempts, 5.0))
+            if attempts > self.reconnect_limit:
+                continue  # budget spent: exit now, skip a dead backoff
+            # full jitter on the exponential backoff: simultaneously
+            # orphaned slaves must not stampede a restarted master
+            delay = min(0.2 * 2 ** attempts, 5.0)
+            await asyncio.sleep(delay * (0.5 + random.random() / 2))
         if not self._stopping:
             self.error("giving up after %d reconnect attempts", attempts)
 
@@ -169,6 +196,8 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                 return
             assert msg.get("type") == "handshake_ack"
             self.sid = msg["id"]
+            self._handshaken = True
+            self.sessions_established += 1
             if "shm" in msg:
                 try:
                     self._shm_in = ShmChannel.attach(msg["shm"]["m2s"])
@@ -181,7 +210,11 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             if initial:
                 await self._in_thread(
                     self.workflow.apply_initial_data_from_master, initial)
-            self.info("connected as %s", self.sid[:8])
+            if "epoch" in msg:
+                self.info("connected as %s (admitted at epoch %s)",
+                          self.sid[:8], msg["epoch"])
+            else:
+                self.info("connected as %s", self.sid[:8])
             await self._job_loop(reader, writer)
         finally:
             self._close_shm()
@@ -226,12 +259,22 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                 # client.py:438-442)
                 self.warning("fault injection: dying")
                 raise ConnectionResetError("injected death")
+            if chaos.plan is not None:
+                # deterministic variant: die on exactly the Nth job,
+                # BEFORE running it — the master must requeue it and
+                # this client (re-handshaken) must replay it
+                fault = chaos.plan.fire("client.job")
+                if fault is not None and fault.action == "die":
+                    self.warning("fault injection: dying on job %d",
+                                 self.jobs_done + 1)
+                    raise ConnectionResetError("injected death (chaos)")
             data = unpack_payload(payload, msg.get("codec", "none"))
             if self.async_slave:
                 # pipeline: ask for the next job before running this one
                 self._send(writer, {"type": "job_request"})
             update = await self._run_job(data)
             self.jobs_done += 1
+            self._session_progress = True
             self._send(writer, {
                 "type": "update", "job_id": msg.get("job_id"),
                 "codec": self.codec}, payload=update)
@@ -264,11 +307,12 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                     raw = b""
         else:
             raw = b""
-        write_frame(writer, msg, raw, self.secret)
+        write_frame(writer, msg, raw, self.secret, peer="slave")
 
     async def _recv(self, reader):
         try:
-            msg, payload = await read_frame(reader, self.secret)
+            msg, payload = await read_frame(reader, self.secret,
+                                            peer="slave")
         except asyncio.IncompleteReadError:
             raise ConnectionResetError("EOF from master")
         if self._shm_in is not None and "shm" in msg:

@@ -186,6 +186,14 @@ root.common.update({
         "pipeline_input": os.environ.get(
             "VELES_PIPELINE_INPUT", "1") != "0",
     },
+    "snapshot": {
+        # --resume auto|PATH: restore the validated _current target (or
+        # the given snapshot) before initialize; empty = fresh start
+        "resume": "",
+        # retention: keep only the newest N snapshots (+ best-by-metric
+        # and the _current target); 0 = unlimited, reference parity
+        "keep": 0,
+    },
     "trace": {
         "run": False,
         "event_file": None,

@@ -66,6 +66,13 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "--web-status", default="",
             help="URL of a WebStatusServer to post periodic session "
                  "status to (reference launcher.py:852-885)")
+        parser.add_argument(
+            "--resume", default="", metavar="auto|PATH",
+            help="restore the workflow from a snapshot before "
+                 "initialize: 'auto' resumes from the newest validated "
+                 "_current target in the snapshot directory (fresh "
+                 "start when none exists); a path resumes from that "
+                 "snapshot (with previous-good fallback if corrupt)")
         return parser
 
     @classmethod
@@ -76,6 +83,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "master_address": getattr(args, "master_address", ""),
             "web_status": getattr(args, "web_status", ""),
         })
+        if getattr(args, "resume", ""):
+            root.common.snapshot.update({"resume": args.resume})
 
     # -- workflow ownership (Unit.workflow protocol) -----------------------
 
@@ -131,9 +140,34 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             process_id=int(os.environ.get("VELES_PROCESS_ID", 0)))
         return True
 
+    def _maybe_resume(self):
+        """Honor ``--resume`` / ``root.common.snapshot.resume``: swap
+        the attached workflow for the validated snapshot BEFORE
+        initialize, so slaves reconnecting to a restarted master are
+        re-admitted at the restored epoch.  Slaves never restore (their
+        state arrives from the master); an already-restored workflow
+        (``-w``) is left alone."""
+        from veles_tpu.config import root
+        spec = root.common.snapshot.get("resume") or ""
+        if not spec or self.is_slave or \
+                getattr(self._workflow, "restored_from_snapshot_", False):
+            return
+        from veles_tpu.snapshotter import SnapshotterBase
+        path = SnapshotterBase.resolve_resume(spec)
+        if path is None:
+            self.info("--resume auto: no snapshot found; starting fresh")
+            return
+        self.info("resuming workflow from snapshot %s", path)
+        from veles_tpu.workflow import restore_workflow
+        restored = restore_workflow(path, self)
+        # add_ref re-homed it; make the swap explicit regardless of
+        # launcher add_ref semantics
+        self._workflow = restored
+
     def initialize(self, device=None, **kwargs):
         if self._workflow is None:
             raise RuntimeError("no workflow attached to the launcher")
+        self._maybe_resume()
         self.init_multihost()
         if device is None or isinstance(device, str):
             from veles_tpu.backends import Device

@@ -150,7 +150,18 @@ class Loader(Unit):
         state = super(Loader, self).__getstate__()
         if not self.stopped:
             failed = list(state.get("failed_minibatches", []))
-            for pmb in self.pending_minibatches_.values():
+            for key, pmb in self.pending_minibatches_.items():
+                if key is None and self._pipeline_ is None:
+                    # Standalone SYNC serving retires its single None-
+                    # keyed record only lazily, at the start of the
+                    # NEXT serve — but a snapshot is taken post-
+                    # decision, after the graph has fully consumed the
+                    # minibatch.  Requeueing it would REPLAY a consumed
+                    # minibatch on resume (double-counted samples, a
+                    # spurious epoch-end), so exact resume forbids it.
+                    # The pipeline's None-keyed records are different:
+                    # those are served-ahead and genuinely unconsumed.
+                    continue
                 # reversed: serve_next_minibatch replays failed jobs
                 # LIFO, so requeueing newest-first preserves the
                 # original serve order on restore (the pipeline can

@@ -202,7 +202,20 @@ def fuse_standard_workflow(sw, dropout_seed=0, pipeline=False,
     sw.decision.link_from(trainer)
     # decision reads its metrics from the trainer now
     sw.decision.evaluator = trainer
-    sw.repeater.link_from(sw.decision)
+    snapshotter = getattr(sw, "snapshotter", None)
+    if snapshotter is not None:
+        # the fused step is atomic, so post-decision state is already
+        # quiescent: ride decision -> snapshotter -> repeater (the
+        # per-unit graph hangs it off gds[0] instead, which fuse just
+        # severed); gate unchanged — once per improved epoch
+        snapshotter.unlink_all()
+        snapshotter.link_from(sw.decision)
+        sw.repeater.link_from(snapshotter)
+        sw.end_point.link_from(snapshotter)
+        snapshotter.gate_skip = ~(sw.decision.improved &
+                                  sw.loader.epoch_ended)
+    else:
+        sw.repeater.link_from(sw.decision)
     sw.end_point.link_from(sw.decision)
     sw.end_point.gate_block = ~sw.decision.complete
     sw.fused_trainer = trainer

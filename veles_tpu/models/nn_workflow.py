@@ -131,11 +131,18 @@ class StandardWorkflow(Workflow):
             if prev_gd is None:
                 unit.link_from(self.decision)
                 unit.link_attrs(self.evaluator, "err_output")
-                unit.gate_block = self.decision.complete
             else:
                 unit.link_from(prev_gd)
                 unit.link_attrs(prev_gd, ("err_output", "err_input"))
-            unit.gate_skip = self.decision.gd_skip
+            # completion SKIPS the chain instead of blocking it: the
+            # final cycle must still propagate through gds[0] to the
+            # snapshotter (final improved checkpoint) and on to
+            # end_point.  EVERY gd carries the complete term — if only
+            # the first one did, an epoch ending on a TRAIN minibatch
+            # (no-validation workflows) would skip-propagate the last
+            # gd but RUN the rest against its stale err_input
+            unit.gate_skip = self.decision.gd_skip | \
+                self.decision.complete
             self.gds[i] = unit
             prev_gd = unit
 
@@ -154,8 +161,23 @@ class StandardWorkflow(Workflow):
             from veles_tpu.snapshotter import Snapshotter
             self.snapshotter = Snapshotter(
                 self, prefix=type(self).__name__)
-            self.snapshotter.link_from(self.decision)
-            self.snapshotter.gate_skip = ~self.decision.improved
+            # The snapshotter runs at the QUIESCENT point of the
+            # minibatch cycle — after the last gd applied its update,
+            # before the repeater serves the next minibatch — so every
+            # snapshot is an exact resume point (weights, loader
+            # offsets, prng, decision accumulators all consistent).
+            # Linking it from the decision instead would pickle TORN
+            # state: the worklist interleaves it with the gd chain, so
+            # some layers would carry the current minibatch's update
+            # and some would not.
+            self.snapshotter.link_from(self.gds[0])
+            self.repeater.unlink_from(self.gds[0])
+            self.repeater.link_from(self.snapshotter)
+            # fire once per improved epoch: improved alone stays True
+            # through the whole following epoch (it resets only at the
+            # next judge-class end), which would export every minibatch
+            self.snapshotter.gate_skip = ~(self.decision.improved &
+                                           self.loader.epoch_ended)
             # the exit gate also waits on the snapshotter (reference
             # topology decision -> snapshotter -> end): otherwise the
             # worklist is abandoned at end_point before a queued
@@ -212,7 +234,9 @@ class StandardWorkflow(Workflow):
         if self.workflow_mode == "slave":
             # one job = one pass: a slave must not loop the repeater; the
             # drained worklist ends the pass (master drives iteration)
-            self.repeater.unlink_from(self.gds[0])
+            self.repeater.unlink_from(
+                self.gds[0] if self.snapshotter is None
+                else self.snapshotter)
         elif self.workflow_mode == "standalone":
             # standalone ONLY: in distributed runs master and slaves
             # exchange unit state by zipping their unit lists

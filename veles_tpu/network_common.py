@@ -27,6 +27,7 @@ authenticated with HMAC-SHA256 and unauthenticated frames are rejected
 *before* any unpickling.  Multi-host deployments must set a secret.
 """
 
+import asyncio
 import bz2
 import gzip
 import hashlib
@@ -36,7 +37,11 @@ import lzma
 import os
 import pickle
 import struct
+import threading
+import time
 import uuid
+
+from veles_tpu import chaos
 
 try:  # optional, reference codec parity (txzmq/connection.py:140)
     import snappy as _snappy
@@ -98,20 +103,70 @@ def unpack_payload(raw, codec="none"):
     return pickle.loads(decompress(raw))
 
 
-def write_frame(writer, msg, payload=b"", secret=None):
+def _flip_byte(blob):
+    """Invert one byte (chaos 'corrupt' action).  The MAC/manifest was
+    computed over the clean bytes, so verification catches this."""
+    buf = bytearray(blob)
+    buf[len(buf) // 2] ^= 0xFF
+    return bytes(buf)
+
+
+def _fire_net_fault(point, peer):
+    """Chaos lookup for a frame op: the generic point first, then the
+    peer-scoped one (``net.recv:slave``) — peer scoping keeps the Nth-
+    hit triggers deterministic when master and slave share one
+    in-process plan."""
+    fault = chaos.plan.fire(point)
+    if fault is None and peer:
+        fault = chaos.plan.fire("%s:%s" % (point, peer))
+    return fault
+
+
+def write_frame(writer, msg, payload=b"", secret=None, peer=None):
     """Serialize one frame onto an asyncio StreamWriter."""
     header = json.dumps(msg).encode()
     mac = (hmac.new(secret, header + payload, hashlib.sha256).digest()
            if secret else b"")
-    writer.write(_FRAME.pack(len(header), len(payload), len(mac)) +
-                 header + payload + mac)
+    frame = _FRAME.pack(len(header), len(payload), len(mac)) + \
+        header + payload + mac
+    if chaos.plan is not None:
+        fault = _fire_net_fault("net.send", peer)
+        if fault is not None:
+            frame = _apply_send_fault(fault, frame, writer)
+            if frame is None:
+                return
+    writer.write(frame)
 
 
-async def read_frame(reader, secret=None):
+def _apply_send_fault(fault, frame, writer):
+    """Wire-level faults on an outgoing frame (chaos 'net.send')."""
+    if fault.action == "drop":
+        return None
+    if fault.action == "delay":
+        # deliberately BLOCKS the sender's event loop: net.send=delay
+        # models a stalled sender process (GC pause, CPU starvation),
+        # which freezes everything that peer multiplexes.  Per-frame
+        # network latency belongs on net.recv, whose delay awaits.
+        time.sleep(fault.param or 0.05)
+        return frame
+    if fault.action == "truncate":
+        # partial frame then close: the peer's readexactly raises
+        # IncompleteReadError -> clean connection-loss recovery path
+        writer.write(frame[:max(1, len(frame) * 2 // 3)])
+        writer.close()
+        return None
+    if fault.action == "corrupt":
+        return _flip_byte(frame)
+    return frame
+
+
+async def read_frame(reader, secret=None, peer=None):
     """Read one frame -> (msg dict, payload bytes).
 
     When ``secret`` is set the MAC is verified before the header is
-    even parsed; a missing or wrong MAC raises ProtocolError.
+    even parsed; a missing or wrong MAC raises ProtocolError.  With a
+    shared secret this also rejects chaos-corrupted frames BEFORE any
+    unpickling; without one, only header corruption is caught here.
     """
     prefix = await reader.readexactly(_FRAME.size)
     hlen, plen, mlen = _FRAME.unpack(prefix)
@@ -121,11 +176,26 @@ async def read_frame(reader, secret=None):
     header = await reader.readexactly(hlen)
     payload = await reader.readexactly(plen) if plen else b""
     mac = await reader.readexactly(mlen) if mlen else b""
+    if chaos.plan is not None:
+        fault = _fire_net_fault("net.recv", peer)
+        if fault is not None:
+            if fault.action == "delay":
+                await asyncio.sleep(fault.param or 0.05)
+            elif fault.action == "corrupt":
+                if payload:
+                    payload = _flip_byte(payload)
+                else:
+                    header = _flip_byte(header)
     if secret is not None:
         want = hmac.new(secret, header + payload, hashlib.sha256).digest()
         if not hmac.compare_digest(want, mac):
             raise ProtocolError("frame authentication failed")
-    return json.loads(header.decode()), payload
+    try:
+        return json.loads(header.decode()), payload
+    except (UnicodeDecodeError, ValueError) as exc:
+        # a mangled header is a protocol violation, not a crash: the
+        # caller's ProtocolError handling (drop + reconnect) applies
+        raise ProtocolError("malformed frame header (%s)" % exc)
 
 
 def parse_address(address, default_host="127.0.0.1"):
@@ -167,6 +237,13 @@ class ShmChannel(object):
 
     #: names created by THIS process (attach must not unregister them)
     _local_creations = set()
+    #: every not-yet-closed channel in this process — the test suite's
+    #: leak detector fails any test that abandons a segment (an
+    #: unlinked-but-open segment holds memory; an un-unlinked created
+    #: one leaks a /dev/shm file past process death).  Channels open
+    #: and close on daemon network threads, so the registry is locked.
+    _open_channels = set()
+    _open_lock = threading.Lock()
 
     def __init__(self, shm, created):
         self._shm = shm
@@ -176,6 +253,14 @@ class ShmChannel(object):
         self.slot_size = shm.size // 2
         if created:
             ShmChannel._local_creations.add(shm.name)
+        with ShmChannel._open_lock:
+            ShmChannel._open_channels.add(self)
+
+    @classmethod
+    def open_channels(cls):
+        """Race-free snapshot of the not-yet-closed channels."""
+        with cls._open_lock:
+            return set(cls._open_channels)
 
     @classmethod
     def create(cls, size):
@@ -218,6 +303,8 @@ class ShmChannel(object):
         return bytes(self._shm.buf[offset:offset + length])
 
     def close(self):
+        with ShmChannel._open_lock:
+            ShmChannel._open_channels.discard(self)
         try:
             self._shm.close()
             if self._created:

@@ -44,6 +44,7 @@ import queue
 import threading
 import time
 
+from veles_tpu import chaos
 from veles_tpu.loader.base import ServeShadow
 from veles_tpu.logger import Logger
 
@@ -171,6 +172,15 @@ class Prefetcher(Logger):
         ahead.  Called in place of the synchronous ``Loader.run``."""
         if self._pool is None:
             self._start()
+        pool = self._pool
+        if pool is not None and pool.failure is not None:
+            # fail FAST on a worker serve failure: the pool keeps
+            # processing queued serves, so waiting for starvation
+            # (the _take path) could let a bad serve's neighbors feed
+            # the graph for many more steps before anyone notices
+            failure = pool.failure
+            self.shutdown()
+            raise failure[1].with_traceback(failure[2])
         while self._inflight < self.depth + 1 and not self._shutdown:
             self._submit()
         item = self._take()
@@ -243,6 +253,14 @@ class Prefetcher(Logger):
             self._serve_one_locked(serial, slot)
 
     def _serve_one_locked(self, serial, slot):
+        if chaos.plan is not None:
+            fault = chaos.plan.fire("pipeline.serve")
+            if fault is not None and fault.action == "exc":
+                # a worker-thread serve failure must surface on the
+                # graph thread (Prefetcher._take's pool-failure path),
+                # not hang the run or leak the worker
+                raise RuntimeError(
+                    "chaos: injected serve failure (serial %d)" % serial)
         loader = self.loader
         shadow = loader._serve_shadow_
         if shadow is None or shadow.thread is not threading.current_thread():

@@ -24,6 +24,7 @@ import threading
 import time
 from collections import deque
 
+from veles_tpu import chaos
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
@@ -211,7 +212,14 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             return
         self.port = self._server.sockets[0].getsockname()[1]
         self._listening.set()
-        self.info("master listening on %s:%d", self.host, self.port)
+        if getattr(self.workflow, "restored_from_snapshot_", False):
+            self.info(
+                "master listening on %s:%d (restored from snapshot; "
+                "re-admitting slaves at epoch %s)", self.host, self.port,
+                getattr(getattr(self.workflow, "loader", None),
+                        "epoch_number", "?"))
+        else:
+            self.info("master listening on %s:%d", self.host, self.port)
         watchdog = asyncio.ensure_future(self._watchdog())
         try:
             await self._stop_event.wait()
@@ -229,7 +237,8 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         conn = None
         try:
             while True:
-                msg, payload = await read_frame(reader, self.secret)
+                msg, payload = await read_frame(reader, self.secret,
+                                                peer="master")
                 if conn is not None and conn.shm_in is not None \
                         and "shm" in msg:
                     off, length = msg["shm"]
@@ -284,6 +293,12 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                                  msg.get("power", 1.0))
         conn = _SlaveConn(slave, reader, writer)
         ack = {"type": "handshake_ack", "id": sid}
+        epoch = getattr(getattr(self.workflow, "loader", None),
+                        "epoch_number", None)
+        if epoch is not None:
+            # a slave (re)joining a restarted master learns the epoch
+            # it is being admitted at — resume observability
+            ack["epoch"] = int(epoch)
         if self.use_shm and msg.get("machine") == machine_id():
             # same host: payloads ride shared memory, not the socket
             # (reference SharedIO engagement, server.py:144-167)
@@ -324,6 +339,20 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             self._waiting.append(conn)
             self._send(conn.writer, {"type": "wait"})
             return
+        if chaos.plan is not None:
+            fault = chaos.plan.fire("server.serve")
+            if fault is not None:
+                if fault.action == "kill":
+                    # mid-batch conn death: the minibatch is already
+                    # reserved to this slave, so the drop path MUST
+                    # requeue it (watchdog/drop_slave contract)
+                    self.warning("fault injection: killing conn of "
+                                 "slave %s mid-batch",
+                                 conn.slave.id[:8])
+                    conn.writer.close()
+                    return
+                if fault.action == "stall":
+                    await asyncio.sleep(fault.param or 0.5)
         job_id = new_id()
         conn.jobs_out[job_id] = time.time()
         self.jobs_dispatched += 1
@@ -443,7 +472,7 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                     raw = b""
         else:
             raw = b""
-        write_frame(writer, msg, raw, self.secret)
+        write_frame(writer, msg, raw, self.secret, peer="master")
 
     async def _in_thread(self, fn, *args):
         return await self._loop.run_in_executor(None, fn, *args)

@@ -7,6 +7,18 @@ Bool, compression codecs (none/gz/bz2/xz + snappy when available), the
 size warning with a per-unit pickle-size top-5, and destruction of
 pending state so restored runs are consistent.
 
+Crash consistency (docs/checkpointing.md): every snapshot is written to
+``<dest>.tmp``, fsynced, ``os.replace``d into place, and the directory
+fsynced, so a ``kill -9`` at any instant leaves either the complete new
+file or no new file — never a torn one at the final path.  A sidecar
+manifest (``<dest>.manifest``, JSON: sha256, nbytes, codec, epoch,
+workflow checksum/metric) makes every snapshot verifiable;
+:meth:`import_file` checks it before unpickling and falls back to the
+newest previous-good snapshot when the preferred one is truncated or
+corrupt.  ``keep=N`` bounds the on-disk history (the best-by-metric and
+the ``_current`` target always survive); the default keeps everything,
+reference parity.
+
 TPU note: device arrays snapshot through ``Array.__getstate__`` which
 performs ``map_read`` (device->host) first, so a snapshot taken mid-run
 is a complete host-side image; restore re-uploads lazily at first unmap,
@@ -14,17 +26,34 @@ resharding onto whatever mesh the restoring process has.
 """
 
 import bz2
+import glob
 import gzip
+import hashlib
+import json
+import logging
 import lzma
 import os
 import pickle
 import time
 
+from veles_tpu import chaos
 from veles_tpu.config import root
 from veles_tpu.mutable import Bool
 from veles_tpu.units import Unit
 
-__all__ = ["SnapshotterBase", "Snapshotter"]
+__all__ = ["SnapshotterBase", "Snapshotter", "SnapshotError",
+           "MANIFEST_SUFFIX"]
+
+#: sidecar manifest filename suffix (next to the snapshot it describes)
+MANIFEST_SUFFIX = ".manifest"
+
+#: module-level logger for the static restore/verify paths
+_log = logging.getLogger("Snapshotter")
+
+
+class SnapshotError(Exception):
+    """No usable snapshot could be restored."""
+
 
 CODECS = {
     "": (lambda path: open(path, "wb"), lambda path: open(path, "rb")),
@@ -46,6 +75,9 @@ try:  # snappy framing, reference parity (snapshotter.py:249-356)
 
         def write(self, data):
             self._file.write(self._compressor.compress(data))
+
+        def flush(self):
+            self._file.flush()
 
         def close(self):
             self._file.close()
@@ -94,6 +126,43 @@ except ImportError:
 SIZE_WARNING = 1 << 30
 
 
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    """Durably record a rename/creation in its directory; best-effort
+    (some filesystems refuse O_RDONLY directory fsync)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _file_sha256(path):
+    digest = hashlib.sha256()
+    with open(path, "rb") as fin:
+        for block in iter(lambda: fin.read(1 << 20), b""):
+            digest.update(block)
+    return digest.hexdigest()
+
+
+def _manifest_path(path):
+    """Manifest sidecar for a snapshot; symlinks (``_current``) resolve
+    to their target first, since the sidecar sits next to the data."""
+    return os.path.realpath(path) + MANIFEST_SUFFIX
+
+
 class SnapshotterBase(Unit):
     """Common logic: gating, naming, codec selection, restore."""
 
@@ -120,6 +189,11 @@ class SnapshotterBase(Unit):
             "--snapshot-db", default=None,
             help="sqlite file recording snapshot history (the "
                  "reference's ODBC sink analog)")
+        parser.add_argument(
+            "--snapshot-keep", type=int, default=None, metavar="N",
+            help="retain only the newest N snapshots (plus the "
+                 "best-by-metric and the _current target); 0 keeps "
+                 "everything")
         return parser
 
     @classmethod
@@ -135,6 +209,8 @@ class SnapshotterBase(Unit):
             cfg["compression"] = args.snapshot_compress
         if getattr(args, "snapshot_db", None):
             cfg["db"] = args.snapshot_db
+        if getattr(args, "snapshot_keep", None) is not None:
+            cfg["keep"] = args.snapshot_keep
         root.common.snapshot.update(cfg)
         if getattr(args, "disable_snapshotting", False):
             root.common.disable.update({"snapshotting": True})
@@ -151,11 +227,16 @@ class SnapshotterBase(Unit):
         self.time_interval = kwargs.pop(
             "time_interval", cfg.get("time_interval", 15))
         self._db_path = kwargs.pop("db_path", cfg.get("db"))
+        # retention: 0/None = unlimited (reference parity); the
+        # best-by-metric snapshot and the _current target always survive
+        self.keep = kwargs.pop("keep", cfg.get("keep", 0))
+        self.keep_best = kwargs.pop("keep_best", True)
         super(SnapshotterBase, self).__init__(workflow, **kwargs)
         self.skip = Bool(False)
         self.suffix = None
         self.destination = None
         self._counter = 0
+        self._exports = 0
         self._last_time = 0.0
 
     def initialize(self, **kwargs):
@@ -186,18 +267,33 @@ class SnapshotterBase(Unit):
     def export(self):  # pragma: no cover - overridden
         raise NotImplementedError
 
-    def _record_in_db(self, destination, nbytes):
-        """Append a row to the snapshot database (the reference's ODBC
-        sink, snapshotter.py:428-518; sqlite here).  Enabled via
-        ``db_path=`` kwarg or root.common.snapshot.db."""
-        db_path = self._db_path
-        if not db_path:
-            return
-        import sqlite3
+    def _workflow_epoch_metric(self):
         decision = getattr(self.workflow, "decision", None)
         metric = getattr(decision, "best_metric", None)
         epoch = getattr(decision, "epoch_number", None)
-        with sqlite3.connect(db_path) as conn:
+        return (epoch, float(metric) if metric is not None else None)
+
+    def _record_in_db(self, destination, nbytes):
+        """Append a row to the snapshot database (the reference's ODBC
+        sink, snapshotter.py:428-518; sqlite here).  Enabled via
+        ``db_path=`` kwarg or root.common.snapshot.db.  A DB failure
+        (locked/readonly sqlite) only warns: the snapshot itself is
+        already safe on disk and must not abort the training step."""
+        db_path = self._db_path
+        if not db_path:
+            return
+        try:
+            self._record_in_db_unchecked(destination, nbytes)
+        except Exception as exc:
+            self.warning(
+                "snapshot db record failed (%s: %s); continuing — the "
+                "snapshot itself is safe at %s",
+                type(exc).__name__, exc, destination)
+
+    def _record_in_db_unchecked(self, destination, nbytes):
+        import sqlite3
+        epoch, metric = self._workflow_epoch_metric()
+        with sqlite3.connect(self._db_path) as conn:
             conn.execute(
                 "CREATE TABLE IF NOT EXISTS snapshots ("
                 "  id INTEGER PRIMARY KEY AUTOINCREMENT,"
@@ -212,11 +308,15 @@ class SnapshotterBase(Unit):
                 (time.strftime("%Y-%m-%d %H:%M:%S"), self.prefix,
                  type(self.workflow).__name__,
                  getattr(self.workflow, "checksum", None),
-                 destination, nbytes, epoch,
-                 float(metric) if metric is not None else None))
+                 destination, nbytes, epoch, metric))
 
     def _destination(self):
-        suffix = self.suffix or time.strftime("%Y%m%d_%H%M%S")
+        # the export ordinal disambiguates same-second exports: a
+        # second-resolution timestamp alone silently OVERWRITES the
+        # previous snapshot (destroying the previous-good fallback)
+        self._exports += 1
+        suffix = self.suffix or "%s.%03d" % (
+            time.strftime("%Y%m%d_%H%M%S"), self._exports)
         ext = (".%s" % self.compression) if self.compression else ""
         return os.path.join(
             self.directory,
@@ -235,16 +335,111 @@ class SnapshotterBase(Unit):
                 pass
             os.symlink(os.path.basename(self.destination), temp)
             os.replace(temp, link)
-        except OSError:
-            pass
+            _fsync_dir(self.directory)
+        except OSError as exc:
+            # a failed flip means _current (the canonical resume
+            # target) silently stops tracking the newest snapshot —
+            # that must never be invisible
+            self.warning(
+                "failed to update snapshot link %s -> %s (%s); resume "
+                "will use an OLDER snapshot", link,
+                os.path.basename(self.destination), exc)
+
+    # -- verification / restore --------------------------------------------
 
     @staticmethod
-    def import_file(path):
-        """Restore a workflow object from a snapshot file.
+    def write_manifest(destination, workflow_name=None, checksum=None,
+                       codec=None, epoch=None, best_metric=None):
+        """Write the sidecar manifest for a finished snapshot file,
+        atomically (tmp -> fsync -> replace -> dir fsync)."""
+        manifest = {
+            "version": 1,
+            "sha256": _file_sha256(destination),
+            "nbytes": os.path.getsize(destination),
+            "codec": codec or "",
+            "workflow": workflow_name,
+            "checksum": checksum,
+            "epoch": epoch,
+            "best_metric": best_metric,
+            "timestamp": time.strftime("%Y-%m-%d %H:%M:%S"),
+        }
+        mpath = destination + MANIFEST_SUFFIX
+        tmp = mpath + ".tmp"
+        with open(tmp, "wb") as fout:
+            fout.write(json.dumps(manifest, indent=1,
+                                  sort_keys=True).encode())
+            fout.flush()
+            os.fsync(fout.fileno())
+        os.replace(tmp, mpath)
+        _fsync_dir(os.path.dirname(mpath) or ".")
+        return manifest
 
-        The codec is sniffed from the file's magic bytes, not the
-        extension — the ``_current`` symlink (the natural -w target)
-        carries no extension."""
+    @staticmethod
+    def read_manifest(path):
+        """The manifest dict for a snapshot path, or None when absent
+        or unparseable."""
+        try:
+            with open(_manifest_path(path), "rb") as fin:
+                manifest = json.loads(fin.read().decode())
+        except (OSError, ValueError):
+            return None
+        return manifest if isinstance(manifest, dict) else None
+
+    @staticmethod
+    def verify_snapshot(path):
+        """Check a snapshot against its manifest.
+
+        Returns ``(True, manifest)`` when it verifies, ``(None,
+        reason)`` when there is no manifest to check against (legacy
+        snapshot — restorable but unverifiable), and ``(False,
+        reason)`` on truncation or checksum mismatch."""
+        real = os.path.realpath(path)
+        if not os.path.isfile(real):
+            return False, "missing file %s" % real
+        manifest = SnapshotterBase.read_manifest(real)
+        if manifest is None:
+            return None, "no manifest"
+        nbytes = os.path.getsize(real)
+        if nbytes != manifest.get("nbytes"):
+            return False, "size mismatch (%d on disk, %s in manifest)" \
+                % (nbytes, manifest.get("nbytes"))
+        digest = _file_sha256(real)
+        if digest != manifest.get("sha256"):
+            return False, "sha256 mismatch"
+        return True, manifest
+
+    @staticmethod
+    def _iter_verified_snapshots(directory, exclude=()):
+        """Manifest-verified snapshots in ``directory``, newest first.
+
+        Candidates are ordered by a cheap mtime stat and HASHED LAZILY,
+        so a fallback restore only pays sha256 for the snapshots it
+        actually tries, not the whole retained history."""
+        exclude = {os.path.realpath(p) for p in exclude}
+        found = []
+        for mpath in glob.glob(os.path.join(directory,
+                                            "*" + MANIFEST_SUFFIX)):
+            snap = mpath[:-len(MANIFEST_SUFFIX)]
+            if os.path.realpath(snap) in exclude:
+                continue
+            try:
+                found.append((os.path.getmtime(snap), snap))
+            except OSError:
+                continue
+        for _, snap in sorted(found, reverse=True):
+            if SnapshotterBase.verify_snapshot(snap)[0]:
+                yield snap
+
+    @staticmethod
+    def _verified_snapshots(directory, exclude=()):
+        return list(SnapshotterBase._iter_verified_snapshots(
+            directory, exclude=exclude))
+
+    @staticmethod
+    def _load_pickle(path):
+        """Unpickle one snapshot file.  The codec is sniffed from the
+        file's magic bytes, not the extension — the ``_current``
+        symlink (the natural -w target) carries no extension."""
         with open(path, "rb") as probe:
             magic = probe.read(10)
         if magic[:2] == b"\x1f\x8b":
@@ -265,25 +460,227 @@ class SnapshotterBase(Unit):
         with opener(path) as fin:
             return pickle.load(fin)
 
+    @staticmethod
+    def import_file(path, fallback=True):
+        """Restore a workflow object from a snapshot file.
+
+        The sidecar manifest, when present, is verified (size + sha256)
+        BEFORE unpickling.  A snapshot that fails verification or fails
+        to load falls back to the newest previous-good (manifest-
+        verified) snapshot in the same directory, so a torn write or a
+        corrupted ``_current`` target never strands a resume; pass
+        ``fallback=False`` to fail fast instead."""
+        real = os.path.realpath(path)
+        want = SnapshotterBase.read_manifest(real)
+
+        def same_workflow(candidate):
+            # NEVER fall back across workflows: a shared snapshot
+            # directory (the out-of-the-box default) may hold several
+            # models' histories.  Prefer the manifest identity; with no
+            # primary manifest, require a shared filename prefix.
+            if want is not None:
+                manifest = SnapshotterBase.read_manifest(candidate)
+                if manifest is None or \
+                        manifest.get("workflow") != want.get("workflow"):
+                    return False
+                if manifest.get("checksum") != want.get("checksum"):
+                    _log.warning(
+                        "fallback snapshot %s was written by a "
+                        "different source revision of %s", candidate,
+                        want.get("workflow"))
+                return True
+            return os.path.basename(candidate).split("_")[0] == \
+                os.path.basename(real).split("_")[0]
+
+        def candidates():
+            yield real, False
+            if fallback:  # evaluated only once the primary has failed
+                for prev in SnapshotterBase._iter_verified_snapshots(
+                        os.path.dirname(real) or ".", exclude=(real,)):
+                    if same_workflow(prev):
+                        yield prev, True  # just verified — don't re-hash
+
+        tried = 0
+        errors = []
+        for candidate, verified in candidates():
+            tried += 1
+            if not verified:
+                ok, detail = SnapshotterBase.verify_snapshot(candidate)
+                if ok is False:
+                    _log.warning("snapshot %s failed verification: %s",
+                                 candidate, detail)
+                    errors.append("%s: %s" % (candidate, detail))
+                    continue
+                if ok is None:
+                    _log.debug("snapshot %s has no manifest; restoring "
+                               "unverified (legacy)", candidate)
+            try:
+                restored = SnapshotterBase._load_pickle(candidate)
+            except Exception as exc:
+                _log.warning("snapshot %s failed to load (%s: %s)",
+                             candidate, type(exc).__name__, exc)
+                errors.append("%s: %s" % (candidate, exc))
+                continue
+            if candidate != real:
+                _log.warning(
+                    "restored previous-good snapshot %s (%s was "
+                    "invalid)", candidate, path)
+            return restored
+        raise SnapshotError(
+            "no usable snapshot for %s (tried %d candidate(s): %s)" %
+            (path, tried, "; ".join(errors) or "none found"))
+
+    @staticmethod
+    def resolve_resume(spec, directory=None):
+        """Resolve a ``--resume`` spec to a snapshot path, or None.
+
+        ``auto`` picks the newest ``*_current`` target under the
+        snapshot directory (``root.common.snapshot.dir`` falling back
+        to ``root.common.dirs.snapshots``), then the newest manifest-
+        verified snapshot; None means "nothing to resume — start
+        fresh".  Any other spec is an explicit path (which must
+        exist).  Validation and previous-good fallback happen at
+        :meth:`import_file` time."""
+        if not spec:
+            return None
+        if spec != "auto":
+            if not os.path.exists(spec):
+                raise SnapshotError("--resume %s: no such snapshot" %
+                                    spec)
+            return spec
+        if directory is None:
+            cfg = root.common.snapshot
+            directory = cfg.get("dir") or root.common.dirs.get(
+                "snapshots", "/tmp")
+        if not os.path.isdir(directory):
+            return None
+        targets = []
+        for link in glob.glob(os.path.join(directory, "*_current")):
+            target = os.path.realpath(link)
+            if os.path.isfile(target):
+                targets.append((os.path.getmtime(target), target))
+            else:
+                _log.warning("broken snapshot link %s -> %s", link,
+                             target)
+        if targets:
+            return sorted(targets, reverse=True)[0][1]
+        verified = SnapshotterBase._verified_snapshots(directory)
+        return verified[0] if verified else None
+
 
 class Snapshotter(SnapshotterBase):
     """Pickles the whole workflow through the selected codec."""
 
     def export(self):
-        self.destination = self._destination()
-        writer, _ = CODECS.get(self.compression, CODECS[""])
+        destination = self._destination()
         start = time.time()
         self._prefetch_device_arrays()
         payload = pickle.dumps(self.workflow,
                                protocol=pickle.HIGHEST_PROTOCOL)
         if len(payload) > SIZE_WARNING:
             self.check_snapshot_size()
-        with writer(self.destination) as fout:
-            fout.write(payload)
+        try:
+            self._write_atomic(destination, payload)
+        except OSError as exc:
+            # Disk trouble (ENOSPC and friends) must not kill a
+            # training run: the previous snapshot and _current are
+            # untouched, so recovery capability degrades but survives.
+            self.error(
+                "snapshot write to %s failed (%s); previous snapshot "
+                "kept, training continues", destination, exc)
+            self._remove_quiet(destination + ".tmp")
+            return
+        self.destination = destination
+        epoch, metric = self._workflow_epoch_metric()
+        try:
+            self.write_manifest(
+                destination, workflow_name=type(self.workflow).__name__,
+                checksum=getattr(self.workflow, "checksum", None),
+                codec=self.compression, epoch=epoch, best_metric=metric)
+        except OSError as exc:
+            self.warning("manifest write for %s failed (%s); snapshot "
+                         "restorable but unverifiable", destination, exc)
         self._update_current_link()
-        self._record_in_db(self.destination, len(payload))
-        self.info("snapshot -> %s (%.1f MB, %.2f s)", self.destination,
+        self._record_in_db(destination, len(payload))
+        self._apply_retention()
+        self.info("snapshot -> %s (%.1f MB, %.2f s)", destination,
                   len(payload) / 1e6, time.time() - start)
+
+    def _write_atomic(self, destination, payload):
+        """tmp -> fsync -> os.replace -> directory fsync.  A crash at
+        any instant leaves either the complete new snapshot or only a
+        ``.tmp`` residue — the final path is never torn, so ``_current``
+        can never point at a half-written file."""
+        tmp = destination + ".tmp"
+        writer, _ = CODECS.get(self.compression, CODECS[""])
+        with writer(tmp) as fout:
+            if chaos.plan is not None:
+                self._chaos_write(fout, payload)
+            fout.write(payload)
+        _fsync_file(tmp)
+        os.replace(tmp, destination)
+        _fsync_dir(self.directory)
+
+    def _chaos_write(self, fout, payload):
+        fault = chaos.plan.fire("snapshot.write")
+        if fault is None:
+            return
+        if fault.action == "crash":
+            # half the payload lands in the .tmp file, then the
+            # "process dies": os.replace never runs
+            fout.write(payload[:max(1, len(payload) // 2)])
+            flush = getattr(fout, "flush", None)
+            if flush is not None:
+                flush()
+            raise chaos.ChaosCrash("simulated crash mid-snapshot-write")
+        if fault.action == "enospc":
+            raise chaos.enospc()
+
+    @staticmethod
+    def _remove_quiet(path):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def _apply_retention(self):
+        """Prune old snapshots beyond ``keep``; the best-by-metric
+        (lower is better, the decision's convention) and the _current
+        target always survive."""
+        keep = int(self.keep or 0)
+        if keep <= 0:
+            return
+        snaps = []
+        for path in glob.glob(os.path.join(self.directory,
+                                           self.prefix + "_*")):
+            name = os.path.basename(path)
+            if os.path.islink(path) or name.endswith(MANIFEST_SUFFIX) \
+                    or name.endswith(".tmp"):
+                continue
+            if ".pickle" not in name:
+                continue
+            snaps.append((os.path.getmtime(path), path))
+        snaps.sort(reverse=True)
+        survivors = {os.path.realpath(p) for _, p in snaps[:keep]}
+        link = os.path.join(self.directory, "%s_current" % self.prefix)
+        if os.path.exists(link):
+            survivors.add(os.path.realpath(link))
+        if self.keep_best:
+            best = None
+            for _, path in snaps:
+                manifest = self.read_manifest(path)
+                metric = manifest.get("best_metric") if manifest else None
+                if metric is not None and (best is None or
+                                           metric < best[0]):
+                    best = (metric, path)
+            if best is not None:
+                survivors.add(os.path.realpath(best[1]))
+        for _, path in snaps:
+            if os.path.realpath(path) in survivors:
+                continue
+            self.debug("retention (keep=%d): pruning %s", keep, path)
+            self._remove_quiet(path)
+            self._remove_quiet(path + MANIFEST_SUFFIX)
 
     def _prefetch_device_arrays(self):
         """Overlap the device->host reads the pickle is about to do:

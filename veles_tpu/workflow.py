@@ -25,12 +25,32 @@ from veles_tpu.mutable import Bool
 from veles_tpu.plumbing import EndPoint, StartPoint
 from veles_tpu.units import Unit
 
-__all__ = ["Workflow", "NoMoreJobs", "AcceleratedWorkflow"]
+__all__ = ["Workflow", "NoMoreJobs", "AcceleratedWorkflow",
+           "restore_workflow"]
 
 
 class NoMoreJobs(Exception):
     """Raised by a unit when the job stream is exhausted
     (reference: workflow.py:82)."""
+
+
+def restore_workflow(path, launcher=None):
+    """Restore a workflow from a (manifest-verified) snapshot and
+    re-home it: attach it to ``launcher`` and mark it restored so
+    initialize() applies the post-restore gate fixups.  The single
+    bootstrap path behind ``-w`` / ``--resume`` and programmatic
+    resumes."""
+    from veles_tpu.snapshotter import SnapshotterBase
+    workflow = SnapshotterBase.import_file(path)
+    if not isinstance(workflow, Workflow):
+        from veles_tpu.snapshotter import SnapshotError
+        raise SnapshotError(
+            "snapshot %s holds a %s, not a Workflow" %
+            (path, type(workflow).__name__))
+    if launcher is not None:
+        workflow.workflow = launcher
+    workflow.restored_from_snapshot_ = True
+    return workflow
 
 
 class Workflow(Unit):
@@ -111,6 +131,14 @@ class Workflow(Unit):
         AttributeError (unsatisfied demands) get re-queued until no
         progress is made (reference: workflow.py:303,331-336)."""
         self.device = device
+        if self.restored_from_snapshot_:
+            # units must know they carry pickled state BEFORE their
+            # initialize runs — e.g. a restored loader must NOT
+            # re-shuffle (that would tear shuffled_indices away from
+            # the pickled PRNG stream and break exact resume)
+            for unit in self._units:
+                if unit is not self:
+                    unit.restored_from_snapshot = True
         queue = deque(self.units_in_dependency_order)
         deferred_errors = {}
         while queue:
