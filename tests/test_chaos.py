@@ -536,6 +536,188 @@ def test_pipeline_serve_exception_surfaces_cleanly(cpu_device):
     assert pf is None or pf._pool is None, "worker must be shut down"
 
 
+# -- numerics health: nan injection, rollback, quarantine ----------------
+# (docs/health.md; unit-level guard coverage lives in tests/test_health.py)
+
+
+def test_nan_grad_injected_step_is_skipped_and_run_completes(cpu_device):
+    """A NaN gradient at train step k: the fused step skips exactly
+    that update (skip counter = 1), training continues, and the run
+    finishes with finite weights and a sane validation error."""
+    prng.get().seed(4242)
+    sw = StandardWorkflow(
+        DummyWorkflow().workflow,
+        layers=[dict(spec) for spec in LAYERS],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("chaos_nan1", seed=7)),
+        decision_config=dict(max_epochs=4),
+    )
+    sw.fuse()
+    sw.initialize(device=cpu_device)
+    plan = chaos.install(FaultPlan().add("step.grad", "nan", nth=3))
+    try:
+        sw.run()
+    finally:
+        chaos.uninstall()
+    assert plan.fired("step.grad") == 1
+    assert bool(sw.decision.complete)
+    assert int(sw.fused_trainer.skip_count) == 1
+    assert int(sw.fused_trainer.consecutive_skips) == 0
+    for w in _weights(sw):
+        assert numpy.isfinite(w).all()
+    assert sw.decision.epoch_metrics[1] < 10.0, \
+        "one skipped step must not derail training"
+
+
+def test_nan_grad_per_unit_path_skips_whole_chain(cpu_device):
+    """The PER-UNIT gd chain has the same skip semantics: poisoning the
+    last layer's err_output cascades a non-finite err_input upstream,
+    so every layer skips that step — no torn half-updated state."""
+    prng.get().seed(4242)
+    sw = StandardWorkflow(
+        DummyWorkflow().workflow,
+        layers=[dict(spec) for spec in LAYERS],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("chaos_nan2", seed=7)),
+        decision_config=dict(max_epochs=4),
+    )
+    sw.initialize(device=cpu_device)
+    # hit 1 = the FIRST gd to run on the first train step — the last
+    # layer's unit, whose poisoned err_input cascades to every other
+    plan = chaos.install(FaultPlan().add("step.grad", "nan", nth=1))
+    try:
+        sw.run()
+    finally:
+        chaos.uninstall()
+    assert plan.fired("step.grad") == 1
+    assert bool(sw.decision.complete)
+    skips = [int(gd.skip_count) for gd in sw.gds]
+    assert skips == [1, 1], \
+        "both layers must skip the poisoned step together: %s" % skips
+    for w in _weights(sw):
+        assert numpy.isfinite(w).all()
+    assert sw.decision.epoch_metrics[1] < 10.0
+
+
+def test_sustained_nan_rolls_back_and_completes(tmp_path, cpu_device):
+    """Acceptance: sustained NaN gradients trip the consecutive-skip
+    budget; the run rolls back to the last VERIFIED snapshot, backs
+    off the learning rate, and still completes with finite weights."""
+    saved = (root.common.snapshot.get("dir"),
+             root.common.snapshot.get("time_interval", 15))
+    root.common.snapshot.update({"dir": str(tmp_path),
+                                 "time_interval": 0})
+    try:
+        sw = _build_resume(DummyWorkflow().workflow, max_epochs=6)
+        sw.decision.skip_budget = 4
+        sw.fuse()
+        sw.initialize(device=cpu_device)
+        assert sw.snapshotter is not None
+        lr0 = sw.gds[0].learning_rate
+        # 4 train steps/epoch: epoch 1 clean (snapshot lands), epochs
+        # 2-3 fully poisoned (trip + rollback each), 4-6 clean again
+        chaos.install(FaultPlan().add("step.grad", "nan",
+                                      after=4, times=8))
+        try:
+            sw.run()
+        finally:
+            chaos.uninstall()
+        assert bool(sw.decision.complete)
+        assert sw.snapshotter.rollbacks == 2
+        assert sw.gds[0].learning_rate == pytest.approx(lr0 * 0.25)
+        assert not bool(sw.decision.diverged)
+        for w in _weights(sw):
+            assert numpy.isfinite(w).all()
+    finally:
+        root.common.snapshot.update({"dir": saved[0],
+                                     "time_interval": saved[1]})
+
+
+def test_rollback_budget_exhaustion_hard_fails(tmp_path, cpu_device):
+    """When divergence keeps tripping past the bounded retry budget,
+    the run must die LOUDLY (RollbackExhausted), not loop forever."""
+    from veles_tpu.health import RollbackExhausted
+    saved = (root.common.snapshot.get("dir"),
+             root.common.snapshot.get("time_interval", 15))
+    root.common.snapshot.update({"dir": str(tmp_path),
+                                 "time_interval": 0})
+    try:
+        sw = _build_resume(DummyWorkflow().workflow, max_epochs=8)
+        sw.decision.skip_budget = 4
+        sw.fuse()
+        sw.initialize(device=cpu_device)
+        sw.snapshotter.rollback_budget = 1
+        # epoch 1 clean, then NaN forever: rollback 1 is allowed, the
+        # second trip exhausts the budget
+        chaos.install(FaultPlan().add("step.grad", "nan", after=4))
+        try:
+            with pytest.raises(RollbackExhausted):
+                sw.run()
+        finally:
+            chaos.uninstall()
+        assert sw.snapshotter.rollbacks == 2  # the failing attempt
+        assert not bool(sw.decision.complete)
+    finally:
+        root.common.snapshot.update({"dir": saved[0],
+                                     "time_interval": saved[1]})
+
+
+def test_divergence_without_snapshotter_fails_loudly(cpu_device):
+    """No snapshotter attached -> nothing to roll back to: the
+    watchdog must abort the run instead of converging to garbage."""
+    from veles_tpu.health import DivergenceError
+    prng.get().seed(4242)
+    sw = StandardWorkflow(
+        DummyWorkflow().workflow,
+        layers=[dict(spec) for spec in LAYERS],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("chaos_nosnap", seed=7)),
+        decision_config=dict(max_epochs=4, skip_budget=4),
+    )
+    sw.fuse()
+    sw.initialize(device=cpu_device)
+    assert sw.snapshotter is None
+    chaos.install(FaultPlan().add("step.grad", "nan"))
+    try:
+        with pytest.raises(DivergenceError):
+            sw.run()
+    finally:
+        chaos.uninstall()
+
+
+def test_poisoned_slave_update_quarantined_and_run_finishes(cpu_device):
+    """Acceptance: a master receiving a poisoned (all-NaN) slave update
+    quarantines that slave — drop + TTL blacklist, minibatch requeued —
+    instead of merging it into global weights; the slave rejoins after
+    the TTL and the run finishes with finite weights."""
+    master = _build("master", "chaos_poison_m", cpu_device)
+    slave = _build("slave", "chaos_poison_s", cpu_device)
+    server, _ = _start_server(master, blacklist_ttl=0.6)
+    client = Client("127.0.0.1:%d" % server.port, slave)
+    plan = chaos.install(FaultPlan().add("net.update", "nan", nth=2))
+    try:
+        client.run()
+    finally:
+        chaos.uninstall()
+    assert server._done.wait(15)
+
+    assert plan.fired("net.update") == 1
+    assert server.quarantined == 1
+    assert master.loader.total_failed >= 1, \
+        "the poisoned job's minibatch must requeue"
+    assert client.sessions_established >= 2, \
+        "the quarantined slave must rejoin after the blacklist TTL"
+    assert bool(master.decision.complete)
+    for w in _weights(master):
+        assert numpy.isfinite(w).all()
+    # the poisoned update was never applied: global metrics stay sane
+    assert master.decision.epoch_metrics[1] is not None
+    assert numpy.isfinite(master.decision.epoch_metrics[1])
+
+
 # -- kill -9 soak (slow tier) --------------------------------------------
 
 

@@ -1,0 +1,466 @@
+"""Numerics health watchdog (docs/health.md), unit level: the in-graph
+skip-step guards (fused step + per-unit gd backward), NaN-safe decision
+metrics, the divergence detector, the payload finiteness walker, the
+server's TTL blacklist / per-slave respawn backoff, and the matmul
+non-finite debug guard.  End-to-end chaos runs (rollback, quarantine)
+live in tests/test_chaos.py."""
+
+import math
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.health import DivergenceError, all_finite, is_finite_metric
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.models.decision import DecisionGD, DecisionMSE
+from veles_tpu.models.evaluator import EvaluatorSoftmax, lazy_consec
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+from tests.test_models import BlobsLoader
+
+pytestmark = pytest.mark.health
+
+NAN = float("nan")
+
+
+# -- the fused step's in-graph guard --------------------------------------
+
+
+def _fused_step_fixture(cpu_device):
+    from veles_tpu.compiler import (
+        build_train_step, extract_state, workflow_plan)
+    prng.get().seed(4242)
+    sw = StandardWorkflow(
+        DummyWorkflow().workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("health_fused", seed=7)),
+        decision_config=dict(max_epochs=1),
+    )
+    sw.initialize(device=cpu_device)
+    plans = workflow_plan(sw)
+    state = extract_state(sw)
+    step = build_train_step(plans, loss="softmax", donate=False)
+    rng = numpy.random.RandomState(0)
+    batches = [(rng.randn(64, 16).astype(numpy.float32),
+                rng.randint(0, 4, 64).astype(numpy.int32))
+               for _ in range(4)]
+    return step, state, batches
+
+
+def _assert_states_equal(sa, sb):
+    for ea, eb in zip(sa, sb):
+        for key in ea:
+            if ea[key] is None:
+                assert eb[key] is None
+                continue
+            numpy.testing.assert_array_equal(
+                numpy.asarray(ea[key]), numpy.asarray(eb[key]))
+
+
+def test_fused_step_nan_grad_skips_bit_exactly(cpu_device):
+    """Acceptance: a NaN gradient at step k leaves the state (params
+    AND solver accumulators) bit-identical to never having served that
+    minibatch — the run with the poisoned step matches the fault-free
+    run after the same number of *applied* steps."""
+    step, state, batches = _fused_step_fixture(cpu_device)
+    bs = numpy.float32(64)
+
+    ref = state  # applied steps: 0, 1, 3
+    for i in (0, 1, 3):
+        ref, m = step(ref, batches[i][0], batches[i][1], bs)
+        assert bool(m["finite"]) and int(m["skipped"]) == 0
+        assert math.isfinite(float(m["grad_norm"]))
+
+    got = state  # same, plus a poisoned (skipped) step 2 in between
+    for i in (0, 1):
+        got, _ = step(got, batches[i][0], batches[i][1], bs)
+    got, m = step(got, batches[2][0], batches[2][1], bs,
+                  grad_poison=numpy.float32(NAN))
+    assert not bool(m["finite"]) and int(m["skipped"]) == 1
+    assert not math.isfinite(float(m["grad_norm"]))
+    got, _ = step(got, batches[3][0], batches[3][1], bs)
+
+    _assert_states_equal(ref, got)
+
+
+def test_fused_step_loss_poison_skips(cpu_device):
+    """The guard also covers a non-finite LOSS with finite gradients
+    (the loss leg of the isfinite reduction)."""
+    step, state, batches = _fused_step_fixture(cpu_device)
+    bs = numpy.float32(64)
+    new, m = step(state, batches[0][0], batches[0][1], bs,
+                  loss_poison=numpy.float32(NAN))
+    assert int(m["skipped"]) == 1
+    assert not math.isfinite(float(m["loss"]))
+    # gradients themselves were finite — the skip came from the loss
+    assert math.isfinite(float(m["grad_norm"]))
+    _assert_states_equal(state, new)
+
+
+def test_train_epoch_counts_skipped_steps(cpu_device):
+    """build_train_epoch surfaces the guard's per-epoch skip count, and
+    one poisoned minibatch never contaminates the epoch's state."""
+    import jax.numpy as jnp
+    from veles_tpu.compiler import (
+        build_train_epoch, extract_state, workflow_plan)
+    prng.get().seed(4242)
+    sw = StandardWorkflow(
+        DummyWorkflow().workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("health_epoch", seed=7)),
+        decision_config=dict(max_epochs=1),
+    )
+    sw.initialize(device=cpu_device)
+    rng = numpy.random.RandomState(0)
+    dataset = rng.randn(256, 16).astype(numpy.float32)
+    labels = rng.randint(0, 4, 256).astype(numpy.int32)
+    # poison one sample: its minibatch's gradients go non-finite; the
+    # scan must skip exactly that one step and report it
+    dataset[70, 3] = NAN
+    epoch = build_train_epoch(workflow_plan(sw), batch=64,
+                              loss="softmax", donate=False)
+    order = jnp.arange(256, dtype=jnp.int32)
+    new_state, totals = epoch(extract_state(sw), dataset, labels, order)
+    assert int(totals["skipped"]) == 1
+    for entry in new_state:
+        for key, value in entry.items():
+            if value is not None:
+                assert bool(jnp.isfinite(value).all()), key
+
+
+# -- the per-unit gd guard ------------------------------------------------
+
+
+def test_gd_backward_nan_err_skips_update():
+    from veles_tpu.models.all2all import All2AllTanh
+    from veles_tpu.models.gd import GDTanh
+
+    rng = numpy.random.RandomState(1)
+    W = rng.randn(5, 3).astype(numpy.float32)
+    b = rng.randn(3).astype(numpy.float32)
+    x = rng.randn(8, 5).astype(numpy.float32)
+    y = numpy.asarray(All2AllTanh.apply({"weights": W, "bias": b}, x))
+    err = rng.randn(8, 3).astype(numpy.float32)
+    state = {"weights": W, "bias": b,
+             "accum_weights": numpy.zeros_like(W),
+             "accum_bias": numpy.zeros_like(b),
+             "accum2_weights": None, "accum2_bias": None}
+    hyper = {"learning_rate": 0.1, "learning_rate_bias": 0.1,
+             "weights_decay": 0.0, "weights_decay_bias": 0.0,
+             "l1_vs_l2": 0.0, "gradient_moment": 0.9,
+             "gradient_moment_bias": 0.9, "adadelta_rho": 0.95,
+             "solver_epsilon": 1e-6}
+
+    # finite gradients apply normally and report skipped=0
+    _, applied = GDTanh.backward(
+        state, hyper, x, y, err, solver="momentum", include_bias=True,
+        need_err_input=True)
+    assert int(numpy.asarray(applied["skipped"])) == 0
+    assert not numpy.array_equal(numpy.asarray(applied["weights"]), W)
+
+    # one NaN in err_output: update skipped, err_input still propagates
+    # the poison upstream so the whole chain skips the step
+    poisoned = err.copy()
+    poisoned[2, 1] = NAN
+    err_input, skipped = GDTanh.backward(
+        state, hyper, x, y, poisoned, solver="momentum",
+        include_bias=True, need_err_input=True)
+    assert int(numpy.asarray(skipped["skipped"])) == 1
+    for key in ("weights", "bias", "accum_weights", "accum_bias"):
+        numpy.testing.assert_array_equal(
+            numpy.asarray(skipped[key]), numpy.asarray(state[key]))
+    assert not numpy.isfinite(numpy.asarray(err_input)).all()
+
+
+def test_lazy_consec_counter():
+    assert lazy_consec(0, 1) == 1
+    assert lazy_consec(1, 1) == 2
+    assert lazy_consec(5, 0) == 0
+    import jax.numpy as jnp
+    assert int(lazy_consec(jnp.int32(3), jnp.int32(1))) == 4
+    assert int(lazy_consec(jnp.int32(3), jnp.int32(0))) == 0
+
+
+# -- NaN-safe decision metrics --------------------------------------------
+
+
+def _decision(cls=DecisionMSE, **kwargs):
+    wf = DummyWorkflow()
+    decision = cls(wf, **kwargs)
+    decision.class_lengths = [0, 64, 256]
+    decision.epoch_number = 0
+    decision.last_minibatch = False
+    decision.epoch_ended = False
+    decision.minibatch_class = TRAIN
+    return decision
+
+
+def test_nan_validation_metric_never_recorded_as_best():
+    """`NaN < best` is False, but `best is None or NaN < best` would
+    crown NaN the FIRST best — after which nothing ever improves."""
+    decision = _decision()
+    decision.epoch_metrics[VALID] = NAN
+    decision._on_class_ended(VALID)
+    assert decision.best_metric is None
+    assert not bool(decision.improved)
+
+    decision.epoch_metrics[VALID] = 3.5
+    decision._on_class_ended(VALID)
+    assert decision.best_metric == 3.5
+    assert bool(decision.improved)
+
+    for bad in (NAN, float("inf"), None):
+        decision.epoch_metrics[VALID] = bad
+        decision._on_class_ended(VALID)
+        assert decision.best_metric == 3.5, bad
+        assert not bool(decision.improved), bad
+
+
+def test_nan_train_metric_never_improves_train_best():
+    decision = _decision(watchdog=False)
+    decision.epoch_metrics[TRAIN] = NAN
+    decision._on_class_ended(TRAIN)
+    assert decision.best_train_metric is None
+    assert not bool(decision.train_improved)
+    decision.epoch_metrics[TRAIN] = 1.25
+    decision._on_class_ended(TRAIN)
+    assert decision.best_train_metric == 1.25
+
+
+def test_evaluator_softmax_nan_probs_metrics_stay_finite():
+    """NaN probabilities must not leak NaN into the (integer) n_err /
+    confusion metrics the decision accumulates."""
+    probs = numpy.full((6, 3), NAN, numpy.float32)
+    labels = numpy.array([0, 1, 2, 0, 1, -1], numpy.int32)
+    err, n_err, confusion = EvaluatorSoftmax.compute(
+        probs, labels, numpy.float32(6), 3)
+    assert int(n_err) >= 0 and int(n_err) <= 5
+    assert numpy.issubdtype(numpy.asarray(n_err).dtype, numpy.integer)
+    assert numpy.asarray(confusion).sum() == 5  # only valid labels
+
+
+# -- the divergence detector ----------------------------------------------
+
+
+class _HealthStub(object):
+    def __init__(self, skip_count=0, consecutive_skips=0):
+        self.skip_count = skip_count
+        self.consecutive_skips = consecutive_skips
+
+
+class _RecordingWorkflow(object):
+    """Duck-typed owner for a decision under test: records divergence
+    callbacks instead of rolling back."""
+
+    workflow_mode = "standalone"
+
+    def __init__(self):
+        self.divergences = []
+
+    def on_divergence(self, reason):
+        self.divergences.append(reason)
+
+
+def test_consecutive_skip_budget_trips_watchdog():
+    decision = _decision(cls=DecisionGD, skip_budget=4)
+    recorder = _RecordingWorkflow()
+    decision._workflow = recorder
+    decision.health_sources = [_HealthStub(skip_count=4,
+                                           consecutive_skips=4)]
+    decision.epoch_metrics[TRAIN] = 10.0
+    decision._check_divergence()
+    assert len(recorder.divergences) == 1
+    assert "consecutive" in recorder.divergences[0]
+    assert bool(decision.diverged)
+
+
+def test_skips_below_budget_warn_but_do_not_trip():
+    decision = _decision(cls=DecisionGD, skip_budget=4)
+    recorder = _RecordingWorkflow()
+    decision._workflow = recorder
+    decision.health_sources = [_HealthStub(skip_count=2,
+                                           consecutive_skips=1)]
+    decision.epoch_metrics[TRAIN] = 10.0
+    decision._check_divergence()
+    assert not recorder.divergences
+    assert not bool(decision.diverged)
+
+
+def test_ema_spike_trips_watchdog():
+    decision = _decision(cls=DecisionGD, spike_factor=3.0,
+                         spike_floor=1.0)
+    recorder = _RecordingWorkflow()
+    decision._workflow = recorder
+    decision.health_sources = []
+    for metric in (8.0, 7.0, 6.5):  # healthy declining history
+        decision.epoch_metrics[TRAIN] = metric
+        decision._check_divergence()
+    assert not recorder.divergences
+    decision.epoch_metrics[TRAIN] = 80.0  # blow-up
+    decision._check_divergence()
+    assert len(recorder.divergences) == 1
+    assert "spiked" in recorder.divergences[0]
+
+
+def test_nonfinite_train_metric_trips_watchdog():
+    decision = _decision(cls=DecisionMSE)
+    recorder = _RecordingWorkflow()
+    decision._workflow = recorder
+    decision.health_sources = []
+    decision.epoch_metrics[TRAIN] = NAN
+    decision._check_divergence()
+    assert len(recorder.divergences) == 1
+    assert "non-finite train metric" in recorder.divergences[0]
+
+
+def test_divergence_without_handler_raises_loudly():
+    decision = _decision(cls=DecisionGD, skip_budget=1)
+
+    class _NoHook(object):
+        workflow_mode = "standalone"
+    decision._workflow = _NoHook()
+    decision.health_sources = [_HealthStub(skip_count=2,
+                                           consecutive_skips=2)]
+    decision.epoch_metrics[TRAIN] = 10.0
+    with pytest.raises(DivergenceError):
+        decision._check_divergence()
+
+
+def test_reset_divergence_restarts_window():
+    decision = _decision(cls=DecisionGD, skip_budget=2)
+    recorder = _RecordingWorkflow()
+    decision._workflow = recorder
+    source = _HealthStub(skip_count=3, consecutive_skips=3)
+    decision.health_sources = [source]
+    decision.epoch_metrics[TRAIN] = 10.0
+    decision._check_divergence()
+    assert len(recorder.divergences) == 1
+    # the workflow's recovery hook zeroes counters + resets the window
+    source.skip_count = source.consecutive_skips = 0
+    decision.reset_divergence()
+    assert not bool(decision.diverged)
+    decision._check_divergence()
+    assert len(recorder.divergences) == 1  # no re-trip on stale state
+
+
+# -- payload finiteness walker --------------------------------------------
+
+
+def test_all_finite_walker():
+    good = [{"n_err": [1, 2, 3]},
+            {"weights": numpy.ones((4, 4), numpy.float32),
+             "bias": numpy.zeros(4)},
+            None, "text", 7, 3.5, (1.0, 2.0),
+            numpy.arange(5),  # int array: vacuously finite
+            numpy.float64(2.5)]
+    assert all_finite(good)
+    assert not all_finite(NAN)
+    assert not all_finite(float("inf"))
+    assert not all_finite([{"weights": numpy.array([1.0, NAN])}])
+    assert not all_finite({"a": {"b": [numpy.float32(NAN)]}})
+    assert not all_finite((1.0, float("-inf")))
+    # non-numeric leaves never fail the check
+    assert all_finite({"s": b"bytes", "flag": True, "none": None})
+
+
+def test_is_finite_metric():
+    assert is_finite_metric(0.0) and is_finite_metric(5)
+    assert not is_finite_metric(None)
+    assert not is_finite_metric(NAN)
+    assert not is_finite_metric(float("inf"))
+    assert not is_finite_metric("nope")
+
+
+# -- server: TTL blacklist + per-slave respawn backoff --------------------
+
+
+class _StubMasterWorkflow(object):
+    checksum = "stub"
+
+
+def test_blacklist_ttl_expires():
+    from veles_tpu.server import Server
+    server = Server("127.0.0.1:0", _StubMasterWorkflow(),
+                    blacklist_ttl=30.0)
+    server._blacklist("m1")
+    assert server._blacklisted("m1")
+    assert not server._blacklisted("m2")
+    # force-expire: the slave becomes eligible again and the entry is
+    # dropped (no unbounded growth over a long run)
+    server.blacklist["m1"] = 0.0
+    assert not server._blacklisted("m1")
+    assert "m1" not in server.blacklist
+
+
+def test_respawn_backoff_is_per_slave():
+    from veles_tpu.server import Server
+    server = Server("127.0.0.1:0", _StubMasterWorkflow())
+    # consecutive failures of ONE slave back off exponentially...
+    assert server._respawn_delay("a") == 2.0
+    assert server._respawn_delay("a") == 4.0
+    assert server._respawn_delay("a") == 8.0
+    # ...without inflating an unrelated slave's first delay (the old
+    # formula used the GLOBAL blacklist size)
+    assert server._respawn_delay("b") == 2.0
+    for _ in range(10):
+        delay = server._respawn_delay("a")
+    assert delay == 30.0  # capped
+    # a productive update resets the per-slave counter
+    server._respawn_attempts.pop("a", None)
+    assert server._respawn_delay("a") == 2.0
+
+
+# -- matmul non-finite debug guard ----------------------------------------
+
+
+def test_matmul_debug_guard_raises_with_stats(monkeypatch):
+    import importlib
+    # veles_tpu.ops re-exports the matmul FUNCTION; fetch the module
+    matmul_mod = importlib.import_module("veles_tpu.ops.matmul")
+    a = numpy.ones((8, 16), numpy.float32)
+    a[3, 2] = numpy.inf
+    b = numpy.ones((16, 8), numpy.float32)
+    # guard off (default): non-finite output passes through silently
+    out = matmul_mod.matmul(a, b)
+    assert not numpy.isfinite(numpy.asarray(out)).all()
+    # guard on: raises with operand stats naming the non-finite count
+    monkeypatch.setattr(matmul_mod, "_DEBUG_NONFINITE", True)
+    with pytest.raises(FloatingPointError) as excinfo:
+        matmul_mod.matmul(a, b)
+    message = str(excinfo.value)
+    assert "lhs" in message and "1 non-finite" in message
+
+
+def test_matmul_debug_guard_names_bf16_domain(monkeypatch):
+    """Finite-but-huge f32 operands land outside the level-0 bf16x3
+    domain; the guard must say so (and point at precision_level>=1)."""
+    import importlib
+    # veles_tpu.ops re-exports the matmul FUNCTION; fetch the module
+    matmul_mod = importlib.import_module("veles_tpu.ops.matmul")
+    # f32 max exceeds bf16 max (~3.39e38): finite f32, inf as bf16
+    big = float(numpy.finfo(numpy.float32).max)
+    a = numpy.full((8, 16), big, numpy.float32)
+    b = numpy.full((16, 8), 1.0, numpy.float32)
+    out = matmul_mod.matmul(a, b)
+    if numpy.isfinite(numpy.asarray(out)).all():
+        pytest.skip("interpret-mode decomposition stayed finite here")
+    monkeypatch.setattr(matmul_mod, "_DEBUG_NONFINITE", True)
+    with pytest.raises(FloatingPointError) as excinfo:
+        matmul_mod.matmul(a, b)
+    assert "bf16x3 domain" in str(excinfo.value)

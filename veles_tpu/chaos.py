@@ -24,8 +24,19 @@ point               module                     actions
                                                frame latency, awaited)
 ``server.serve``    server.Server._serve_job   kill, stall
 ``client.job``      client.Client._job_loop    die
+``net.update``      client (update send)       nan (poison the update
+                                               payload's float arrays;
+                                               param overrides the
+                                               poison value)
 ``snapshot.write``  snapshotter (atomic write) crash, enospc
 ``pipeline.serve``  pipeline_input worker      exc
+``step.grad``       models.fused / nn_units    nan (non-finite
+                                               gradients: fused step
+                                               adds the poison to every
+                                               grad leaf, per-unit path
+                                               poisons err_output)
+``step.loss``       models.fused (train step)  nan (non-finite loss,
+                                               gradients untouched)
 ==================  =========================  =========================
 
 Activation: programmatic (``chaos.install(FaultPlan(...))`` /
@@ -59,21 +70,24 @@ class Fault(object):
     """One armed injection: where, what, and when it fires."""
 
     __slots__ = ("point", "action", "nth", "probability", "times",
-                 "param", "hits", "fired")
+                 "param", "after", "hits", "fired")
 
     def __init__(self, point, action, nth=None, probability=None,
-                 times=None, param=None):
+                 times=None, param=None, after=None):
         self.point = point
         self.action = action
         self.nth = nth                  # fire on the Nth hit (1-based)
         self.probability = probability  # else: fire with probability p
         self.times = times              # max firings (None = unlimited)
         self.param = param              # action parameter (e.g. delay s)
+        self.after = after              # stay silent for the first N hits
         self.hits = 0
         self.fired = 0
 
     def _should_fire(self, rng):
         self.hits += 1
+        if self.after is not None and self.hits <= self.after:
+            return False
         if self.times is not None and self.fired >= self.times:
             return False
         if self.nth is not None:
@@ -86,6 +100,8 @@ class Fault(object):
         trig = ("n%d" % self.nth if self.nth is not None else
                 "p%g" % self.probability if self.probability is not None
                 else "*")
+        if self.after is not None:
+            trig += ":a%d" % self.after
         return "<Fault %s=%s:%s hits=%d fired=%d>" % (
             self.point, self.action, trig, self.hits, self.fired)
 
@@ -102,9 +118,9 @@ class FaultPlan(object):
         self.log = []
 
     def add(self, point, action, nth=None, probability=None, times=None,
-            param=None):
+            param=None, after=None):
         fault = Fault(point, action, nth=nth, probability=probability,
-                      times=times, param=param)
+                      times=times, param=param, after=after)
         self._faults.setdefault(point, []).append(fault)
         return self
 
@@ -132,9 +148,12 @@ class FaultPlan(object):
         """Parse ``"seed=42;point=action[:trigger[:param]];..."``.
 
         Trigger: ``nK`` = Kth hit exactly once, ``pX`` = probability X
-        per hit, ``xM`` = at most M unconditional firings, absent/``*``
-        = always.  Param is a float handed to the site (e.g. delay
-        seconds)."""
+        per hit, ``xM`` = at most M unconditional firings, ``aK`` =
+        stay silent for the first K hits (composes with the others:
+        ``nan:a8:x12`` fires unconditionally on hits 9-20 — the
+        sustained-fault window the nan-injection tests use),
+        absent/``*`` = always.  Param is a float handed to the site
+        (e.g. delay seconds, or the poison value for ``nan``)."""
         plan_seed = 0
         entries = []
         for entry in (spec or "").split(";"):
@@ -154,7 +173,7 @@ class FaultPlan(object):
             point, _, rhs = entry.partition("=")
             parts = rhs.split(":")
             action = parts[0]
-            nth = probability = times = param = None
+            nth = probability = times = param = after = None
             for token in parts[1:]:
                 if not token or token == "*":
                     continue
@@ -164,10 +183,13 @@ class FaultPlan(object):
                     probability = float(token[1:])
                 elif token.startswith("x"):
                     times = int(token[1:])
+                elif token.startswith("a"):
+                    after = int(token[1:])
                 else:
                     param = float(token)
             plan.add(point.strip(), action, nth=nth,
-                     probability=probability, times=times, param=param)
+                     probability=probability, times=times, param=param,
+                     after=after)
         return plan
 
 
@@ -199,6 +221,25 @@ def install_from_env(env="VELES_CHAOS"):
 def enospc():
     """The ENOSPC OSError chaos sites raise (one place, one message)."""
     return OSError(errno.ENOSPC, "No space left on device (chaos)")
+
+
+def poison_tree(obj, value=float("nan")):
+    """A structural copy of a payload tree with every float leaf (array
+    or scalar) replaced by ``value`` — the ``net.update=nan`` action's
+    implementation.  Integer arrays, strings, and other non-float
+    leaves pass through unchanged, so the poisoned payload still parses
+    like a real update and only its *numerics* are sick (the failure
+    mode the master's finiteness quarantine must catch)."""
+    import numpy
+    if isinstance(obj, dict):
+        return {k: poison_tree(v, value) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(poison_tree(v, value) for v in obj)
+    if isinstance(obj, numpy.ndarray) and obj.dtype.kind == "f":
+        return numpy.full_like(obj, value)
+    if isinstance(obj, float):
+        return value
+    return obj
 
 
 install_from_env()

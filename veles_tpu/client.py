@@ -191,6 +191,20 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             msg, payload = await self._recv(reader)
             if msg.get("type") == "reject":
                 self.reject_reason = msg.get("reason")
+                retry_after = msg.get("retry_after")
+                if retry_after is not None and not self._stopping:
+                    # TTL quarantine, not a verdict: sleep out the FULL
+                    # TTL (capped only against absurd values) so ONE
+                    # attempt-budget charge outlives the blacklist —
+                    # sleeping less would burn the whole budget on
+                    # rejections before a long TTL ever expires
+                    self.warning(
+                        "master quarantined us (%s); retrying in "
+                        "%.1fs", self.reject_reason, retry_after)
+                    await asyncio.sleep(
+                        min(max(float(retry_after), 0.0), 600.0) + 0.05)
+                    raise ConnectionResetError(
+                        "temporarily blacklisted by master")
                 self.error("master rejected us: %s", self.reject_reason)
                 self._stopping = True
                 return
@@ -275,6 +289,18 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             update = await self._run_job(data)
             self.jobs_done += 1
             self._session_progress = True
+            if chaos.plan is not None:
+                # poisoned-update injection (docs/health.md): ship a
+                # structurally-valid update whose float payloads are
+                # all NaN — the master's finiteness quarantine must
+                # catch it BEFORE apply_data_from_slave
+                fault = chaos.plan.fire("net.update")
+                if fault is not None and fault.action == "nan":
+                    self.warning("fault injection: poisoning update "
+                                 "payload with non-finite values")
+                    update = chaos.poison_tree(
+                        update, float("nan") if fault.param is None
+                        else fault.param)
             self._send(writer, {
                 "type": "update", "job_id": msg.get("job_id"),
                 "codec": self.codec}, payload=update)

@@ -176,12 +176,34 @@ def _build_step_fn(plans, loss):
         mse_sum = jnp.sum(jnp.sum(diff * diff, axis=1) / out2.shape[1])
         return jnp.sum(diff * diff) / batch_size, mse_sum
 
-    def step(state, x, target, batch_size, step_key=None):
+    def step(state, x, target, batch_size, step_key=None,
+             grad_poison=None, loss_poison=None):
         params = [{"weights": s["weights"], "bias": s["bias"]}
                   for s in state]
         (loss_value, aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, x, target, batch_size,
                                    step_key)
+        # chaos nan-injection (docs/health.md): the poisons are traced
+        # scalars, so the injection happens INSIDE the compiled step —
+        # exactly where a real numeric fault would appear — and the
+        # non-poisoned trace carries zero overhead (poison args are
+        # None at trace time on the healthy path)
+        if grad_poison is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: g + grad_poison.astype(g.dtype), grads)
+        if loss_poison is not None:
+            loss_value = loss_value + loss_poison
+
+        # numerics guard: one all-isfinite reduction over the loss and
+        # the global grad-norm.  A single inf/nan anywhere in the
+        # gradients makes the squared-sum non-finite, so isfinite of
+        # the norm covers every leaf; both flags stay LAZY device
+        # scalars riding the existing metrics result — no host sync
+        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in jax.tree_util.tree_leaves(grads))
+        grad_norm = jnp.sqrt(gsq)
+        step_finite = jnp.isfinite(loss_value) & jnp.isfinite(grad_norm)
+
         new_state = []
         for plan, hyper, s, g in zip(plans, hypers, state, grads):
             if s["weights"] is None:  # param-less layer (pooling, ...)
@@ -213,12 +235,22 @@ def _build_step_fn(plans, loss):
                 entry.update({"bias": new_b, "accum_bias": acc_b,
                               "accum2_bias": acc2_b})
             new_state.append(entry)
+        # a non-finite update is SKIPPED, not applied: every state leaf
+        # falls back to its pre-step value, so one poisoned minibatch
+        # leaves params (and solver accumulators) bit-identical to
+        # never having served it (tests/test_health.py proves equality)
+        new_state = [GradientDescentBase.select_state(step_finite,
+                                                      entry, old)
+                     for entry, old in zip(new_state, state)]
         if loss == "softmax":
             metrics = {"loss": loss_value, "n_err": aux}
         else:
             metrics = {"loss": loss_value,
                        "n_err": jnp.zeros((), jnp.int32),
                        "mse_sum": aux}
+        metrics["grad_norm"] = grad_norm
+        metrics["finite"] = step_finite
+        metrics["skipped"] = (~step_finite).astype(jnp.int32)
         return new_state, metrics
 
     return step
@@ -254,7 +286,14 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
     (new_state, metrics).
 
     state: list of dicts (weights/bias/accum*); metrics: {"loss", "n_err"}
-    (classification) or {"loss"} (mse).  batch_size is a traced scalar so
+    (classification) or {"loss"} (mse), plus the numerics-health trio
+    {"grad_norm", "finite", "skipped"} — all lazy device scalars.  A
+    step whose loss or global grad-norm is non-finite does NOT update
+    the state (``skipped`` = 1; params and solver accumulators keep
+    their pre-step values bit-exactly); see docs/health.md.  The
+    optional ``grad_poison`` / ``loss_poison`` keyword scalars are the
+    chaos harness's in-graph nan-injection hooks (None costs nothing).
+    batch_size is a traced scalar so
     short minibatches don't retrigger compilation.
     ``compiler_options``: per-program XLA options (see
     :func:`step_compiler_options` for the tuned per-chip set).
@@ -269,17 +308,22 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
     if donate:
         jit_kwargs["donate_argnums"] = (0,)
     if mesh is not None and state_shardings is not None:
-        # 5-tuple: the optional step_key (dropout PRNG) rides replicated
+        # 7-tuple: the optional step_key (dropout PRNG) and the chaos
+        # poison scalars all ride replicated.  Everything is passed
+        # POSITIONALLY — pjit rejects kwargs once in_shardings is
+        # specified — with fixed arity so the spec always matches
+        # (None args are empty pytrees)
         jit_kwargs["in_shardings"] = (
             state_shardings, batch_sharding, batch_sharding and
-            _labels_sharding(mesh, data_axis, loss), None, None)
+            _labels_sharding(mesh, data_axis, loss), None, None,
+            None, None)
         jit_kwargs["out_shardings"] = (state_shardings, None)
         jitted = jax.jit(step, **jit_kwargs)
 
-        def sharded_step(state, x, target, batch_size, step_key=None):
-            # fixed arity so in_shardings always matches (None is an
-            # empty pytree when no dropout key is used)
-            return jitted(state, x, target, batch_size, step_key)
+        def sharded_step(state, x, target, batch_size, step_key=None,
+                         grad_poison=None, loss_poison=None):
+            return jitted(state, x, target, batch_size, step_key,
+                          grad_poison, loss_poison)
         return sharded_step
     return jax.jit(step, **jit_kwargs)
 
@@ -366,7 +410,11 @@ def build_train_epoch(plans, batch, loss="softmax", donate=True,
         state, ms = jax.lax.scan(body, state,
                                  (jnp.arange(n_steps), sizes))
         totals = {"loss_mean": jnp.sum(ms["loss"] * sizes) / n,
-                  "n_err": ms["n_err"].sum()}
+                  "n_err": ms["n_err"].sum(),
+                  # steps whose update the numerics guard refused to
+                  # apply (non-finite loss/grads); callers treat > 0 as
+                  # a health signal (docs/health.md)
+                  "skipped": ms["skipped"].sum()}
         if "mse_sum" in ms:
             totals["mse_sum"] = ms["mse_sum"].sum()
         return state, totals

@@ -1,0 +1,74 @@
+"""Numerics health: shared finiteness checks and watchdog failures.
+
+One bad minibatch (or one sick worker) must never silently poison a
+training run: the fused step and the per-unit gd chain *skip* non-finite
+updates (docs/health.md), the decision unit detects divergence and
+triggers :meth:`veles_tpu.snapshotter.Snapshotter.rollback`, and the
+master validates slave updates with :func:`all_finite` before applying
+them.  This module holds the pieces every plane shares so the guards
+cannot drift apart.
+"""
+
+import math
+
+import numpy
+
+__all__ = ["all_finite", "DivergenceError", "RollbackExhausted",
+           "is_finite_metric"]
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged and no recovery path exists (no snapshotter
+    attached, or nothing good to roll back to).  Raised loudly instead
+    of letting the run converge to garbage."""
+
+
+class RollbackExhausted(DivergenceError):
+    """The bounded rollback retry budget is spent and the run still
+    diverges; continuing would loop rollback -> divergence forever."""
+
+
+def is_finite_metric(metric):
+    """True only for a real, finite scalar metric.  ``None`` and NaN
+    both fail: ``NaN < best`` is silently False, so a NaN metric could
+    otherwise be *recorded as best* when no best exists yet."""
+    if metric is None:
+        return False
+    try:
+        return math.isfinite(float(metric))
+    except (TypeError, ValueError):
+        return False
+
+
+def all_finite(obj):
+    """Recursively check a payload tree (the master-slave update wire
+    format: nested dicts/lists of numpy arrays and scalars) for
+    non-finite floats.  Non-numeric leaves (str, bytes, bool, None) and
+    integer arrays are vacuously finite.  Used by the master to
+    validate a slave's update BEFORE ``apply_data_from_slave`` — a NaN
+    delta merged into global weights poisons every other slave's next
+    job."""
+    if obj is None or isinstance(obj, (bool, int, str, bytes)):
+        return True
+    if isinstance(obj, float):
+        return math.isfinite(obj)
+    if isinstance(obj, numpy.ndarray):
+        if obj.dtype.kind not in "fc":
+            return True
+        return bool(numpy.isfinite(obj).all())
+    if isinstance(obj, numpy.generic):
+        if obj.dtype.kind not in "fc":
+            return True
+        return bool(numpy.isfinite(obj))
+    if isinstance(obj, dict):
+        return all(all_finite(v) for v in obj.values())
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return all(all_finite(v) for v in obj)
+    # jax arrays (and anything array-like) reach here via __array__
+    try:
+        arr = numpy.asarray(obj)
+    except Exception:
+        return True  # opaque object: nothing numeric to validate
+    if arr.dtype.kind not in "fc":
+        return True
+    return bool(numpy.isfinite(arr).all())

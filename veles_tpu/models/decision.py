@@ -6,8 +6,18 @@ validation error, raises ``improved`` when a new best is reached, skips
 gradient descent on non-TRAIN minibatches via the shared ``gd_skip``
 Bool, and sets ``complete`` when ``fail_iterations`` epochs pass without
 improvement or ``max_epochs`` is reached.
+
+Numerics health (docs/health.md): a non-finite metric is NEVER recorded
+as improved/best (``NaN < best`` is silently False, and a NaN could
+otherwise *become* best when no best exists yet), and the decision
+doubles as the training-health watchdog — at each train-class end it
+checks the consecutive-skip counters the guarded train steps maintain
+and an EMA loss-spike threshold, raising ``diverged`` and invoking the
+owning workflow's ``on_divergence`` hook (snapshot rollback + LR
+backoff in StandardWorkflow) when training has gone off the rails.
 """
 
+from veles_tpu.health import DivergenceError, is_finite_metric
 from veles_tpu.loader.base import CLASS_NAME, TRAIN, VALID
 from veles_tpu.mutable import Bool
 from veles_tpu.units import Unit
@@ -16,7 +26,19 @@ __all__ = ["DecisionBase", "DecisionGD", "DecisionMSE"]
 
 
 class DecisionBase(Unit):
-    """Epoch metric aggregation + stop control."""
+    """Epoch metric aggregation + stop control + divergence watchdog.
+
+    Watchdog kwargs (defaults are deliberately conservative so healthy
+    noisy runs never trip):
+
+    - ``watchdog`` (True): master switch for divergence detection.
+    - ``skip_budget`` (16): consecutive guarded-step skips that count
+      as divergence (sustained non-finite gradients/loss).
+    - ``spike_factor`` (10.0) / ``spike_floor`` (1.0) / ``ema_beta``
+      (0.5): trip when the train metric exceeds ``spike_factor *
+      max(EMA, spike_floor)`` — the floor keeps near-zero converged
+      metrics from turning ordinary noise into "spikes".
+    """
 
     def __init__(self, workflow, **kwargs):
         super(DecisionBase, self).__init__(workflow, **kwargs)
@@ -26,6 +48,18 @@ class DecisionBase(Unit):
         self.improved = Bool(False)
         self.train_improved = Bool(False)
         self.gd_skip = Bool(False)
+        # divergence watchdog
+        self.diverged = Bool(False)
+        self.watchdog = kwargs.get("watchdog", True)
+        self.skip_budget = kwargs.get("skip_budget", 16)
+        self.spike_factor = kwargs.get("spike_factor", 10.0)
+        self.spike_floor = kwargs.get("spike_floor", 1.0)
+        self.ema_beta = kwargs.get("ema_beta", 0.5)
+        #: units exposing lazy skip_count / consecutive_skips counters
+        #: (the gds, or the fused trainer); wired by the workflow
+        self.health_sources = []
+        self._metric_ema = None
+        self._skips_seen = 0
         # linked from loader:
         self.minibatch_class = None
         self.last_minibatch = None
@@ -64,12 +98,23 @@ class DecisionBase(Unit):
         if bool(self.epoch_ended):
             self._on_epoch_ended()
 
+    @staticmethod
+    def _metric_improves(metric, best):
+        """True when ``metric`` is a real improvement over ``best``.
+        Non-finite metrics NEVER improve: ``NaN < best`` is silently
+        False, but ``best is None or NaN < best`` would record NaN as
+        the first best — poisoning every later comparison (nothing
+        beats NaN, so ``improved`` would never fire again)."""
+        if not is_finite_metric(metric):
+            return False
+        return best is None or metric < best
+
     def _on_class_ended(self, cls):
         # improvement is judged on VALID when present, else on TRAIN
         judge = VALID if self.class_lengths[VALID] > 0 else TRAIN
         if cls == judge:
             metric = self.epoch_metrics[cls]
-            if self.best_metric is None or metric < self.best_metric:
+            if self._metric_improves(metric, self.best_metric):
                 self.best_metric = metric
                 self.best_epoch = self.epoch_number
                 self.improved <<= True
@@ -77,11 +122,89 @@ class DecisionBase(Unit):
                 self.improved <<= False
         if cls == TRAIN:
             metric = self.epoch_metrics[TRAIN]
-            better = (self.best_train_metric is None or
-                      metric < self.best_train_metric)
+            better = self._metric_improves(metric,
+                                           self.best_train_metric)
             if better:
                 self.best_train_metric = metric
             self.train_improved <<= better
+            self._check_divergence()
+
+    # -- divergence watchdog (docs/health.md) -------------------------------
+
+    def _health_counters(self):
+        """Sync the health sources' lazy counters (once per finished
+        train class — the same cadence as the metric sync, never per
+        minibatch).  Returns (total_skips, max_consecutive_skips)."""
+        total = 0
+        consec = 0
+        for unit in self.health_sources:
+            total += int(unit.skip_count)
+            consec = max(consec, int(unit.consecutive_skips))
+        return total, consec
+
+    def _check_divergence(self):
+        if not self.watchdog or bool(self.diverged):
+            return
+        if self.workflow is not None and \
+                self.workflow.workflow_mode == "slave":
+            return  # the master owns recovery; slaves just ship metrics
+        reasons = []
+        total, consec = self._health_counters()
+        fresh = total - self._skips_seen
+        self._skips_seen = total
+        if consec >= self.skip_budget:
+            reasons.append(
+                "%d consecutive non-finite train steps skipped "
+                "(budget %d)" % (consec, self.skip_budget))
+        metric = self.epoch_metrics[TRAIN]
+        if metric is not None:
+            if not is_finite_metric(metric):
+                reasons.append("non-finite train metric %r" % (metric,))
+            else:
+                threshold = self.spike_factor * max(
+                    self._metric_ema if self._metric_ema is not None
+                    else metric, self.spike_floor)
+                if self._metric_ema is not None and metric > threshold:
+                    reasons.append(
+                        "train metric spiked to %.4g (EMA %.4g, "
+                        "threshold %.4g)" % (metric, self._metric_ema,
+                                             threshold))
+                else:
+                    beta = self.ema_beta
+                    self._metric_ema = metric if self._metric_ema is \
+                        None else beta * self._metric_ema + \
+                        (1.0 - beta) * metric
+        if fresh and not reasons:
+            self.warning(
+                "numerics guard skipped %d non-finite train step(s) "
+                "this epoch (consecutive max %d, budget %d)",
+                fresh, consec, self.skip_budget)
+        if reasons:
+            self._trip("; ".join(reasons))
+
+    def _trip(self, reason):
+        """Divergence detected: raise the flag and hand recovery to the
+        owning workflow (StandardWorkflow rolls back to the last
+        verified snapshot and backs off the learning rate).  Without a
+        handler this FAILS LOUDLY — converging to garbage silently is
+        the one outcome the watchdog exists to prevent."""
+        self.diverged <<= True
+        self.error("training diverged at epoch %s: %s",
+                   self.epoch_number, reason)
+        handler = getattr(self.workflow, "on_divergence", None)
+        if handler is None:
+            raise DivergenceError(
+                "training diverged (%s) and the workflow has no "
+                "on_divergence recovery hook" % reason)
+        handler(reason)
+
+    def reset_divergence(self):
+        """Post-rollback reset (called by the workflow's recovery hook
+        after counters were zeroed): the watchdog starts a fresh
+        observation window."""
+        self.diverged <<= False
+        self._metric_ema = None
+        self._skips_seen = 0
 
     def get_metric_names(self):
         return {"Errors", "Best metric", "Best epoch"}

@@ -134,6 +134,7 @@ class GDDeconv(GradientDescent):
             hyper["solver_epsilon"])
         new_state = {"weights": new_w, "accum_weights": acc_w,
                      "accum2_weights": acc2_w}
+        grad_b = None
         if include_bias:
             b = state["bias"]
             grad_b = err.astype(jnp.float32).sum(axis=(0, 1, 2))
@@ -144,6 +145,10 @@ class GDDeconv(GradientDescent):
                 hyper["solver_epsilon"])
             new_state.update({"bias": new_b, "accum_bias": acc_b,
                               "accum2_bias": acc2_b})
+        # numerics guard: skip the update on non-finite gradients
+        # (docs/health.md; same semantics as the fully-connected family)
+        new_state = GradientDescentBase.finite_guard(
+            state, new_state, grad_w, grad_b)
         return err_input, new_state
 
 

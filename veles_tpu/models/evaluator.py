@@ -25,9 +25,10 @@ from veles_tpu.memory import Array
 from veles_tpu.units import Unit
 
 __all__ = ["EvaluatorBase", "EvaluatorSoftmax", "EvaluatorMSE",
-           "lazy_add"]
+           "lazy_add", "lazy_consec"]
 
 _JIT_ADD = None
+_JIT_CONSEC = None
 
 
 def lazy_add(a, b):
@@ -45,6 +46,21 @@ def lazy_add(a, b):
         import jax
         _JIT_ADD = jax.jit(lambda p, q: p + q)
     return _JIT_ADD(a, b)
+
+
+def lazy_consec(prev, skipped):
+    """Consecutive-skip counter update for the numerics watchdog
+    (docs/health.md) without a host sync: ``skipped`` is a lazy 0/1
+    scalar, so ``(prev + s) * s`` increments on a skipped step and
+    resets to 0 on any applied one.  Jitted like :func:`lazy_add`;
+    plain arithmetic for host-side (numpy-backend) callers."""
+    if not (hasattr(prev, "aval") or hasattr(skipped, "aval")):
+        return (prev + skipped) * skipped
+    global _JIT_CONSEC
+    if _JIT_CONSEC is None:
+        import jax
+        _JIT_CONSEC = jax.jit(lambda p, s: (p + s) * s)
+    return _JIT_CONSEC(prev, skipped)
 
 
 class EvaluatorBase(Unit):

@@ -14,6 +14,7 @@ every already-written config gains the fused path without changes.
 
 import numpy
 
+from veles_tpu import chaos
 from veles_tpu.loader.base import TRAIN
 from veles_tpu.units import Unit
 
@@ -32,8 +33,16 @@ class FusedTrainer(Unit):
         self.device = None
         self._step_fn = None
         self._state = None
-        self._dropout_base_key = kwargs.get("dropout_seed", 0)
+        self._dropout_seed = kwargs.get("dropout_seed", 0)
+        self._dropout_base_key = self._dropout_seed
         self._iteration = 0
+        # numerics health (docs/health.md): per-step skip flags stay
+        # lazy device scalars; the decision unit syncs them once per
+        # finished class, never on the hot path
+        self.skip_count = 0
+        self.consecutive_skips = 0
+        self.last_step_finite = True
+        self.grad_norm = None
         #: async input pipeline knob (pipeline_input.Prefetcher): serve
         #: minibatch k+1 (host fill + async H2D) while step k runs
         self.pipeline = kwargs.get("pipeline", False)
@@ -131,9 +140,20 @@ class FusedTrainer(Unit):
                 key = jax.random.fold_in(
                     jax.random.PRNGKey(self._dropout_base_key),
                     self._iteration)
-            if key is not None:
+            poisons = {}
+            if chaos.plan is not None:
+                # nan-injection rides INSIDE the jitted step as traced
+                # scalars (compiler.py); the healthy path never pays
+                for point, kwarg in (("step.grad", "grad_poison"),
+                                     ("step.loss", "loss_poison")):
+                    fault = chaos.plan.fire(point)
+                    if fault is not None:
+                        poisons[kwarg] = numpy.float32(
+                            numpy.nan if fault.param is None
+                            else fault.param)
+            if key is not None or poisons:
                 self._state, metrics = self._step_fn(
-                    self._state, x, target, batch_size, key)
+                    self._state, x, target, batch_size, key, **poisons)
             else:
                 self._state, metrics = self._step_fn(
                     self._state, x, target, batch_size)
@@ -142,6 +162,13 @@ class FusedTrainer(Unit):
             # one async dispatch per step even on a tunneled chip
             self.last_loss = metrics["loss"]
             self.n_err = metrics["n_err"]
+            self.grad_norm = metrics["grad_norm"]
+            self.last_step_finite = metrics["finite"]
+            from veles_tpu.models.evaluator import lazy_add, lazy_consec
+            self.skip_count = lazy_add(self.skip_count,
+                                       metrics["skipped"])
+            self.consecutive_skips = lazy_consec(
+                self.consecutive_skips, metrics["skipped"])
             # mse_sum from the step's aux metric matches EvaluatorMSE's
             # definition (per-feature mean, summed over samples); the
             # scalar loss is SSE/batch over ALL elements and would
@@ -164,6 +191,29 @@ class FusedTrainer(Unit):
                     params, x, target, batch_size)
         self.n_samples = int(batch_size)
 
+    def reset_health_counters(self):
+        """Zero the skip accounting (after the decision's divergence
+        handler finished a rollback, so the next epoch's check starts
+        clean)."""
+        self.skip_count = 0
+        self.consecutive_skips = 0
+        self.last_step_finite = True
+
+    def reset_after_rollback(self, rollbacks):
+        """Post-rollback reset: drop the compiled step and the fused
+        device state so the next run re-reads the (restored) unit
+        Arrays AND the (backed-off) gd hyperparameters, and reseed the
+        dropout stream — replaying the exact noise that accompanied a
+        divergence wastes one retry of the bounded budget."""
+        self._step_fn = None
+        self._state = None
+        self._eval_metrics = None
+        # deterministic but distinct per rollback (golden-ratio hash
+        # increment keeps streams well separated for small seeds)
+        self._dropout_base_key = (
+            self._dropout_seed + rollbacks * 0x9E3779B1) & 0x7FFFFFFF
+        self.reset_health_counters()
+
     def __getstate__(self):
         # state lives in the unit Arrays for snapshots
         self._sync_state_to_units()
@@ -179,6 +229,11 @@ class FusedTrainer(Unit):
         state["mse_sum"] = float(self.mse_sum)
         if self.last_loss is not None:
             state["last_loss"] = float(self.last_loss)
+        state["skip_count"] = int(self.skip_count)
+        state["consecutive_skips"] = int(self.consecutive_skips)
+        state["last_step_finite"] = bool(self.last_step_finite)
+        state["grad_norm"] = (None if self.grad_norm is None
+                              else float(self.grad_norm))
         return state
 
 
@@ -202,6 +257,9 @@ def fuse_standard_workflow(sw, dropout_seed=0, pipeline=False,
     sw.decision.link_from(trainer)
     # decision reads its metrics from the trainer now
     sw.decision.evaluator = trainer
+    # ...and its numerics-health counters (skip_count /
+    # consecutive_skips) from the trainer instead of the severed gds
+    sw.decision.health_sources = [trainer]
     snapshotter = getattr(sw, "snapshotter", None)
     if snapshotter is not None:
         # the fused step is atomic, so post-decision state is already

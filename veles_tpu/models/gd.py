@@ -53,6 +53,7 @@ class GradientDescent(GradientDescentBase):
         new_state = {"weights": new_w, "accum_weights": acc_w,
                      "accum2_weights": acc2_w}
 
+        grad_b = None
         if include_bias:
             b = state["bias"]
             grad_b = err.sum(axis=0)
@@ -65,6 +66,11 @@ class GradientDescent(GradientDescentBase):
                 hyper["solver_epsilon"])
             new_state.update({"bias": new_b, "accum_bias": acc_b,
                               "accum2_bias": acc2_b})
+        # numerics guard (docs/health.md): a non-finite gradient means
+        # this update is SKIPPED — params and solver state keep their
+        # pre-step values; the "skipped" flag rides the returned dict
+        new_state = GradientDescentBase.finite_guard(
+            state, new_state, grad_w, grad_b)
         return err_input, new_state
 
 

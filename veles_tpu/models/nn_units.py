@@ -243,6 +243,11 @@ class GradientDescentBase(Unit):
         self.accum_bias = Array()
         self.accum2_weights = Array()
         self.accum2_bias = Array()
+        # numerics health (docs/health.md): updates whose gradients
+        # were non-finite are SKIPPED; both counters stay lazy device
+        # scalars, synced by the decision once per finished class
+        self.skip_count = 0
+        self.consecutive_skips = 0
         self.demand("input", "output", "err_output", "weights")
 
     def init_unpickled(self):
@@ -293,6 +298,43 @@ class GradientDescentBase(Unit):
         import jax.numpy as jnp
         return grad + decay * ((1.0 - l1_vs_l2) * param +
                                l1_vs_l2 * jnp.sign(param))
+
+    @staticmethod
+    def select_state(finite, new_state, old_state):
+        """``where(finite, new, old)`` over one state dict's leaves —
+        the single definition of the skip-step fallback, shared by the
+        per-unit guard below and the fused step (compiler.py) so the
+        two paths can never drift apart.  ``None`` leaves and leaves
+        that ARE the old object (param-less passthroughs) are kept
+        as-is."""
+        import jax.numpy as jnp
+        selected = {}
+        for key, value in new_state.items():
+            old = old_state.get(key)
+            selected[key] = value if (value is None or old is None or
+                                      value is old) else \
+                jnp.where(finite, value, old)
+        return selected
+
+    @staticmethod
+    def finite_guard(state, new_state, *grads):
+        """Skip-step guard shared by every guarded backward: when any
+        gradient in ``grads`` carries a non-finite value, every leaf of
+        ``new_state`` falls back to its pre-step value in ``state`` —
+        params AND solver accumulators stay bit-identical to never
+        having run the step.  Adds the int32 ``"skipped"`` flag (0/1)
+        to the returned dict; callers pop it for their lazy skip
+        accounting (it never reaches ``_adopt_state``'s fixed key
+        set)."""
+        import jax.numpy as jnp
+        finite = jnp.asarray(True)
+        for grad in grads:
+            if grad is not None:
+                finite = finite & jnp.isfinite(grad).all()
+        guarded = GradientDescentBase.select_state(finite, new_state,
+                                                   state)
+        guarded["skipped"] = (~finite).astype(jnp.int32)
+        return guarded
 
     @staticmethod
     def solver_update(solver, param, grad, accum, accum2, lr, moment,
@@ -367,6 +409,15 @@ class GradientDescentBase(Unit):
                             if self.accum2_bias else None),
         }
 
+    def __getstate__(self):
+        # snapshots carry plain ints, not lazy device scalars
+        state = super(GradientDescentBase, self).__getstate__()
+        if "skip_count" in state:
+            state["skip_count"] = int(self.skip_count)
+        if "consecutive_skips" in state:
+            state["consecutive_skips"] = int(self.consecutive_skips)
+        return state
+
     def _adopt_state(self, new_state, device_side):
         pairs = (("weights", self.weights),
                  ("accum_weights", self.accum_weights),
@@ -387,12 +438,35 @@ class GradientDescentBase(Unit):
     # -- execution ----------------------------------------------------------
 
     def run(self):
+        from veles_tpu import chaos
+        poison = None
+        if chaos.plan is not None:
+            # nan-injection (docs/health.md): poisoning err_output
+            # makes this layer's gradients non-finite AND propagates a
+            # non-finite err_input upstream, so the whole chain skips
+            # the step — the same blast radius a real NaN has
+            fault = chaos.plan.fire("step.grad")
+            if fault is not None:
+                poison = numpy.float32(
+                    numpy.nan if fault.param is None else fault.param)
         if self.on_device():
-            self._device_run()
+            self._device_run(poison)
         else:
-            self._numpy_run()
+            self._numpy_run(poison)
 
-    def _device_run(self):
+    def _account_skip(self, skipped):
+        """Lazy skip accounting; ``skipped`` is the guarded backward's
+        0/1 flag (popped before _adopt_state sees the dict)."""
+        from veles_tpu.models.evaluator import lazy_add, lazy_consec
+        self.skip_count = lazy_add(self.skip_count, skipped)
+        self.consecutive_skips = lazy_consec(self.consecutive_skips,
+                                             skipped)
+
+    def reset_health_counters(self):
+        self.skip_count = 0
+        self.consecutive_skips = 0
+
+    def _device_run(self, poison=None):
         import functools
         import jax
         if self._jit_fn_ is None:
@@ -401,9 +475,15 @@ class GradientDescentBase(Unit):
                 include_bias=self.include_bias and bool(self.bias),
                 need_err_input=self.need_err_input,
                 **self.backward_static()))
+        err_output = self.err_output.devmem
+        if poison is not None:
+            err_output = err_output + poison
         err_input, new_state = self._jit_fn_(
             self.state_dict(), self.hyper_dict(),
-            self.input.devmem, self.output.devmem, self.err_output.devmem)
+            self.input.devmem, self.output.devmem, err_output)
+        skipped = new_state.pop("skipped", None)
+        if skipped is not None:
+            self._account_skip(skipped)
         if self.need_err_input and err_input is not None:
             self.err_input.set_device_array(err_input, self.device)
         self._adopt_state(new_state, device_side=True)
@@ -411,18 +491,24 @@ class GradientDescentBase(Unit):
             import jax
             jax.block_until_ready(new_state)
 
-    def _numpy_run(self):
+    def _numpy_run(self, poison=None):
         from veles_tpu.backends import host_compute_context
         for arr in (self.input, self.output, self.err_output):
             arr.map_read()
+        err_output = self.err_output.mem
+        if poison is not None:
+            err_output = err_output + poison
         with host_compute_context(self.device):
             err_input, new_state = type(self).backward(
                 self.state_numpy(), self.hyper_dict(),
-                self.input.mem, self.output.mem, self.err_output.mem,
+                self.input.mem, self.output.mem, err_output,
                 solver=self.solver,
                 include_bias=self.include_bias and bool(self.bias),
                 need_err_input=self.need_err_input,
                 **self.backward_static())
+        skipped = new_state.pop("skipped", None)
+        if skipped is not None:
+            self._account_skip(int(numpy.asarray(skipped)))
         if self.need_err_input and err_input is not None:
             self.err_input.map_invalidate()
             self.err_input.mem = numpy.asarray(err_input)

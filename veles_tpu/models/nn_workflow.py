@@ -146,6 +146,14 @@ class StandardWorkflow(Workflow):
             self.gds[i] = unit
             prev_gd = unit
 
+        # the decision's divergence watchdog reads the gds' lazy skip
+        # counters (the fused path rewires this to the trainer)
+        self.decision.health_sources = [gd for gd in self.gds
+                                        if gd is not None]
+        #: LR multiplier applied by each divergence rollback
+        self.divergence_lr_backoff = kwargs.get(
+            "divergence_lr_backoff", 0.5)
+
         # close the loop and the exit path
         self.repeater.link_from(self.gds[0])
         self.end_point.link_from(self.decision)
@@ -191,6 +199,71 @@ class StandardWorkflow(Workflow):
         next minibatch with the running step."""
         from veles_tpu.models.fused import fuse_standard_workflow
         return fuse_standard_workflow(self, **kwargs)
+
+    # -- numerics health: divergence recovery (docs/health.md) --------------
+
+    def adopt_model_state(self, donor):
+        """Copy the model state (forward params + gd solver
+        accumulators) out of ``donor`` — a workflow unpickled from a
+        verified snapshot — into THIS workflow's live Arrays.  Host
+        copies become authoritative; device uploads happen lazily at
+        the next access, and a fused trainer re-extracts its state on
+        its next compile."""
+        import numpy
+        if len(donor.forwards) != len(self.forwards):
+            raise ValueError(
+                "snapshot workflow has %d forward layers, live one has "
+                "%d — refusing to adopt" % (len(donor.forwards),
+                                            len(self.forwards)))
+
+        def copy_arrays(src_unit, dst_unit, names):
+            for name in names:
+                src = getattr(src_unit, name, None)
+                dst = getattr(dst_unit, name, None)
+                if src is None or dst is None or not src or not dst:
+                    continue
+                src.map_read()
+                dst.map_invalidate()
+                dst.mem = numpy.array(src.mem)
+
+        for live, old in zip(self.forwards, donor.forwards):
+            copy_arrays(old, live, ("weights", "bias"))
+        for live, old in zip(self.gds, donor.gds):
+            if live is None or old is None:
+                continue
+            copy_arrays(old, live, ("accum_weights", "accum_bias",
+                                    "accum2_weights", "accum2_bias"))
+
+    def on_divergence(self, reason):
+        """The decision watchdog's recovery hook: roll the model back
+        to the last verified snapshot, back off every layer's learning
+        rate, reseed the fused dropout stream, and clear the health
+        counters so the watchdog starts a fresh observation window.
+        Without a snapshotter (or with the rollback budget spent) this
+        raises — surviving bad math silently is not an option."""
+        from veles_tpu.health import DivergenceError
+        if self.snapshotter is None:
+            raise DivergenceError(
+                "training diverged (%s) and no snapshotter is attached "
+                "— nothing to roll back to" % reason)
+        path = self.snapshotter.rollback(reason=reason)
+        backoff = self.divergence_lr_backoff
+        for gd in self.gds:
+            if gd is None:
+                continue
+            gd.learning_rate *= backoff
+            gd.learning_rate_bias *= backoff
+            gd.reset_health_counters()
+        trainer = getattr(self, "fused_trainer", None)
+        if trainer is not None:
+            # recompiles against the restored Arrays and the
+            # backed-off hyperparameters, with a fresh dropout stream
+            trainer.reset_after_rollback(self.snapshotter.rollbacks)
+        self.decision.reset_divergence()
+        self.warning(
+            "divergence recovery: restored %s, learning rates *= %g "
+            "(rollback %d/%d); training continues", path, backoff,
+            self.snapshotter.rollbacks, self.snapshotter.rollback_budget)
 
     def link_plotters(self):
         """Attach the standard plotter set (reference Znicz standard

@@ -173,6 +173,11 @@ class _GDRecurrent(GradientDescent):
                 hyper["solver_epsilon"])
             new_state.update({"bias": new_b, "accum_bias": acc_b,
                               "accum2_bias": acc2_b})
+        # numerics guard: skip the update on non-finite gradients
+        # (docs/health.md; same semantics as the fully-connected family)
+        new_state = GradientDescentBase.finite_guard(
+            state, new_state, grad_w,
+            grad_b if include_bias else None)
         return err_input, new_state
 
 

@@ -4,9 +4,16 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.pallas import tpu as _pltpu
 
 __all__ = ["interpret_mode", "interpret_for", "pad_to", "unpad", "kernel_cast",
-           "ceil_mult"]
+           "ceil_mult", "tpu_compiler_params"]
+
+#: jax renamed TPUCompilerParams -> CompilerParams across releases;
+#: resolve whichever this jax ships so the kernels run on both
+tpu_compiler_params = getattr(
+    _pltpu, "CompilerParams", None) or getattr(
+    _pltpu, "TPUCompilerParams")
 
 
 def kernel_cast(x, dtype):

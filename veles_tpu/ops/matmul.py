@@ -27,6 +27,7 @@ Tile sizes come from the per-chip autotune table
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +35,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from veles_tpu.ops.common import (ceil_mult, interpret_for,
-                                   pad_to, unpad)
+                                   pad_to, tpu_compiler_params, unpad)
 
 __all__ = ["matmul", "matmul_benchmark", "autotune_matmul",
            "MATMUL_KERNEL_VERSION"]
@@ -143,9 +144,56 @@ def matmul(a, b, precision_level=0, blocks=None, out_dtype=None):
     decision needs the CONCRETE operand placement (CPU-committed arrays
     on a TPU-default host must interpret), which is invisible once
     everything is a tracer inside one jit.
+
+    Debug guard (docs/health.md): set ``VELES_DEBUG_NONFINITE=1`` and
+    every eager call validates its output, raising FloatingPointError
+    with per-operand stats when inf/NaN appears — the level-0 bf16x3
+    decomposition silently maps ``|x| >= bf16-max`` (and inf) to NaN,
+    which otherwise surfaces only steps later as a skipped update.
+    The check forces a device sync per call, so it is opt-in and for
+    debugging only.
     """
-    return _matmul_jit(a, b, precision_level, blocks, out_dtype,
-                       interpret_for(a, b))
+    out = _matmul_jit(a, b, precision_level, blocks, out_dtype,
+                      interpret_for(a, b))
+    if _DEBUG_NONFINITE:
+        _debug_check_finite(a, b, out, precision_level)
+    return out
+
+
+#: env-gated opt-in (read once at import; tests monkeypatch the module
+#: flag directly): the guard synchronizes on every call
+_DEBUG_NONFINITE = os.environ.get(
+    "VELES_DEBUG_NONFINITE", "") not in ("", "0")
+
+
+def _operand_stats(name, x):
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return "%s: %s %s" % (name, x.shape, x.dtype)
+    finite = jnp.isfinite(x)
+    n_bad = int(jnp.sum(~finite))
+    finite_abs = jnp.where(finite, jnp.abs(x), 0.0)
+    return ("%s: %s %s, %d non-finite, max|finite| %.6g" %
+            (name, x.shape, x.dtype, n_bad, float(jnp.max(finite_abs))
+             if x.size else 0.0))
+
+
+def _debug_check_finite(a, b, out, precision_level):
+    if not bool(jnp.isfinite(out).all()):
+        bf16_max = float(jnp.finfo(jnp.bfloat16).max)
+        hint = ""
+        if (precision_level == 0 and jnp.asarray(a).dtype ==
+                jnp.float32 and bool(jnp.isfinite(a).all()) and
+                bool(jnp.isfinite(b).all())):
+            hint = (" — operands are finite, so this is the level-0 "
+                    "bf16x3 domain limit (|x| >= %.4g maps to NaN); "
+                    "use precision_level >= 1 for operands this large"
+                    % bf16_max)
+        raise FloatingPointError(
+            "matmul produced non-finite output (%s)%s" % (
+                "; ".join((_operand_stats("lhs", a),
+                           _operand_stats("rhs", b),
+                           _operand_stats("out", out))), hint))
 
 
 @functools.partial(
@@ -185,7 +233,7 @@ def _matmul_jit(a, b, precision_level, blocks, out_dtype, interpret):
             pltpu.VMEM((bm, bn), jnp.float32),
             pltpu.VMEM((bm, bn), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(a, b)

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from veles_tpu.parallel.mesh import shard_map
+
 __all__ = ["moe_apply", "moe_reference", "init_moe_params",
            "shard_moe_params"]
 
@@ -95,7 +97,7 @@ def moe_apply(params, x, mesh, top_k=2, axis="expert",
         partial = jnp.einsum("be,ebf->bf", local_gates, local_out)
         return lax.psum(partial, axis).astype(x_full.dtype)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         sharded, mesh=mesh,
         in_specs=({"gate": P(), "w1": P(axis), "b1": P(axis),
                    "w2": P(axis), "b2": P(axis)}, P(data_axis)),

@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from veles_tpu.parallel.mesh import shard_map
+
 __all__ = ["pipeline_forward", "stack_stage_params",
            "stage_param_sharding"]
 
@@ -88,7 +90,7 @@ def pipeline_forward(stage_fn, params_stacked, x, mesh, microbatches,
             jnp.where(p == n_stages - 1, result, jnp.zeros_like(result)),
             axis)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         sharded, mesh=mesh,
         in_specs=(P(axis), P(data_axis)), out_specs=P(data_axis),
         check_vma=False)

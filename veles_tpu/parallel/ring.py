@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from veles_tpu.parallel.mesh import shard_map
+
 __all__ = ["ring_attention", "ulysses_attention", "attention_reference"]
 
 
@@ -106,7 +108,7 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False,
                           scale, q_s, k_s, v_s)
 
     spec = P(data_axis, seq_axis, head_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         sharded, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
     return fn(q, k, v)
@@ -138,7 +140,7 @@ def ulysses_attention(q, k, v, mesh, seq_axis="seq", causal=False,
         return gather_back(out)
 
     spec = P(data_axis, seq_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         sharded, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
     return fn(q, k, v)

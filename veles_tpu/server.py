@@ -24,7 +24,9 @@ import threading
 import time
 from collections import deque
 
-from veles_tpu import chaos
+import numpy
+
+from veles_tpu import chaos, health
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
@@ -82,6 +84,10 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         parser.add_argument(
             "--no-shm", action="store_true", default=None,
             help="disable the same-host shared-memory payload bypass")
+        parser.add_argument(
+            "--blacklist-ttl", type=float, default=None,
+            help="seconds a dropped/quarantined slave stays "
+                 "blacklisted before it may rejoin")
         return parser
 
     @classmethod
@@ -93,11 +99,13 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             cfg["codec"] = args.codec
         if getattr(args, "no_shm", None):
             cfg["shm"] = False
+        if getattr(args, "blacklist_ttl", None) is not None:
+            cfg["blacklist_ttl"] = args.blacklist_ttl
         root.common.network.update(cfg)
 
     def __init__(self, address, workflow, launcher=None, codec=None,
                  job_timeout=None, respawn_hook=None, secret=None,
-                 use_shm=None, shm_size=None):
+                 use_shm=None, shm_size=None, blacklist_ttl=None):
         super(Server, self).__init__()
         net = root.common.network
         self.host, self.port = parse_address(address)
@@ -114,7 +122,17 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             else net.get("job_timeout", 60.0)
         self.respawn_hook = respawn_hook
         self.secret = secret if secret is not None else default_secret()
-        self.blacklist = set()
+        # mid -> expiry timestamp: blacklisting is a QUARANTINE with a
+        # TTL, not a life sentence — a once-slow machine (or one that
+        # sent one poisoned update) may rejoin after it expires
+        self.blacklist_ttl = blacklist_ttl if blacklist_ttl is not None \
+            else net.get("blacklist_ttl", 30.0)
+        self.blacklist = {}
+        #: per-slave consecutive respawn attempts (mid -> count); the
+        #: respawn delay backs off on THIS, not on global blacklist
+        #: size, and resets once the slave applies a productive update
+        self._respawn_attempts = {}
+        self.quarantined = 0
         self.slaves = {}
         self._waiting = deque()     # parked requesters (sync points)
         self._all_job_times = deque(maxlen=500)
@@ -275,6 +293,22 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             await self._apply_update(conn, msg, payload)
         return conn
 
+    def _blacklist(self, mid):
+        self.blacklist[mid] = time.time() + self.blacklist_ttl
+
+    def _blacklisted(self, mid):
+        """True while ``mid``'s quarantine TTL has not expired; expired
+        entries are dropped on the way (the slave may rejoin)."""
+        expiry = self.blacklist.get(mid)
+        if expiry is None:
+            return False
+        if time.time() >= expiry:
+            del self.blacklist[mid]
+            self.info("blacklist TTL expired for slave %s; eligible "
+                      "to rejoin", mid)
+            return False
+        return True
+
     async def _handshake(self, msg, reader, writer):
         checksum = msg.get("checksum")
         mid = msg.get("mid", "?")
@@ -283,10 +317,15 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             self._send(writer, {"type": "reject",
                                 "reason": "checksum mismatch"})
             return None
-        if mid in self.blacklist:
-            self.warning("rejecting blacklisted slave %s", mid)
+        if self._blacklisted(mid):
+            retry_after = max(self.blacklist[mid] - time.time(), 0.0)
+            self.warning("rejecting blacklisted slave %s (%.1fs left)",
+                         mid, retry_after)
+            # retry_after marks the rejection TRANSIENT: the client
+            # sleeps it out and retries instead of giving up for good
             self._send(writer, {"type": "reject",
-                                "reason": "blacklisted"})
+                                "reason": "blacklisted",
+                                "retry_after": retry_after})
             return None
         sid = new_id()
         slave = SlaveDescription(sid, mid, msg.get("pid", 0),
@@ -367,10 +406,31 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             elapsed = time.time() - started
             conn.job_times.append(elapsed)
             self._all_job_times.append(elapsed)
+        # numerics quarantine (docs/health.md): validate BEFORE
+        # apply_data_from_slave — a NaN delta merged into the global
+        # weights poisons every other slave's next job.  The offender
+        # is dropped and TTL-blacklisted; its reserved minibatch
+        # requeues exactly like a slave death, so recovery is exact.
+        if not await self._in_thread(health.all_finite, update):
+            self.quarantined += 1
+            self._blacklist(conn.slave.mid)
+            self.warning(
+                "quarantining slave %s (mid %s): non-finite update "
+                "payload dropped, blacklisted for %.0fs",
+                conn.slave.id[:8], conn.slave.mid, self.blacklist_ttl)
+            self._send(conn.writer, {"type": "update_ack", "result": 0})
+            self._drop(conn, "poisoned update")
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+            return
         try:
             result = await self._in_thread(
                 self.workflow.apply_data_from_slave, update, conn.slave)
             self.updates_applied += 1
+            # a productive update resets the slave's respawn backoff
+            self._respawn_attempts.pop(conn.slave.mid, None)
             self._send(conn.writer, {"type": "update_ack",
                                      "result": 1 if result else 0})
         except Exception:
@@ -412,8 +472,9 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                 if overdue:
                     self.warning(
                         "slave %s exceeded %.1fs timeout; dropping + "
-                        "blacklisting", conn.slave.id[:8], threshold)
-                    self.blacklist.add(conn.slave.mid)
+                        "blacklisting for %.0fs", conn.slave.id[:8],
+                        threshold, self.blacklist_ttl)
+                    self._blacklist(conn.slave.mid)
                     self._drop(conn, "timeout")
                     try:
                         conn.writer.close()
@@ -421,12 +482,22 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                         pass
 
     def _timeout_threshold(self):
+        # numpy is imported at module scope: this runs every 0.5 s on
+        # the watchdog tick, and repeated `import` statements still pay
+        # a sys.modules lookup + lock on a hot loop
         times = list(self._all_job_times)
         if len(times) < 4:
             return self.job_timeout
-        import numpy
         arr = numpy.array(times)
         return max(float(arr.mean() + 3 * arr.std()), self.job_timeout)
+
+    def _respawn_delay(self, mid):
+        """Exponential backoff on THIS slave's consecutive respawns
+        (reset by a productive update) — keying it on global blacklist
+        size punished healthy slaves for unrelated machines' sins."""
+        attempts = self._respawn_attempts.get(mid, 0) + 1
+        self._respawn_attempts[mid] = attempts
+        return min(2.0 ** attempts, 30.0)
 
     def _drop(self, conn, reason):
         if self.slaves.pop(conn.slave.id, None) is None:
@@ -444,7 +515,7 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             self._loop.call_soon_threadsafe(
                 lambda: asyncio.ensure_future(self._release_parked()))
         if self.respawn_hook is not None and not self._finishing:
-            delay = min(2.0 ** len(self.blacklist), 30.0)
+            delay = self._respawn_delay(conn.slave.mid)
             self._loop.call_later(
                 delay, lambda: self.respawn_hook(conn.slave))
 

@@ -38,11 +38,12 @@ import time
 
 from veles_tpu import chaos
 from veles_tpu.config import root
+from veles_tpu.health import RollbackExhausted
 from veles_tpu.mutable import Bool
 from veles_tpu.units import Unit
 
 __all__ = ["SnapshotterBase", "Snapshotter", "SnapshotError",
-           "MANIFEST_SUFFIX"]
+           "RollbackExhausted", "MANIFEST_SUFFIX"]
 
 #: sidecar manifest filename suffix (next to the snapshot it describes)
 MANIFEST_SUFFIX = ".manifest"
@@ -194,6 +195,10 @@ class SnapshotterBase(Unit):
             help="retain only the newest N snapshots (plus the "
                  "best-by-metric and the _current target); 0 keeps "
                  "everything")
+        parser.add_argument(
+            "--rollback-budget", type=int, default=None, metavar="N",
+            help="in-process divergence rollbacks allowed before the "
+                 "run hard-fails (docs/health.md)")
         return parser
 
     @classmethod
@@ -211,6 +216,8 @@ class SnapshotterBase(Unit):
             cfg["db"] = args.snapshot_db
         if getattr(args, "snapshot_keep", None) is not None:
             cfg["keep"] = args.snapshot_keep
+        if getattr(args, "rollback_budget", None) is not None:
+            cfg["rollback_budget"] = args.rollback_budget
         root.common.snapshot.update(cfg)
         if getattr(args, "disable_snapshotting", False):
             root.common.disable.update({"snapshotting": True})
@@ -231,10 +238,15 @@ class SnapshotterBase(Unit):
         # best-by-metric snapshot and the _current target always survive
         self.keep = kwargs.pop("keep", cfg.get("keep", 0))
         self.keep_best = kwargs.pop("keep_best", True)
+        # divergence recovery (docs/health.md): in-process rollbacks
+        # allowed before the run hard-fails with RollbackExhausted
+        self.rollback_budget = kwargs.pop(
+            "rollback_budget", cfg.get("rollback_budget", 3))
         super(SnapshotterBase, self).__init__(workflow, **kwargs)
         self.skip = Bool(False)
         self.suffix = None
         self.destination = None
+        self.rollbacks = 0
         self._counter = 0
         self._exports = 0
         self._last_time = 0.0
@@ -566,6 +578,56 @@ class SnapshotterBase(Unit):
             return sorted(targets, reverse=True)[0][1]
         verified = SnapshotterBase._verified_snapshots(directory)
         return verified[0] if verified else None
+
+    # -- in-process divergence rollback (docs/health.md) --------------------
+
+    def rollback(self, reason=""):
+        """Restore the newest manifest-VERIFIED snapshot's model state
+        into the LIVE workflow, in process — the decision watchdog's
+        recovery path when training diverges (sustained non-finite
+        steps, loss spike).
+
+        Unlike ``--resume`` this does not replace the workflow object:
+        the run keeps its loader position and epoch bookkeeping and
+        only the model state (params + solver accumulators) rolls back,
+        via the workflow's ``adopt_model_state`` hook; the caller then
+        applies LR backoff and reseeds stochastic streams so the retry
+        is not a bit-exact replay of the divergence.  Bounded by
+        ``rollback_budget``: when the budget is spent the run
+        HARD-FAILS with :class:`RollbackExhausted` — looping rollback
+        -> divergence forever is worse than dying loudly."""
+        self.rollbacks += 1
+        if self.rollbacks > self.rollback_budget:
+            raise RollbackExhausted(
+                "rollback budget exhausted (%d allowed) and training "
+                "still diverges: %s" % (self.rollback_budget, reason))
+        adopt = getattr(self.workflow, "adopt_model_state", None)
+        if adopt is None:
+            raise SnapshotError(
+                "cannot roll back: workflow %s has no "
+                "adopt_model_state hook" % type(self.workflow).__name__)
+        errors = []
+        for path in self._iter_verified_snapshots(self.directory):
+            if not os.path.basename(path).startswith(self.prefix + "_"):
+                continue
+            try:
+                # verified just above by the iterator: no fallback
+                # cascade — each candidate stands or falls alone
+                restored = self.import_file(path, fallback=False)
+                adopt(restored)
+            except Exception as exc:
+                self.warning("rollback candidate %s unusable (%s: %s)",
+                             path, type(exc).__name__, exc)
+                errors.append("%s: %s" % (path, exc))
+                continue
+            self.warning(
+                "rolled back model state to verified snapshot %s "
+                "[%d/%d, reason: %s]", path, self.rollbacks,
+                self.rollback_budget, reason or "unspecified")
+            return path
+        raise SnapshotError(
+            "no verified snapshot to roll back to in %s (%s)" %
+            (self.directory, "; ".join(errors) or "none found"))
 
 
 class Snapshotter(SnapshotterBase):
