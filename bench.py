@@ -177,12 +177,19 @@ def _slope(run_chain, n1, n2, repeats=5):
 
 
 def _spread(samples):
-    """{median, min, max, passes} for a list of slope samples — makes
-    cross-round headline deltas readable as congestion vs regression."""
-    return {"median": round(float(numpy.median(samples)), 9),
-            "min": round(float(min(samples)), 9),
-            "max": round(float(max(samples)), 9),
-            "passes": len(samples)}
+    """{median, min, max, p50/p95/p99, passes} for a list of slope
+    samples — makes cross-round headline deltas readable as congestion
+    vs regression, and records the step-time DISTRIBUTION (nearest-rank
+    percentiles via the shared observe helper) rather than one central
+    value per row."""
+    from veles_tpu.observe.metrics import percentiles
+    out = {"median": round(float(numpy.median(samples)), 9),
+           "min": round(float(min(samples)), 9),
+           "max": round(float(max(samples)), 9),
+           "passes": len(samples)}
+    out.update({key: round(float(value), 9)
+                for key, value in percentiles(samples).items()})
+    return out
 
 
 _DISPATCH_FLOOR = None
@@ -666,7 +673,13 @@ def _pipeline_workflow(input_shape, hidden, classes, batch, train_n,
 def _pipeline_ab_row(input_shape, hidden, classes, batch, train_n,
                      valid_n, chain_lens):
     """One A/B row: per-step slope of loader.run+trainer.run with the
-    pipeline off, then on, over the SAME synthetic workload."""
+    pipeline off, then on, over the SAME synthetic workload.
+
+    Besides the slope, each leg publishes its per-dispatch step-time
+    distribution from the telemetry registry's ``step.train_s``
+    histogram (the same series the heartbeat reports), so the row
+    carries p50/p95/p99 of what the trainer actually measured."""
+    from veles_tpu.observe.metrics import registry
     row = {}
     for key, pipeline in (("off", False), ("on", True)):
         sw = _pipeline_workflow(input_shape, hidden, classes, batch,
@@ -678,6 +691,8 @@ def _pipeline_ab_row(input_shape, hidden, classes, batch, train_n,
             loader.run()
             trainer.run()
         float(trainer.last_loss or 0.0)
+        step_hist = registry.histogram("step.train_s")
+        step_hist.reset()  # drop warmup/compile observations
 
         def chain(k):
             start = time.perf_counter()
@@ -695,6 +710,12 @@ def _pipeline_ab_row(input_shape, hidden, classes, batch, train_n,
             "pipeline_%s_%s" % ("x".join(map(str, input_shape)), key))
         row["%s_step_s" % key] = round(per_step, 9)
         row["%s_spread" % key] = _spread(samples)
+        row["%s_samples_per_sec" % key] = round(batch / per_step, 1)
+        snap = step_hist.snapshot()
+        if snap["count"]:
+            row["%s_dispatch_hist" % key] = {
+                k: (round(v, 9) if isinstance(v, float) else v)
+                for k, v in snap.items() if v is not None}
         if pipeline and trainer._prefetcher is not None:
             stats = trainer._prefetcher.stats
             serves = max(1, stats["serves"])

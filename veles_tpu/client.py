@@ -18,6 +18,8 @@ from veles_tpu import chaos
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.network_common import (
     ProtocolError, ShmChannel, default_secret, machine_id, pack_payload,
     parse_address, read_frame, unpack_payload, write_frame)
@@ -282,6 +284,8 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                     self.warning("fault injection: dying on job %d",
                                  self.jobs_done + 1)
                     raise ConnectionResetError("injected death (chaos)")
+            _tracer.instant("proto.job_in", cat="proto",
+                            job=str(msg.get("job_id") or "")[:8])
             data = unpack_payload(payload, msg.get("codec", "none"))
             if self.async_slave:
                 # pipeline: ask for the next job before running this one
@@ -304,6 +308,9 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             self._send(writer, {
                 "type": "update", "job_id": msg.get("job_id"),
                 "codec": self.codec}, payload=update)
+            _registry.counter("client.jobs_done").inc()
+            _tracer.instant("proto.update_out", cat="proto",
+                            job=str(msg.get("job_id") or "")[:8])
             if not self.async_slave:
                 self._send(writer, {"type": "job_request"})
 

@@ -14,6 +14,7 @@ jobs are expected to be launched by the cluster scheduler with
 keeps job-level spawn hooks for genetics/ensemble child processes.
 """
 
+import os
 import threading
 import time
 
@@ -44,6 +45,18 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.notification_interval = float(kwargs.get(
             "notification_interval",
             root.common.web.get("notification_interval", 1)))
+        # telemetry (docs/observability.md): --trace / --metrics-* land
+        # in root.common.observe via apply_args; kwargs override for
+        # programmatic use
+        obs = root.common.observe
+        self.trace_path = kwargs.get("trace", obs.get("trace", ""))
+        self.metrics_interval = float(kwargs.get(
+            "metrics_interval", obs.get("metrics_interval", 0)) or 0)
+        self.metrics_path = kwargs.get(
+            "metrics_path", obs.get("metrics_path", ""))
+        self.profile_dir = kwargs.get(
+            "profile", obs.get("profile", "")) or \
+            os.environ.get("VELES_PROFILE", "")
         self._workflow = None
         self.device = None
         self.stopped = False
@@ -67,6 +80,27 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             help="URL of a WebStatusServer to post periodic session "
                  "status to (reference launcher.py:852-885)")
         parser.add_argument(
+            "--trace", default="", metavar="PATH",
+            help="write a Chrome/Perfetto trace of this run (unit runs, "
+                 "fused steps, prefetcher stages, snapshot writes, "
+                 "protocol events) to PATH; zero overhead when unset")
+        parser.add_argument(
+            "--metrics-interval", type=float, default=0, metavar="N",
+            help="emit a JSONL telemetry heartbeat every N seconds "
+                 "(step-time percentiles, throughput, health counters); "
+                 "0 disables")
+        parser.add_argument(
+            "--metrics-path", default="", metavar="PATH",
+            help="heartbeat JSONL destination (default: <trace>."
+                 "heartbeat.jsonl next to --trace, else "
+                 "veles_heartbeat.jsonl)")
+        parser.add_argument(
+            "--profile", default="", metavar="DIR",
+            help="capture a jax.profiler trace into DIR around a "
+                 "window of fused train steps (also VELES_PROFILE=DIR; "
+                 "window via VELES_PROFILE_WINDOW=start:stop, "
+                 "default 5:25)")
+        parser.add_argument(
             "--resume", default="", metavar="auto|PATH",
             help="restore the workflow from a snapshot before "
                  "initialize: 'auto' resumes from the newest validated "
@@ -82,6 +116,12 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "listen_address": getattr(args, "listen_address", ""),
             "master_address": getattr(args, "master_address", ""),
             "web_status": getattr(args, "web_status", ""),
+        })
+        root.common.observe.update({
+            "trace": getattr(args, "trace", ""),
+            "metrics_interval": getattr(args, "metrics_interval", 0),
+            "metrics_path": getattr(args, "metrics_path", ""),
+            "profile": getattr(args, "profile", ""),
         })
         if getattr(args, "resume", ""):
             root.common.snapshot.update({"resume": args.resume})
@@ -261,6 +301,46 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             target=loop, daemon=True, name="status-reporter")
         self._reporter_thread.start()
 
+    def _start_telemetry(self):
+        """Run-scoped observability (docs/observability.md): the span
+        tracer behind ``--trace``, the heartbeat behind
+        ``--metrics-interval``, and the jax.profiler window behind
+        ``--profile`` / VELES_PROFILE.  Returns the heartbeat (or
+        None); everything else is process-global."""
+        from veles_tpu import observe
+        if self.trace_path:
+            observe.tracer.start()
+        if self.profile_dir:
+            observe.install_profiler(
+                observe.ProfilerHook(self.profile_dir))
+        if self.metrics_interval > 0:
+            path = self.metrics_path or (
+                self.trace_path + ".heartbeat.jsonl"
+                if self.trace_path else "veles_heartbeat.jsonl")
+            heartbeat = observe.Heartbeat(
+                path, self.metrics_interval, workflow=self._workflow)
+            heartbeat.start()
+            self.info("telemetry heartbeat -> %s every %.3g s",
+                      path, self.metrics_interval)
+            return heartbeat
+        return None
+
+    def _stop_telemetry(self, heartbeat):
+        from veles_tpu import observe
+        if heartbeat is not None:
+            heartbeat.stop()
+        if self.profile_dir:
+            observe.uninstall_profiler()
+        if self.trace_path:
+            observe.tracer.stop()
+            try:
+                observe.tracer.save(self.trace_path)
+                self.info("trace written to %s (%d events)",
+                          self.trace_path, len(observe.tracer.events))
+            except OSError as exc:
+                self.error("failed to write trace %s: %s",
+                           self.trace_path, exc)
+
     def run(self):
         if not self.initialized:
             self.initialize()
@@ -269,8 +349,13 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.stopped = False
         from veles_tpu.thread_pool import ThreadPool
         ThreadPool.sigint_hook = self.stop
-        self._start_status_reporter()
+        heartbeat = None
         try:
+            # inside the try: a failure here must still reach the
+            # finally that stops the heartbeat/tracer and writes the
+            # --trace file, not leak them enabled into the process
+            heartbeat = self._start_telemetry()
+            self._start_status_reporter()
             if self._agent is not None:
                 self._agent.run()  # blocks until the session ends
             else:
@@ -283,6 +368,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                 self._reporter_stop.set()
                 self._reporter_thread.join(timeout=5)
                 self._reporter_thread = None
+            self._stop_telemetry(heartbeat)
         elapsed = time.time() - self.start_time
         self.info("session finished in %.1f s", elapsed)
         self._workflow.print_stats()

@@ -20,6 +20,7 @@ backoff in StandardWorkflow) when training has gone off the rails.
 from veles_tpu.health import DivergenceError, is_finite_metric
 from veles_tpu.loader.base import CLASS_NAME, TRAIN, VALID
 from veles_tpu.mutable import Bool
+from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.units import Unit
 
 __all__ = ["DecisionBase", "DecisionGD", "DecisionMSE"]
@@ -92,11 +93,23 @@ class DecisionBase(Unit):
         self.gd_skip <<= (self.minibatch_class != TRAIN)
         self._accumulate_minibatch()
         if bool(self.last_minibatch):
-            cls = self.minibatch_class
-            self.epoch_metrics[cls] = self._epoch_class_metric(cls)
-            self._on_class_ended(cls)
+            self._record_class_metric(self.minibatch_class)
+            self._on_class_ended(self.minibatch_class)
         if bool(self.epoch_ended):
             self._on_epoch_ended()
+
+    def _record_class_metric(self, cls):
+        """Finished class: compute the metric and publish it to the
+        telemetry registry (here and in the master's
+        apply_data_from_slave path — already a plain float, the
+        class-end sync happened in _epoch_class_metric).  Non-finite
+        metrics stay out of the gauge: the heartbeat/status files must
+        remain strict JSON, and the watchdog reports the divergence
+        through its own channel."""
+        metric = self._epoch_class_metric(cls)
+        self.epoch_metrics[cls] = metric
+        if metric is not None and is_finite_metric(metric):
+            _registry.gauge("metric.%s" % CLASS_NAME[cls]).set(metric)
 
     @staticmethod
     def _metric_improves(metric, best):
@@ -140,6 +153,11 @@ class DecisionBase(Unit):
         for unit in self.health_sources:
             total += int(unit.skip_count)
             consec = max(consec, int(unit.consecutive_skips))
+        # publish to the telemetry registry HERE — this is the existing
+        # once-per-class device sync, so dashboards/heartbeats read the
+        # counters as plain ints without ever touching the device
+        _registry.gauge("health.skip_count").set(total)
+        _registry.gauge("health.consecutive_skips").set(consec)
         return total, consec
 
     def _check_divergence(self):
@@ -218,6 +236,7 @@ class DecisionBase(Unit):
         }
 
     def _on_epoch_ended(self):
+        _registry.gauge("train.epoch").set(int(self.epoch_number))
         self.info("Epoch %d metrics: test %s, validation %s, train %s",
                   self.epoch_number,
                   self.epoch_metrics[0], self.epoch_metrics[1],
@@ -292,9 +311,11 @@ class DecisionGD(DecisionBase):
         for i, n in enumerate(data.get("n_err", ())):
             self.epoch_n_err[i] += n
         if bool(self.last_minibatch):
-            cls = self.minibatch_class
-            self.epoch_metrics[cls] = self._epoch_class_metric(cls)
-            self._on_class_ended(cls)
+            # same class-end path as run(): the master's telemetry
+            # (metric gauges, health counters) must not go dark just
+            # because the hot loop runs on the slaves
+            self._record_class_metric(self.minibatch_class)
+            self._on_class_ended(self.minibatch_class)
         if bool(self.epoch_ended):
             self._on_epoch_ended()
         if bool(self.complete) and self.workflow is not None:

@@ -12,10 +12,15 @@ besides the scalar metrics the decision unit needs.
 every already-written config gains the fused path without changes.
 """
 
+import time
+
 import numpy
 
 from veles_tpu import chaos
 from veles_tpu.loader.base import TRAIN
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.observe.profile import profiler_step
+from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.units import Unit
 
 __all__ = ["FusedTrainer", "fuse_standard_workflow"]
@@ -53,6 +58,17 @@ class FusedTrainer(Unit):
         self.mse_sum = 0.0
         self.n_samples = 0
         self.last_loss = None
+
+    def init_unpickled(self):
+        super(FusedTrainer, self).init_unpickled()
+        # telemetry handles (trailing underscore: transient, re-created
+        # after unpickling).  The step histograms measure the graph
+        # thread's dispatch wall time — the honest steady-state step
+        # time under device backpressure, with zero extra host syncs
+        self._m_train_step_ = _registry.histogram("step.train_s")
+        self._m_eval_step_ = _registry.histogram("step.eval_s")
+        self._m_steps_ = _registry.counter("train.steps")
+        self._m_samples_ = _registry.counter("train.samples")
 
     def initialize(self, device=None, **kwargs):
         self.device = device
@@ -114,9 +130,11 @@ class FusedTrainer(Unit):
     def run(self):
         import jax
 
+        t0 = time.perf_counter()
         if self._step_fn is None:
             self._compile()
         loader = self.sw.loader
+        is_train = loader.minibatch_class == TRAIN
         prefetched = (self._prefetcher.current
                       if self._prefetcher is not None else None)
         if prefetched is not None:
@@ -133,7 +151,7 @@ class FusedTrainer(Unit):
                 target = loader.minibatch_targets.device_array(self.device)
         batch_size = numpy.float32(loader.minibatch_size)
 
-        if loader.minibatch_class == TRAIN:
+        if is_train:
             self._iteration += 1
             key = None
             if self._has_dropout:
@@ -190,6 +208,19 @@ class FusedTrainer(Unit):
                 self.mse_sum = self._eval_metrics(
                     params, x, target, batch_size)
         self.n_samples = int(batch_size)
+        elapsed = time.perf_counter() - t0
+        if is_train:
+            self._m_train_step_.observe(elapsed)
+            self._m_steps_.inc()
+            self._m_samples_.inc(self.n_samples)
+            profiler_step()
+        else:
+            self._m_eval_step_.observe(elapsed)
+        if _tracer.enabled:
+            _tracer.complete(
+                "fused.train_step" if is_train else "fused.eval_step",
+                t0, elapsed, cat="step",
+                args={"iteration": self._iteration})
 
     def reset_health_counters(self):
         """Zero the skip accounting (after the decision's divergence

@@ -1,0 +1,47 @@
+"""Unified telemetry layer: span tracing, metrics, profiler hooks.
+
+One measurement substrate for the whole system (docs/observability.md),
+replacing the three ad-hoc timer systems that grew organically: the
+workflow's method/unit wall timers, pipeline_input's per-stage
+perf_counter deltas, and the health watchdog's decision-unit-only lazy
+device counters.  Three pieces:
+
+- :mod:`veles_tpu.observe.trace` — a thread-safe span tracer with a
+  context-manager + decorator API emitting Chrome trace-event JSON
+  (loadable in Perfetto / chrome://tracing) with per-thread tracks and
+  zero overhead when disabled;
+- :mod:`veles_tpu.observe.metrics` — a registry of counters, gauges
+  and windowed histograms (step-time percentiles, throughput, health
+  counts, queue depths).  Device scalars enter the registry only at
+  the EXISTING lazy-metric sync points (decision class end,
+  snapshotter rollback, server quarantine) — the registry never adds a
+  host sync to the hot path;
+- :mod:`veles_tpu.observe.profile` — ``jax.profiler`` start/stop
+  around a configurable step window (``VELES_PROFILE=dir`` /
+  ``VELES_PROFILE_WINDOW=start:stop``) and the periodic JSONL
+  heartbeat (``--metrics-interval N``) consumed by web_status.py
+  dashboards and offline tooling.
+
+Everything here is stdlib-only and import-light, so hot modules
+(units, pipeline_input, compiler-adjacent code) can import it without
+dragging in jax.
+"""
+
+from veles_tpu.observe.metrics import (Counter, Gauge, Histogram,
+                                       MetricsRegistry, health_snapshot,
+                                       percentiles, registry)
+from veles_tpu.observe.profile import (HEARTBEAT_SCHEMA_VERSION, Heartbeat,
+                                       ProfilerHook, install_profiler,
+                                       profiler_step, uninstall_profiler,
+                                       validate_heartbeat)
+from veles_tpu.observe.trace import (SpanTracer, instant, span, traced,
+                                     tracer, validate_trace)
+
+__all__ = [
+    "SpanTracer", "tracer", "span", "instant", "traced", "validate_trace",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "percentiles", "health_snapshot",
+    "ProfilerHook", "install_profiler", "uninstall_profiler",
+    "profiler_step", "Heartbeat", "validate_heartbeat",
+    "HEARTBEAT_SCHEMA_VERSION",
+]

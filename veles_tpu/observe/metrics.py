@@ -1,0 +1,214 @@
+"""Metrics registry: counters, gauges, and windowed histograms.
+
+The numeric side of the telemetry layer: step-time percentiles,
+samples/sec throughput, skip/rollback/quarantine counts, queue depths.
+Observing a value is a lock + a few attribute writes (sub-microsecond),
+so instrumented hot paths stay hot; reading never blocks a writer for
+longer than one observation.
+
+Device-scalar rule (docs/observability.md): values that live on the
+accelerator (skip counters, grad norms) enter the registry ONLY at the
+existing lazy-metric sync points — the decision unit's class-end sync,
+the snapshotter's rollback, the server's quarantine check — as the
+plain Python numbers those paths already concretized.  The registry
+itself never calls ``int()``/``float()`` on a device array, so it can
+never add a host sync to the step path.
+"""
+
+import math
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "registry", "percentiles", "health_snapshot"]
+
+
+def percentiles(samples, ps=(50, 95, 99)):
+    """Nearest-rank percentiles of a sequence as ``{"p50": ...}``.
+
+    Plain-Python so import-light callers (bench.py's slope spreads, the
+    histogram snapshots) share ONE definition; on tiny sample sets the
+    nearest-rank convention degrades gracefully (p95/p99 of 5 samples
+    are both the max) instead of inventing interpolated values.
+    """
+    if not samples:
+        return {}
+    data = sorted(samples)
+    n = len(data)
+    return {"p%d" % p:
+            data[max(0, min(n, int(math.ceil(p / 100.0 * n))) - 1)]
+            for p in ps}
+
+
+class Counter(object):
+    """Monotonic counter (events, samples, protocol messages)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge(object):
+    """Last-value metric (queue depth, budget remaining, epoch)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def set(self, value):
+        self._value = value
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram(object):
+    """Windowed distribution: lifetime count/sum plus a ring buffer of
+    the most recent ``window`` observations for percentile queries."""
+
+    __slots__ = ("name", "_lock", "_window", "_buf", "_pos",
+                 "count", "total", "min", "max")
+
+    def __init__(self, name, window=1024):
+        self.name = name
+        self._lock = threading.Lock()
+        self._window = max(1, int(window))
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._buf = []
+            self._pos = 0
+            self.count = 0
+            self.total = 0.0
+            self.min = None
+            self.max = None
+
+    def observe(self, value):
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += value
+            if self.min is None or value < self.min:
+                self.min = value
+            if self.max is None or value > self.max:
+                self.max = value
+            if len(self._buf) < self._window:
+                self._buf.append(value)
+            else:
+                self._buf[self._pos] = value
+                self._pos = (self._pos + 1) % self._window
+
+    def window_values(self):
+        with self._lock:
+            return list(self._buf)
+
+    def snapshot(self):
+        """{"count","mean","min","max","p50","p95","p99"} — count/mean
+        over the lifetime, percentiles over the recent window."""
+        with self._lock:
+            buf = list(self._buf)
+            count, total = self.count, self.total
+            lo, hi = self.min, self.max
+        out = {"count": count,
+               "mean": (total / count) if count else None,
+               "min": lo, "max": hi}
+        out.update(percentiles(buf))
+        return out
+
+
+class MetricsRegistry(object):
+    """Named get-or-create store for the three metric kinds."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get(self, name, factory, kind):
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = self._metrics[name] = factory()
+            elif not isinstance(metric, kind):
+                raise TypeError(
+                    "metric %r already registered as %s" %
+                    (name, type(metric).__name__))
+            return metric
+
+    def counter(self, name):
+        return self._get(name, lambda: Counter(name), Counter)
+
+    def gauge(self, name):
+        return self._get(name, lambda: Gauge(name), Gauge)
+
+    def histogram(self, name, window=1024):
+        return self._get(
+            name, lambda: Histogram(name, window=window), Histogram)
+
+    def peek(self, name):
+        """The metric if it was ever registered, else None — readers
+        (health_snapshot, dashboards) must not create empty metrics."""
+        return self._metrics.get(name)
+
+    def snapshot(self):
+        """Plain-data view: {"counters": {...}, "gauges": {...},
+        "histograms": {name: {count, mean, p50, ...}}}."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, metric in sorted(metrics.items()):
+            if isinstance(metric, Counter):
+                out["counters"][name] = metric.value
+            elif isinstance(metric, Gauge):
+                if metric.value is not None:
+                    out["gauges"][name] = metric.value
+            else:
+                out["histograms"][name] = metric.snapshot()
+        return out
+
+    def reset(self):
+        """Drop every metric (tests / bench A-B legs start clean)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+#: The process-wide registry every subsystem publishes into.
+registry = MetricsRegistry()
+
+#: Health keys surfaced to dashboards: registry name -> short name.
+_HEALTH_KEYS = (
+    ("health.skip_count", "skip_count"),
+    ("health.consecutive_skips", "consecutive_skips"),
+    ("health.rollbacks_remaining", "rollbacks_remaining"),
+    ("health.rollbacks", "rollbacks"),
+    ("server.blacklist_size", "blacklist_size"),
+    ("server.quarantined", "quarantined"),
+)
+
+
+def health_snapshot(reg=None):
+    """The PR-3 numerics-health counters as a flat dict for the
+    web-status posts and the heartbeat line: skip counts published by
+    the decision unit at its class-end sync, rollback budget remaining
+    by the snapshotter, blacklist/quarantine sizes by the server.
+    Only counters that were actually published appear."""
+    reg = reg if reg is not None else registry
+    out = {}
+    for name, short in _HEALTH_KEYS:
+        metric = reg.peek(name)
+        if metric is not None and metric.value is not None:
+            out[short] = metric.value
+    return out

@@ -1,0 +1,270 @@
+"""Span tracer: Chrome trace-event JSON, viewable in Perfetto.
+
+Records *complete* events ("ph": "X") with microsecond timestamps on a
+``time.perf_counter`` base — the same clock the unit/pipeline timers
+use, so a span's ``dur`` agrees with the accumulated timer it rides on.
+Each thread gets its own track (a ``thread_name`` metadata event is
+emitted on first sight), so the prefetch worker's fill/H2D spans render
+on a separate lane from the graph thread's unit-run spans and the
+overlap is visible directly.
+
+Design rules:
+
+- **zero overhead when disabled**: ``tracer.enabled`` is a plain bool;
+  hot call sites guard on it (one attribute load) and every public
+  method returns immediately when tracing is off.  ``span()`` returns
+  a shared no-op context manager;
+- **no locks on the hot path**: event dicts are appended to a plain
+  list (``list.append`` is atomic under the GIL); the lock guards only
+  start/save and first-sight thread registration;
+- **bounded memory**: past ``max_events`` new events are counted as
+  dropped instead of growing the buffer without bound.
+
+The module-level :data:`tracer` singleton is the instance the whole
+system instruments against; ``--trace PATH`` (launcher.py) starts it
+and saves the file at run end.
+"""
+
+import functools
+import json
+import os
+import threading
+import time
+
+__all__ = ["SpanTracer", "tracer", "span", "instant", "traced",
+           "validate_trace"]
+
+
+class _NullSpan(object):
+    """Shared no-op context manager returned while tracing is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span(object):
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_start")
+
+    def __init__(self, owner, name, cat, args):
+        self._tracer = owner
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer.complete(
+            self._name, self._start, time.perf_counter() - self._start,
+            cat=self._cat, args=self._args)
+        return False
+
+
+class SpanTracer(object):
+    """Thread-safe trace-event recorder with a Perfetto-loadable dump."""
+
+    def __init__(self, max_events=1000000):
+        self.enabled = False
+        self.dropped = 0
+        self._max_events = max_events
+        self._events = []
+        self._lock = threading.Lock()
+        self._epoch = time.perf_counter()
+        self._pid = os.getpid()
+        self._tids = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Clear any previous events and begin recording."""
+        with self._lock:
+            self._events = []
+            self._tids = {}
+            self.dropped = 0
+            self._epoch = time.perf_counter()
+            self.enabled = True
+        return self
+
+    def stop(self):
+        self.enabled = False
+        return self
+
+    @property
+    def events(self):
+        return list(self._events)
+
+    # -- recording ---------------------------------------------------------
+
+    def _tid(self):
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.get(ident)
+                if tid is None:
+                    tid = len(self._tids) + 1
+                    self._tids[ident] = tid
+            self._append({
+                "name": "thread_name", "ph": "M", "pid": self._pid,
+                "tid": tid,
+                "args": {"name": threading.current_thread().name}})
+        return tid
+
+    def _append(self, event):
+        if len(self._events) >= self._max_events:
+            self.dropped += 1
+            return
+        self._events.append(event)
+
+    def _ts(self, when):
+        return (when - self._epoch) * 1e6
+
+    def complete(self, name, start, dur, cat="span", args=None):
+        """Record a complete ("X") event from perf_counter timings —
+        the primitive every instrumented timer calls, so the trace and
+        the accumulated timers always report the SAME measurement."""
+        if not self.enabled:
+            return
+        event = {"name": name, "cat": cat, "ph": "X",
+                 "ts": self._ts(start), "dur": dur * 1e6,
+                 "pid": self._pid, "tid": self._tid()}
+        if args:
+            event["args"] = args
+        self._append(event)
+
+    def span(self, name, cat="span", **args):
+        """Context manager recording one complete event around a block."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args or None)
+
+    def traced(self, name=None, cat="span"):
+        """Decorator form of :meth:`span` (label defaults to the
+        function's qualified name)."""
+        def decorate(fn):
+            label = name or fn.__qualname__
+
+            @functools.wraps(fn)
+            def wrapper(*a, **kw):
+                if not self.enabled:
+                    return fn(*a, **kw)
+                start = time.perf_counter()
+                try:
+                    return fn(*a, **kw)
+                finally:
+                    self.complete(label, start,
+                                  time.perf_counter() - start, cat=cat)
+            return wrapper
+        return decorate
+
+    def instant(self, name, cat="event", **args):
+        """Record a point event (protocol messages, faults, rollbacks)."""
+        if not self.enabled:
+            return
+        event = {"name": name, "cat": cat, "ph": "i", "s": "t",
+                 "ts": self._ts(time.perf_counter()),
+                 "pid": self._pid, "tid": self._tid()}
+        if args:
+            event["args"] = args
+        self._append(event)
+
+    def counter(self, name, value, cat="counter"):
+        """Record a counter sample (renders as a filled track)."""
+        if not self.enabled:
+            return
+        self._append({"name": name, "cat": cat, "ph": "C",
+                      "ts": self._ts(time.perf_counter()),
+                      "pid": self._pid, "tid": self._tid(),
+                      "args": {"value": value}})
+
+    # -- output ------------------------------------------------------------
+
+    def save(self, path):
+        """Write ``{"traceEvents": [...]}`` atomically — the JSON
+        object form Perfetto and chrome://tracing both load."""
+        with self._lock:
+            doc = {"traceEvents": list(self._events),
+                   "displayTimeUnit": "ms",
+                   "otherData": {"tool": "veles_tpu.observe",
+                                 "dropped_events": self.dropped}}
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fout:
+            json.dump(doc, fout)
+        os.replace(tmp, path)
+        return path
+
+
+def validate_trace(doc):
+    """Structural check of a loaded trace document; raises ValueError.
+
+    Verifies the Perfetto-loadable shape (``traceEvents`` list, known
+    phases, required fields per phase) and that the complete events on
+    each thread track NEST — overlapping non-nested spans on one track
+    mean a broken instrumentation site (e.g. a span closed on a
+    different thread than it opened on).  Used by tests and available
+    to external consumers of ``--trace`` output.
+    """
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError("trace must be {'traceEvents': [...]}")
+    per_track = {}
+    for i, event in enumerate(doc["traceEvents"]):
+        if not isinstance(event, dict):
+            raise ValueError("event %d is not an object" % i)
+        ph = event.get("ph")
+        if ph not in ("X", "M", "i", "C"):
+            raise ValueError("event %d: unknown phase %r" % (i, ph))
+        for key in ("name", "pid", "tid"):
+            if key not in event:
+                raise ValueError("event %d: missing %r" % (i, key))
+        if ph == "X":
+            ts, dur = event.get("ts"), event.get("dur")
+            if not isinstance(ts, (int, float)) or \
+                    not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(
+                    "event %d: complete event needs numeric ts/dur" % i)
+            per_track.setdefault(
+                (event["pid"], event["tid"]), []).append(event)
+    epsilon = 1.0  # microsecond slack for float rounding
+    for track, events in per_track.items():
+        events.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []
+        for event in events:
+            end = event["ts"] + event["dur"]
+            while stack and stack[-1] <= event["ts"] + epsilon:
+                stack.pop()
+            if stack and end > stack[-1] + epsilon:
+                raise ValueError(
+                    "track %r: span %r [%f..%f] overlaps but does not "
+                    "nest within its enclosing span (ends %f)" %
+                    (track, event["name"], event["ts"], end, stack[-1]))
+            stack.append(end)
+    return doc
+
+
+#: The process-wide tracer every subsystem instruments against.
+tracer = SpanTracer()
+
+
+def span(name, cat="span", **args):
+    return tracer.span(name, cat=cat, **args)
+
+
+def instant(name, cat="event", **args):
+    return tracer.instant(name, cat=cat, **args)
+
+
+def traced(name=None, cat="span"):
+    return tracer.traced(name, cat=cat)

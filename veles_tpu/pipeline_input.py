@@ -47,6 +47,8 @@ import time
 from veles_tpu import chaos
 from veles_tpu.loader.base import ServeShadow
 from veles_tpu.logger import Logger
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.observe.trace import tracer as _tracer
 
 __all__ = ["Prefetcher", "PrefetchItem"]
 
@@ -91,6 +93,12 @@ class Prefetcher(Logger):
         # serve mutating pending_minibatches_/failed_minibatches
         self._serve_mutex = threading.Lock()
         self.stats = self._fresh_stats()
+        # telemetry (docs/observability.md): per-stage histograms feed
+        # the heartbeat/bench percentiles; resolved once, not per serve
+        self._m_wait = _registry.histogram("pipeline.wait_s")
+        self._m_fill = _registry.histogram("pipeline.fill_s")
+        self._m_h2d = _registry.histogram("pipeline.h2d_s")
+        _registry.gauge("pipeline.depth").set(self.depth)
 
     def _fresh_stats(self):
         return {"depth": self.depth, "serves": 0, "applied": 0,
@@ -183,6 +191,8 @@ class Prefetcher(Logger):
             raise failure[1].with_traceback(failure[2])
         while self._inflight < self.depth + 1 and not self._shutdown:
             self._submit()
+        if _tracer.enabled:
+            _tracer.counter("pipeline.inflight", self._inflight)
         item = self._take()
         if item is None:  # shut down mid-wait (Workflow.stop)
             return
@@ -217,6 +227,10 @@ class Prefetcher(Logger):
                     raise failure[1].with_traceback(failure[2])
         waited = time.perf_counter() - start
         self.stats["wait_s"] += waited
+        self._m_wait.observe(waited)
+        if _tracer.enabled:
+            _tracer.complete("pipeline.wait", start, waited,
+                             cat="pipeline")
         timers = self.loader.timers
         timers["pipeline_wait"] = timers.get(
             "pipeline_wait", 0.0) + waited
@@ -301,6 +315,16 @@ class Prefetcher(Logger):
         self.stats["serves"] += 1
         self.stats["fill_s"] += t1 - t0
         self.stats["h2d_s"] += t2 - t1
+        self._m_fill.observe(t1 - t0)
+        self._m_h2d.observe(t2 - t1)
+        if _tracer.enabled:
+            # worker-thread spans land on their own Perfetto track, so
+            # the fill/H2D overlap with the graph thread's step spans
+            # is visible directly
+            _tracer.complete("pipeline.fill", t0, t1 - t0,
+                             cat="pipeline", args={"serial": serial})
+            _tracer.complete("pipeline.h2d", t1, t2 - t1,
+                             cat="pipeline", args={"serial": serial})
         timers = loader.timers
         timers["pipeline_fill"] = timers.get(
             "pipeline_fill", 0.0) + (t1 - t0)

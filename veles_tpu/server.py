@@ -30,6 +30,8 @@ from veles_tpu import chaos, health
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.network_common import (
     ProtocolError, ShmChannel, available_codecs, default_secret,
     machine_id, new_id, pack_payload, parse_address, read_frame,
@@ -295,6 +297,7 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
 
     def _blacklist(self, mid):
         self.blacklist[mid] = time.time() + self.blacklist_ttl
+        _registry.gauge("server.blacklist_size").set(len(self.blacklist))
 
     def _blacklisted(self, mid):
         """True while ``mid``'s quarantine TTL has not expired; expired
@@ -304,6 +307,8 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             return False
         if time.time() >= expiry:
             del self.blacklist[mid]
+            _registry.gauge("server.blacklist_size").set(
+                len(self.blacklist))
             self.info("blacklist TTL expired for slave %s; eligible "
                       "to rejoin", mid)
             return False
@@ -395,6 +400,9 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         job_id = new_id()
         conn.jobs_out[job_id] = time.time()
         self.jobs_dispatched += 1
+        _registry.counter("server.jobs_dispatched").inc()
+        _tracer.instant("proto.job_out", cat="proto",
+                        slave=conn.slave.id[:8], job=job_id[:8])
         self._send(conn.writer, {"type": "job", "job_id": job_id},
                    payload=data, conn=conn)
 
@@ -411,8 +419,14 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         # weights poisons every other slave's next job.  The offender
         # is dropped and TTL-blacklisted; its reserved minibatch
         # requeues exactly like a slave death, so recovery is exact.
+        _tracer.instant("proto.update_in", cat="proto",
+                        slave=conn.slave.id[:8],
+                        job=str(job_id or "")[:8])
         if not await self._in_thread(health.all_finite, update):
             self.quarantined += 1
+            _registry.counter("server.quarantined").inc()
+            _tracer.instant("proto.quarantine", cat="proto",
+                            slave=conn.slave.id[:8], mid=conn.slave.mid)
             self._blacklist(conn.slave.mid)
             self.warning(
                 "quarantining slave %s (mid %s): non-finite update "
@@ -429,6 +443,7 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             result = await self._in_thread(
                 self.workflow.apply_data_from_slave, update, conn.slave)
             self.updates_applied += 1
+            _registry.counter("server.updates_applied").inc()
             # a productive update resets the slave's respawn backoff
             self._respawn_attempts.pop(conn.slave.mid, None)
             self._send(conn.writer, {"type": "update_ack",

@@ -40,6 +40,8 @@ from veles_tpu import chaos
 from veles_tpu.config import root
 from veles_tpu.health import RollbackExhausted
 from veles_tpu.mutable import Bool
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.units import Unit
 
 __all__ = ["SnapshotterBase", "Snapshotter", "SnapshotError",
@@ -254,6 +256,8 @@ class SnapshotterBase(Unit):
     def initialize(self, **kwargs):
         os.makedirs(self.directory, exist_ok=True)
         self._last_time = time.time()
+        _registry.gauge("health.rollbacks_remaining").set(
+            max(0, self.rollback_budget - self.rollbacks))
         return super(SnapshotterBase, self).initialize(**kwargs)
 
     def run(self):
@@ -597,6 +601,9 @@ class SnapshotterBase(Unit):
         HARD-FAILS with :class:`RollbackExhausted` — looping rollback
         -> divergence forever is worse than dying loudly."""
         self.rollbacks += 1
+        _registry.counter("health.rollbacks").inc()
+        _registry.gauge("health.rollbacks_remaining").set(
+            max(0, self.rollback_budget - self.rollbacks))
         if self.rollbacks > self.rollback_budget:
             raise RollbackExhausted(
                 "rollback budget exhausted (%d allowed) and training "
@@ -624,6 +631,8 @@ class SnapshotterBase(Unit):
                 "rolled back model state to verified snapshot %s "
                 "[%d/%d, reason: %s]", path, self.rollbacks,
                 self.rollback_budget, reason or "unspecified")
+            _tracer.instant("snapshot.rollback", cat="snapshot",
+                            path=path, reason=reason)
             return path
         raise SnapshotError(
             "no verified snapshot to roll back to in %s (%s)" %
@@ -635,7 +644,7 @@ class Snapshotter(SnapshotterBase):
 
     def export(self):
         destination = self._destination()
-        start = time.time()
+        start = time.perf_counter()
         self._prefetch_device_arrays()
         payload = pickle.dumps(self.workflow,
                                protocol=pickle.HIGHEST_PROTOCOL)
@@ -665,8 +674,16 @@ class Snapshotter(SnapshotterBase):
         self._update_current_link()
         self._record_in_db(destination, len(payload))
         self._apply_retention()
+        elapsed = time.perf_counter() - start
+        _registry.counter("snapshot.exports").inc()
+        _registry.histogram("snapshot.write_s").observe(elapsed)
+        if _tracer.enabled:
+            _tracer.complete("snapshot.export", start, elapsed,
+                             cat="snapshot",
+                             args={"bytes": len(payload),
+                                   "destination": destination})
         self.info("snapshot -> %s (%.1f MB, %.2f s)", destination,
-                  len(payload) / 1e6, time.time() - start)
+                  len(payload) / 1e6, elapsed)
 
     def _write_atomic(self, destination, payload):
         """tmp -> fsync -> os.replace -> directory fsync.  A crash at

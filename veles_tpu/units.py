@@ -21,6 +21,7 @@ import uuid as uuid_module
 from veles_tpu.config import root
 from veles_tpu.distributable import Distributable
 from veles_tpu.mutable import Bool, LinkableAttribute
+from veles_tpu.observe.trace import tracer as _tracer
 
 __all__ = ["Unit", "IUnit", "UnitRegistry", "RunAfterStopError",
            "nothing"]
@@ -302,11 +303,15 @@ class Unit(Distributable, metaclass=UnitRegistry):
                     "%s scheduled to run after the workflow finished "
                     "— check its control links" % self)
             return False
-        start = time.time()
+        start = time.perf_counter()
         self.run()
-        elapsed = time.time() - start
+        elapsed = time.perf_counter() - start
         self.timers["run"] += elapsed
         self.run_calls += 1
+        if _tracer.enabled:
+            # the trace span and the accumulated timer are the SAME
+            # measurement — print_stats and Perfetto cannot disagree
+            _tracer.complete(self.name, start, elapsed, cat="unit")
         self._ran = True
         if self.timings:
             self.debug("%s ran in %.3f ms", self.name, elapsed * 1e3)

@@ -391,17 +391,18 @@ class WebStatusServer(Logger):
                 label=False)
             cells = "".join(
                 "<td>%s</td>" % html.escape(
-                    json.dumps(s.get(k)) if k == "metrics"
+                    json.dumps(s.get(k)) if k in ("metrics", "health")
                     else str(s.get(k, "")))
                 for k in ("workflow", "mode", "epoch", "metrics",
-                          "slaves", "updated"))
+                          "health", "slaves", "updated"))
             rows.append(
                 "<tr><td><a href='/session/%s'>%s</a></td>%s<td>%s</td>"
                 "</tr>" % (quote(sid, safe=""),
                            html.escape(sid), cells, spark))
         return ("<table><tr><th>id</th><th>workflow</th><th>mode</th>"
-                "<th>epoch</th><th>metrics</th><th>slaves</th>"
-                "<th>updated</th><th>trend</th></tr>%s</table>"
+                "<th>epoch</th><th>metrics</th><th>health</th>"
+                "<th>slaves</th><th>updated</th><th>trend</th></tr>"
+                "%s</table>"
                 % "\n".join(rows))
 
     def record(self, data):
@@ -432,6 +433,7 @@ class StatusReporter(object):
         self.workflow = workflow
 
     def snapshot(self):
+        from veles_tpu.observe.metrics import health_snapshot
         decision = getattr(self.workflow, "decision", None)
         launcher = self.workflow.launcher
         return {
@@ -442,6 +444,12 @@ class StatusReporter(object):
             "metrics": getattr(decision, "epoch_metrics", None),
             "slaves": len(getattr(
                 getattr(launcher, "_agent", None), "slaves", {}) or {}),
+            # numerics-health counters (docs/health.md) published at
+            # the existing lazy-metric sync points: skip counts from
+            # the decision unit, rollback budget from the snapshotter,
+            # blacklist/quarantine from the server — reading them here
+            # never forces a device sync
+            "health": health_snapshot(),
         }
 
     def _post_json(self, path, payload):

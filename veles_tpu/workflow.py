@@ -22,6 +22,7 @@ import time
 from collections import deque
 
 from veles_tpu.mutable import Bool
+from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.plumbing import EndPoint, StartPoint
 from veles_tpu.units import Unit
 
@@ -218,7 +219,10 @@ class Workflow(Unit):
             "units": {id(u): (dict(u.timers), u.run_calls)
                       for u in self._units if u is not self},
         }
-        start = time.time()
+        # perf_counter, not time.time: wall-clock timers go backwards
+        # under NTP adjustment and disagree with the perf_counter
+        # deltas every other timer (units, pipeline stages) records
+        start = time.perf_counter()
         self.event("run", "begin")
         try:
             self.start_point.run_dependent()
@@ -234,7 +238,11 @@ class Workflow(Unit):
                 self.on_workflow_finished()
         finally:
             self._running_ = False
-            self._run_time_ += time.time() - start
+            elapsed = time.perf_counter() - start
+            self._run_time_ += elapsed
+            if _tracer.enabled:
+                _tracer.complete("%s.run" % self.name, start, elapsed,
+                                 cat="workflow")
             self.event("run", "end")
         return True
 
@@ -270,12 +278,15 @@ class Workflow(Unit):
     # -- master-slave contract (job level; see parallel/ for on-pod SPMD) --
 
     def _timed_method(self, name, fn, *args):
-        start = time.time()
+        start = time.perf_counter()
         try:
             return fn(*args)
         finally:
+            elapsed = time.perf_counter() - start
             self._method_timers[name] = (
-                self._method_timers.get(name, 0.0) + time.time() - start)
+                self._method_timers.get(name, 0.0) + elapsed)
+            if _tracer.enabled:
+                _tracer.complete(name, start, elapsed, cat="distributed")
 
     def generate_data_for_master(self):
         return [self._timed_method(
