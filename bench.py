@@ -50,11 +50,10 @@ BASELINE_MATMUL_S = 0.1642  # GTX TITAN, reference devices/device_infos.json
 N = 3001
 
 # bf16 MXU peak TFLOP/s by device kind substring (public spec sheets);
-# used only to derive MFU context for bf16 measurements.
-PEAK_BF16_TFLOPS = (
-    ("v6", 918.0), ("v5p", 459.0), ("v5", 197.0), ("v4", 275.0),
-    ("v3", 123.0), ("v2", 45.0),
-)
+# used to derive MFU context for bf16 measurements.  ONE table for the
+# offline bench and the live mfu_pct gauge, so the two can never
+# disagree about what "peak" means.
+from veles_tpu.observe.xla_introspect import PEAK_BF16_TFLOPS  # noqa: E402
 
 # conservative wall-cost estimates per sheddable section (seconds,
 # measured on the axon tunnel, dominated by the one-time server-side
@@ -837,6 +836,14 @@ def main():
     deadline = time.monotonic() + float(
         os.environ.get("VELES_BENCH_DEADLINE_S", "480"))
     t_start = time.monotonic()
+    # VELES_TRACE=path: record the whole bench under the span tracer
+    # and close with a one-line textual digest (top spans by self
+    # time) so CI logs carry a trace summary next to the numbers
+    trace_path = os.environ.get("VELES_TRACE", "")
+    if trace_path:
+        from veles_tpu.observe.trace import tracer as _bench_tracer
+        _bench_tracer.start()
+        _bench_tracer.label = "bench"
     # enable JAX's persistent compile cache: it does not shorten the
     # tunnel's server-side first-exec, but it does skip client-side
     # recompiles and keeps the XLA autotune cache warm
@@ -1024,6 +1031,16 @@ def main():
         section("alexnet_b256_float32", lambda: alex(256, "float32"))
 
     extras["wall_s"] = round(time.monotonic() - t_start, 1)
+    if trace_path:
+        try:
+            from veles_tpu.observe import summary as _summary
+            from veles_tpu.observe.trace import tracer as _bt
+            _bt.stop()
+            _bt.save(trace_path)
+            print(_summary.digest_line(_summary.load(trace_path)),
+                  flush=True)
+        except Exception as exc:
+            print("trace digest unavailable: %s" % exc, flush=True)
     emit()
 
 

@@ -83,6 +83,17 @@ def _no_chaos_bleed():
         mod.uninstall()
 
 
+@pytest.fixture(autouse=True)
+def _flight_dumps_to_tmp(tmp_path, monkeypatch):
+    """The always-on flight recorder dumps on divergence / rollback /
+    quarantine — which many chaos/health tests trigger on purpose.
+    Those dumps must land in the test's tmp dir, not litter the
+    repository cwd."""
+    from veles_tpu.observe.flight import flight
+    monkeypatch.setattr(flight, "base_path",
+                        str(tmp_path / "veles_flight"))
+
+
 @pytest.fixture
 def cpu_device():
     from veles_tpu.backends import Device

@@ -160,7 +160,7 @@ def test_master_speculates_only_past_straggler_threshold():
     assert m.generate_data_for_slave(s2) is False
     # ...but once it straggles past the threshold, an idle slave
     # shadows it (backup task)
-    m._outstanding[0][s1.id] = time.time() - 100.0
+    m._outstanding[0][s1.id] = time.perf_counter() - 100.0
     assert m.generate_data_for_slave(s2) == (e, 0, "a")
     # never a second copy for the same slave
     assert m.generate_data_for_slave(s2) is False
@@ -195,7 +195,7 @@ def test_master_never_speculates_without_completed_durations():
     e = m.epoch
     s1, s2 = _slave("s1"), _slave("s2")
     m.generate_data_for_slave(s1)
-    m._outstanding[0][s1.id] = time.time() - 1e6  # ancient straggler
+    m._outstanding[0][s1.id] = time.perf_counter() - 1e6  # ancient straggler
     # no completed job yet -> no credible mean -> no backup copies
     assert m.generate_data_for_slave(s2) == (e, 1, "b")
     assert m.generate_data_for_slave(s2) is False
@@ -220,7 +220,7 @@ def test_master_keeps_job_with_surviving_backup():
     s1, s2 = _slave("s1"), _slave("s2")
     m.generate_data_for_slave(s1)
     m._durations.append(0.001)
-    m._outstanding[0][s1.id] = time.time() - 100.0
+    m._outstanding[0][s1.id] = time.perf_counter() - 100.0
     assert m.generate_data_for_slave(s2) == (e, 0, "a")  # backup copy
     m.drop_slave(s1)
     # not requeued: s2 still runs its copy

@@ -137,11 +137,14 @@ class Device(Pickleable, metaclass=BackendRegistry):
         a = numpy.random.RandomState(13).rand(size, size).astype(numpy.float32)
         fn = self.matmul_fn()
         fn(a, a)  # warm-up / compile
-        start = time.time()
+        # perf_counter: this rating feeds the master's load balancing;
+        # a wall-clock NTP step here would misweight the slave for the
+        # whole session
+        start = time.perf_counter()
         for _ in range(3):
             result = fn(a, a)
         self.sync_result(result)
-        elapsed = (time.time() - start) / 3
+        elapsed = (time.perf_counter() - start) / 3
         return 1000.0 / max(elapsed, 1e-9)
 
     def matmul_fn(self):

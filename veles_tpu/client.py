@@ -13,11 +13,13 @@ import asyncio
 import os
 import random
 import threading
+import time
 
 from veles_tpu import chaos
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
+from veles_tpu.observe.cluster import estimate_offset
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.network_common import (
@@ -56,7 +58,8 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
 
     def __init__(self, address, workflow, launcher=None, codec=None,
                  async_slave=None, reconnect_limit=None,
-                 death_probability=None, secret=None):
+                 death_probability=None, secret=None, tracer=None,
+                 trace_scope="process", trace_chunk_max=2048):
         super(Client, self).__init__()
         net = root.common.network
         self.host, self.port = parse_address(address,
@@ -76,6 +79,25 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
         self.secret = secret if secret is not None else default_secret()
         self.sid = None
         self.jobs_done = 0
+        # distributed tracing (docs/observability.md): the master's
+        # run-scoped trace id arrives in the handshake ack; bounded
+        # chunks of this process's recorded spans ship back with the
+        # updates (and at session end) for cluster-scope merging
+        self.trace_id = None
+        #: estimated master-minus-local clock offset (NTP-style join
+        #: handshake; None until a session established one)
+        self.clock_offset = None
+        self.clock_delay = None
+        self.trace_chunks_sent = 0
+        self._mid = "%s:%d" % (os.uname().nodename, os.getpid())
+        self._trace_tracer = tracer if tracer is not None else _tracer
+        # "process": ship every recorded event (one-process-per-role
+        # deployments).  "threads": ship only events recorded by THIS
+        # client's threads — the in-process two-node tests share one
+        # tracer between master and slave and must not cross-ship
+        self._trace_scope = trace_scope
+        self._trace_chunk_max = int(trace_chunk_max)
+        self._trace_tids = set()
         self.reject_reason = None
         self.shm_sends = 0
         #: successful handshakes over this client's lifetime
@@ -183,11 +205,12 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
     async def _session(self):
         reader, writer = await asyncio.open_connection(self.host, self.port)
         try:
+            self._mid = "%s:%d" % (os.uname().nodename, os.getpid())
             self._send(writer, {
                 "type": "handshake",
                 "checksum": self.workflow.checksum,
                 "power": self.computing_power,
-                "mid": "%s:%d" % (os.uname().nodename, os.getpid()),
+                "mid": self._mid,
                 "machine": machine_id(),
                 "pid": os.getpid()})
             msg, payload = await self._recv(reader)
@@ -214,6 +237,11 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             self.sid = msg["id"]
             self._handshaken = True
             self.sessions_established += 1
+            self._trace_tids.add(threading.get_ident())
+            if msg.get("trace"):
+                self.trace_id = msg["trace"]
+                if self._trace_tracer.label is None:
+                    self._trace_tracer.label = "slave:" + self._mid
             if "shm" in msg:
                 try:
                     self._shm_in = ShmChannel.attach(msg["shm"]["m2s"])
@@ -231,10 +259,85 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                           self.sid[:8], msg["epoch"])
             else:
                 self.info("connected as %s", self.sid[:8])
+            if self.trace_id is not None:
+                # capability-gated: only masters that advertise
+                # cluster tracing (a trace id in the ack) understand
+                # clock_probe frames; older/stub masters are not sent
+                # messages they would misparse as job traffic
+                await self._clock_sync(reader, writer)
             await self._job_loop(reader, writer)
         finally:
+            self._ship_trace_chunk(writer, final=True)
             self._close_shm()
             writer.close()
+
+    async def _clock_sync(self, reader, writer, probes=4):
+        """NTP-style offset estimate at join time (observe/cluster.py):
+        probe the master's wall clock over the live connection, report
+        the minimum-delay estimate so the master can offset-correct
+        this slave's shipped trace chunks.  Failures only cost the
+        estimate, never the session."""
+        try:
+            samples = []
+            for _ in range(probes):
+                t0 = time.time()
+                self._send(writer, {"type": "clock_probe", "t0": t0})
+                for _ in range(8):  # skip interleaved broadcasts
+                    msg, _ = await self._recv(reader)
+                    mtype = msg.get("type")
+                    if mtype == "clock_probe_ack":
+                        break
+                    if mtype == "pause":
+                        self._paused = True
+                    elif mtype == "resume":
+                        self._paused = False
+                    elif mtype == "stop":
+                        self._stopping = True
+                        return
+                else:
+                    return
+                t3 = time.time()
+                samples.append((msg.get("t0", t0), msg["t1"],
+                                msg["t2"], t3))
+            offset, delay = estimate_offset(samples)
+            self.clock_offset, self.clock_delay = offset, delay
+            self._send(writer, {"type": "clock_report",
+                                "offset": offset, "delay": delay})
+        except (KeyError, TypeError, ValueError) as exc:
+            self.warning("clock sync failed (%s); traces from this "
+                         "slave merge uncorrected", exc)
+
+    def _ship_trace_chunk(self, writer, final=False):
+        """Ship a bounded chunk of recorded trace events to the master
+        (riding along with updates, or the remainder at session end).
+        Never lets a telemetry failure touch the job cycle."""
+        tracer = self._trace_tracer
+        if not tracer.enabled or not self._handshaken:
+            return
+        try:
+            idents = (self._trace_tids
+                      if self._trace_scope == "threads" else None)
+            # the label names THIS slave explicitly: an in-process
+            # two-node setup shares one tracer whose label belongs to
+            # the master, and the merged trace must not show two
+            # tracks with the master's name
+            chunk = tracer.take_chunk(
+                max_events=self._trace_chunk_max, idents=idents,
+                extra={"trace_id": self.trace_id, "final": final,
+                       "label": "slave:" + self._mid})
+            if chunk is None:
+                return
+            # chunks ride INLINE, never shm: the master closes its shm
+            # segments at shutdown while late frames are still being
+            # read (a chunk referencing a dead segment arrives empty),
+            # and keeping telemetry off the two-slot channel preserves
+            # its one-payload-in-flight-per-direction invariant
+            self._send(writer, {"type": "trace_chunk",
+                                "codec": self.codec}, payload=chunk,
+                       use_shm=False)
+            self.trace_chunks_sent += 1
+        except Exception as exc:
+            self.debug("trace chunk shipping failed: %s", exc)
 
     async def _job_loop(self, reader, writer):
         self._send(writer, {"type": "job_request"})
@@ -284,13 +387,18 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                     self.warning("fault injection: dying on job %d",
                                  self.jobs_done + 1)
                     raise ConnectionResetError("injected death (chaos)")
-            _tracer.instant("proto.job_in", cat="proto",
-                            job=str(msg.get("job_id") or "")[:8])
+            job8 = str(msg.get("job_id") or "")[:8]
+            _tracer.instant("proto.job_in", cat="proto", job=job8,
+                            trace=str(self.trace_id or "")[:8])
             data = unpack_payload(payload, msg.get("codec", "none"))
             if self.async_slave:
                 # pipeline: ask for the next job before running this one
                 self._send(writer, {"type": "job_request"})
-            update = await self._run_job(data)
+            # the slave-side span a merged cluster trace hangs between
+            # the master's proto.job_out and proto.update_in instants
+            with _tracer.span("slave.job", cat="proto", job=job8,
+                              trace=str(self.trace_id or "")[:8]):
+                update = await self._run_job(data)
             self.jobs_done += 1
             self._session_progress = True
             if chaos.plan is not None:
@@ -309,8 +417,11 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                 "type": "update", "job_id": msg.get("job_id"),
                 "codec": self.codec}, payload=update)
             _registry.counter("client.jobs_done").inc()
-            _tracer.instant("proto.update_out", cat="proto",
-                            job=str(msg.get("job_id") or "")[:8])
+            _tracer.instant("proto.update_out", cat="proto", job=job8,
+                            trace=str(self.trace_id or "")[:8])
+            # trace chunks ride back WITH the update cadence: bounded,
+            # so a chatty tracer never starves the data plane
+            self._ship_trace_chunk(writer)
             if not self.async_slave:
                 self._send(writer, {"type": "job_request"})
 
@@ -320,8 +431,13 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
         def callback(update):
             result["update"] = update
 
-        await self._in_thread(
-            self.workflow.do_job, data, self._pending_update, callback)
+        def invoke():
+            # remember which executor threads run OUR jobs: with
+            # trace_scope="threads" only their spans ship in chunks
+            self._trace_tids.add(threading.get_ident())
+            self.workflow.do_job(data, self._pending_update, callback)
+
+        await self._in_thread(invoke)
         self._pending_update = None
         return result.get("update")
 
@@ -329,10 +445,10 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
 
     _NO_PAYLOAD = object()
 
-    def _send(self, writer, msg, payload=_NO_PAYLOAD):
+    def _send(self, writer, msg, payload=_NO_PAYLOAD, use_shm=True):
         if payload is not Client._NO_PAYLOAD:
             raw = pack_payload(payload, self.codec)
-            if self._shm_out is not None:
+            if use_shm and self._shm_out is not None:
                 desc = self._shm_out.write(raw)
                 if desc is not None:
                     msg = dict(msg, shm=list(desc))

@@ -129,8 +129,11 @@ class _FarmMaster(object):
         with self._lock:
             if self._pending:
                 i, spec = self._pending.popleft()
+                # perf_counter: these stamps feed job durations and
+                # the speculation threshold — an NTP step on the wall
+                # clock would fake (or hide) a straggler
                 self._outstanding.setdefault(i, {})[slave.id] = \
-                    time.time()
+                    time.perf_counter()
                 return (self.epoch, i, spec)
             # nothing fresh: maybe shadow a straggler (backup task;
             # first result wins).  Only once the job has run longer
@@ -144,7 +147,7 @@ class _FarmMaster(object):
                 self.speculation_factor *
                 sum(self._durations) / len(self._durations),
                 self.min_speculation_s)
-            now = time.time()
+            now = time.perf_counter()
             for i, copies in self._outstanding.items():
                 if (slave.id not in copies
                         and self.results[i] is _UNSET
@@ -167,7 +170,7 @@ class _FarmMaster(object):
             if copies is not None and slave is not None:
                 t0 = copies.pop(slave.id, None)
             if t0 is not None:
-                self._durations.append(time.time() - t0)
+                self._durations.append(time.perf_counter() - t0)
             if self.results[i] is not _UNSET:
                 return True         # a backup copy finished first
             self.results[i] = result

@@ -308,8 +308,20 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         ``--profile`` / VELES_PROFILE.  Returns the heartbeat (or
         None); everything else is process-global."""
         from veles_tpu import observe
+        # the always-on flight recorder dumps next to --trace when one
+        # is set (otherwise its cwd default); the XLA compile listener
+        # installs here so even pre-run compiles are counted
+        if self.trace_path:
+            observe.flight.base_path = self.trace_path + ".flight"
+        try:
+            from veles_tpu.observe import xla_introspect
+            xla_introspect.ensure_installed()
+        except Exception:
+            pass
         if self.trace_path:
             observe.tracer.start()
+            if observe.tracer.label is None:
+                observe.tracer.label = self.workflow_mode
         if self.profile_dir:
             observe.install_profiler(
                 observe.ProfilerHook(self.profile_dir))
@@ -340,6 +352,69 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             except OSError as exc:
                 self.error("failed to write trace %s: %s",
                            self.trace_path, exc)
+            self._write_merged_trace()
+
+    def _write_merged_trace(self):
+        """Master only: stitch this process's trace with the chunks
+        its slaves shipped back into ``<trace>.merged.json`` — one
+        Perfetto timeline with per-process tracks and offset-corrected
+        timestamps (docs/observability.md)."""
+        collector = getattr(self._agent, "trace_collector", None)
+        if collector is None or not collector.keys():
+            return
+        try:
+            import json
+
+            from veles_tpu.observe import merge, tracer
+            with open(self.trace_path) as fin:
+                master_doc = json.load(fin)
+            merged = merge.merge_run(
+                master_doc, collector,
+                trace_id=getattr(self._agent, "trace_id", None),
+                master_label=tracer.label or "master")
+            merged_path = self.trace_path + ".merged.json"
+            tmp = merged_path + ".tmp"
+            with open(tmp, "w") as fout:
+                json.dump(merged, fout)
+            os.replace(tmp, merged_path)
+            self.info("merged cluster trace written to %s "
+                      "(%d slave track(s))", merged_path,
+                      len(collector.keys()))
+        except Exception as exc:
+            self.error("failed to write merged trace: %s", exc)
+
+    def _install_fatal_signal_hook(self):
+        """SIGTERM dumps the flight ring and saves the --trace buffer
+        BEFORE the process dies: a scheduler kill must not take the
+        black box down with the plane.  Only the main thread may set
+        signal handlers; elsewhere (tests, embedded runs) this is a
+        silent no-op.  Returns an uninstall callable."""
+        import signal
+
+        def on_term(signum, frame):
+            from veles_tpu import observe
+            observe.flight.dump(reason="signal-%d" % signum)
+            if self.trace_path:
+                observe.tracer.stop()
+                try:
+                    observe.tracer.save(self.trace_path)
+                except OSError:
+                    pass
+            signal.signal(signum, previous or signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        try:
+            previous = signal.signal(signal.SIGTERM, on_term)
+        except ValueError:
+            return lambda: None
+
+        def uninstall():
+            try:
+                signal.signal(signal.SIGTERM, previous)
+            except (ValueError, TypeError):
+                pass
+
+        return uninstall
 
     def run(self):
         if not self.initialized:
@@ -350,6 +425,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         from veles_tpu.thread_pool import ThreadPool
         ThreadPool.sigint_hook = self.stop
         heartbeat = None
+        uninstall_signals = self._install_fatal_signal_hook()
         try:
             # inside the try: a failure here must still reach the
             # finally that stops the heartbeat/tracer and writes the
@@ -361,7 +437,16 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             else:
                 self._workflow.run()
                 self._finished_event.set()
+        except BaseException:
+            # black-box dump on ANY escaping failure (including chaos
+            # crashes, which derive from BaseException); the finally
+            # below still saves the --trace buffer, so a crashed run
+            # leaves both a flame graph and a flight timeline
+            from veles_tpu import observe
+            observe.flight.dump(reason="exception")
+            raise
         finally:
+            uninstall_signals()
             ThreadPool.sigint_hook = None
             self.stopped = True
             if self._reporter_thread is not None:

@@ -20,6 +20,7 @@ backoff in StandardWorkflow) when training has gone off the rails.
 from veles_tpu.health import DivergenceError, is_finite_metric
 from veles_tpu.loader.base import CLASS_NAME, TRAIN, VALID
 from veles_tpu.mutable import Bool
+from veles_tpu.observe.flight import flight as _flight
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.units import Unit
 
@@ -209,6 +210,9 @@ class DecisionBase(Unit):
         self.diverged <<= True
         self.error("training diverged at epoch %s: %s",
                    self.epoch_number, reason)
+        # black-box dump BEFORE recovery mutates anything: the ring
+        # holds the step spans and heartbeats leading into divergence
+        _flight.dump(reason="divergence")
         handler = getattr(self.workflow, "on_divergence", None)
         if handler is None:
             raise DivergenceError(

@@ -69,6 +69,9 @@ class FusedTrainer(Unit):
         self._m_eval_step_ = _registry.histogram("step.eval_s")
         self._m_steps_ = _registry.counter("train.steps")
         self._m_samples_ = _registry.counter("train.samples")
+        #: XLA cost-model FLOPs of one compiled step (None until the
+        #: first step ran; 0.0 when cost analysis is unavailable)
+        self._step_flops_ = None
 
     def initialize(self, device=None, **kwargs):
         self.device = device
@@ -89,6 +92,11 @@ class FusedTrainer(Unit):
         from veles_tpu.compiler import (
             build_forward, build_train_step, extract_state,
             step_compiler_options, workflow_plan)
+        from veles_tpu.observe import xla_introspect as _xla
+
+        # install the jax.monitoring compile listener BEFORE building,
+        # so this compile (and any later recompile storm) is counted
+        _xla.ensure_installed()
         plans = workflow_plan(self.sw)
         self._plans = plans
         self._step_fn = build_train_step(
@@ -117,6 +125,52 @@ class FusedTrainer(Unit):
         self._state = extract_state(self.sw)
         self._has_dropout = any(
             p.static.get("dropout_ratio") is not None for p in plans)
+        # recompile detection (docs/observability.md): each of these
+        # should settle on a handful of signatures — growth past that
+        # is the recompile storm the watcher warns about
+        _xla.watch(self._step_fn, "fused.step")
+        _xla.watch(self._eval_metrics, "fused.eval")
+
+    def _publish_step_flops(self, x, target, batch_size, key, poisons):
+        """XLA's own cost model for ONE fused step, from abstract
+        avals of the arguments the step was just called with — the
+        same number bench.py reports offline, now feeding the live
+        ``mfu_pct`` gauge.  One-time at the first train step, entirely
+        off the per-step path afterwards; any failure publishes 0.0 so
+        the attempt is never retried per step."""
+        import jax
+
+        from veles_tpu.observe import xla_introspect as _xla
+        self._step_flops_ = 0.0
+        try:
+            def aval(leaf):
+                if leaf is None:
+                    return None
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                    return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+                return leaf
+            args = [jax.tree.map(aval, self._state,
+                                 is_leaf=lambda v: v is None),
+                    aval(x), aval(target), aval(batch_size)]
+            kwargs = {k: aval(v) for k, v in poisons.items()}
+            if key is not None or poisons:
+                args.append(aval(key))
+            # pre-compile estimate ONLY: a .compile() fallback would
+            # synchronously rebuild a step that can take minutes on a
+            # real chip and log a phantom compile.count entry — on a
+            # jax without Lowered.cost_analysis we just skip FLOPs
+            # publication (mfu stays null) instead
+            cost = self._step_fn.lower(*args, **kwargs).cost_analysis()
+            if isinstance(cost, (list, tuple)):  # per-program variants
+                flops = sum(float(c.get("flops", 0.0)) for c in cost
+                            if isinstance(c, dict))
+            else:
+                flops = float((cost or {}).get("flops", 0.0))
+            if flops > 0:
+                self._step_flops_ = flops
+                _xla.set_step_flops(flops)
+        except Exception as exc:
+            self.debug("step cost analysis unavailable: %s", exc)
 
     def sync(self):
         """Write the fused state back into the unit Arrays (on demand:
@@ -197,6 +251,9 @@ class FusedTrainer(Unit):
                 self.mse_sum = metrics["mse_sum"]
             elif self.loss != "softmax":
                 self.mse_sum = metrics["loss"] * batch_size
+            if self._step_flops_ is None:
+                self._publish_step_flops(
+                    x, target, batch_size, key, poisons)
         else:
             # eval minibatch: ONE jitted forward+metrics dispatch,
             # result stays lazy on device until class end
@@ -216,7 +273,10 @@ class FusedTrainer(Unit):
             profiler_step()
         else:
             self._m_eval_step_.observe(elapsed)
-        if _tracer.enabled:
+        if _tracer.active:
+            # .active, not .enabled: the always-on flight recorder
+            # keeps the last N step spans for post-mortem dumps even
+            # when full tracing is off (docs/observability.md)
             _tracer.complete(
                 "fused.train_step" if is_train else "fused.eval_step",
                 t0, elapsed, cat="step",

@@ -22,11 +22,29 @@ device counters.  Three pieces:
   heartbeat (``--metrics-interval N``) consumed by web_status.py
   dashboards and offline tooling.
 
+Cluster scope (PR 5) adds four more:
+
+- :mod:`veles_tpu.observe.flight` — the always-on black-box ring of
+  recent events, dumped on divergence/rollback/quarantine/crash;
+- :mod:`veles_tpu.observe.cluster` — NTP-style clock-offset
+  estimation and the master-side collector for slave trace chunks;
+- :mod:`veles_tpu.observe.merge` — per-process traces -> one
+  offset-corrected Perfetto timeline (also ``python -m
+  veles_tpu.observe merge``);
+- :mod:`veles_tpu.observe.xla_introspect` — recompile counting,
+  device-memory gauges, and the live ``mfu_pct`` from the compiled
+  step's cost analysis (jax imported lazily, off the hot path).
+
 Everything here is stdlib-only and import-light, so hot modules
 (units, pipeline_input, compiler-adjacent code) can import it without
 dragging in jax.
 """
 
+from veles_tpu.observe.cluster import (TraceCollector, estimate_offset,
+                                       probe_sample)
+from veles_tpu.observe.flight import (FLIGHT_SCHEMA_VERSION,
+                                      FlightRecorder, flight,
+                                      validate_flight)
 from veles_tpu.observe.metrics import (Counter, Gauge, Histogram,
                                        MetricsRegistry, health_snapshot,
                                        percentiles, registry)
@@ -34,14 +52,19 @@ from veles_tpu.observe.profile import (HEARTBEAT_SCHEMA_VERSION, Heartbeat,
                                        ProfilerHook, install_profiler,
                                        profiler_step, uninstall_profiler,
                                        validate_heartbeat)
-from veles_tpu.observe.trace import (SpanTracer, instant, span, traced,
-                                     tracer, validate_trace)
+from veles_tpu.observe.trace import (CHUNK_SCHEMA_VERSION, SpanTracer,
+                                     instant, span, traced, tracer,
+                                     validate_trace)
 
 __all__ = [
     "SpanTracer", "tracer", "span", "instant", "traced", "validate_trace",
+    "CHUNK_SCHEMA_VERSION",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "percentiles", "health_snapshot",
     "ProfilerHook", "install_profiler", "uninstall_profiler",
     "profiler_step", "Heartbeat", "validate_heartbeat",
     "HEARTBEAT_SCHEMA_VERSION",
+    "FlightRecorder", "flight", "validate_flight",
+    "FLIGHT_SCHEMA_VERSION",
+    "TraceCollector", "estimate_offset", "probe_sample",
 ]

@@ -1,0 +1,75 @@
+"""Observability CLI: ``python -m veles_tpu.observe <command>``.
+
+Commands:
+
+- ``merge -o OUT master.json slave.json [--offset label=secs] ...`` —
+  stitch saved per-process trace files into one Perfetto document with
+  per-process tracks and offset-corrected timestamps (the first file
+  is the reference clock; see docs/observability.md).
+- ``summary <trace.json|flight.json> [--top N]`` — print a textual
+  digest (top spans by self time per track, counter last values) of a
+  trace file or a flight-recorder dump, for CI logs and bug reports.
+"""
+
+import argparse
+import sys
+
+
+def _parse_offsets(entries):
+    offsets = {}
+    for entry in entries or ():
+        label, sep, value = entry.partition("=")
+        if not sep:
+            raise SystemExit(
+                "--offset expects label=seconds, got %r" % entry)
+        offsets[label] = float(value)
+    return offsets
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m veles_tpu.observe",
+        description="trace merging and digesting tools")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    pm = sub.add_parser("merge", help="merge per-process trace files")
+    pm.add_argument("inputs", nargs="+", metavar="TRACE",
+                    help="saved trace files; the first is the "
+                         "reference clock")
+    pm.add_argument("-o", "--output", required=True, metavar="OUT")
+    pm.add_argument("--offset", action="append", default=[],
+                    metavar="LABEL=SECS",
+                    help="seconds to ADD to that process's clock to "
+                         "land on the reference clock (repeatable); "
+                         "defaults to the join-time estimate of 0")
+    pm.add_argument("--trace-id", default=None)
+
+    ps = sub.add_parser("summary",
+                        help="digest a trace file or flight dump")
+    ps.add_argument("input", metavar="TRACE_OR_FLIGHT")
+    ps.add_argument("--top", type=int, default=10)
+
+    args = parser.parse_args(argv)
+    if args.command == "merge":
+        from veles_tpu.observe import merge
+        merged = merge.merge_files(
+            args.inputs, args.output,
+            offsets=_parse_offsets(args.offset),
+            trace_id=args.trace_id)
+        for warning in merged["otherData"].get("warnings", ()):
+            print("warning: %s" % warning, file=sys.stderr)
+        print("merged %d events from %d processes -> %s" % (
+            sum(1 for e in merged["traceEvents"]
+                if e.get("ph") != "M"),
+            len(merged["otherData"]["parts"]), args.output))
+        return 0
+    if args.command == "summary":
+        from veles_tpu.observe import summary
+        doc = summary.load(args.input)
+        summary.render(summary.summarize(doc, top=args.top))
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -196,6 +196,11 @@ _HEALTH_KEYS = (
     ("health.rollbacks", "rollbacks"),
     ("server.blacklist_size", "blacklist_size"),
     ("server.quarantined", "quarantined"),
+    # XLA introspection (observe/xla_introspect.py): live achieved-MFU
+    # and compile accounting ride the same health surface
+    ("xla.mfu_pct", "mfu_pct"),
+    ("compile.count", "compiles"),
+    ("compile.recompiles", "recompiles"),
 )
 
 

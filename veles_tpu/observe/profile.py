@@ -30,7 +30,7 @@ __all__ = ["ProfilerHook", "install_profiler", "uninstall_profiler",
            "profiler_step", "Heartbeat", "validate_heartbeat",
            "HEARTBEAT_SCHEMA_VERSION"]
 
-HEARTBEAT_SCHEMA_VERSION = 1
+HEARTBEAT_SCHEMA_VERSION = 2
 
 
 class ProfilerHook(object):
@@ -135,10 +135,13 @@ def profiler_step():
 
 # -- heartbeat ---------------------------------------------------------------
 
-#: required keys -> allowed types of one heartbeat line
+#: required keys -> allowed types of one heartbeat line.  Schema v2:
+#: lines carry BOTH clocks — ``ts`` (wall, cross-host correlatable,
+#: NTP-adjustable) and ``mono`` (monotonic, for in-process deltas that
+#: must never go backwards) — plus the XLA ``compile`` block.
 _HEARTBEAT_REQUIRED = {
     "kind": str, "schema": int, "ts": (int, float),
-    "elapsed_s": (int, float), "session": str,
+    "mono": (int, float), "elapsed_s": (int, float), "session": str,
     "counters": dict, "gauges": dict, "histograms": dict, "health": dict,
 }
 
@@ -172,6 +175,11 @@ def validate_heartbeat(record):
         raise ValueError("kind must be 'heartbeat'")
     if record["schema"] != HEARTBEAT_SCHEMA_VERSION:
         raise ValueError("unknown heartbeat schema %r" % record["schema"])
+    if "mfu_pct" in record and record["mfu_pct"] is not None and \
+            not isinstance(record["mfu_pct"], (int, float)):
+        raise ValueError("mfu_pct must be numeric or null")
+    if "compile" in record and not isinstance(record["compile"], dict):
+        raise ValueError("compile block must be an object")
     for name, hist in record["histograms"].items():
         if not isinstance(hist, dict) or "count" not in hist:
             raise ValueError("histogram %r lacks a count" % name)
@@ -201,11 +209,29 @@ class Heartbeat(object):
         """One heartbeat record (plain data, json-serializable)."""
         from veles_tpu import logger
         now = time.monotonic()
+        # XLA introspection (docs/observability.md) refreshes FIRST so
+        # the one snapshot below already carries this tick's recompile
+        # counts, memory gauges and mfu — a recompile storm must show
+        # on the line that observed it, not one interval late.  Gated
+        # on runs that actually compiled something: a dummy/unit-test
+        # heartbeat must not drag jax in.
+        xla = None
+        mfu = None
+        if self.registry.peek("compile.count") is not None or \
+                self.registry.peek("xla.step_flops") is not None:
+            try:
+                from veles_tpu.observe import xla_introspect as xla
+                xla.poll_recompiles()
+                xla.device_memory_gauges(self.registry)
+                mfu = xla.mfu_snapshot(self.registry)
+            except Exception:
+                xla = None
         snap = self.registry.snapshot()
         record = {
             "kind": "heartbeat",
             "schema": HEARTBEAT_SCHEMA_VERSION,
             "ts": time.time(),
+            "mono": now,
             "elapsed_s": round(now - self._t0, 3),
             "session": logger.session_id,
             "counters": snap["counters"],
@@ -213,6 +239,9 @@ class Heartbeat(object):
             "histograms": snap["histograms"],
             "health": health_snapshot(self.registry),
         }
+        if xla is not None:
+            record["compile"] = xla.compile_snapshot(self.registry)
+            record["mfu_pct"] = mfu
         last_t, last_samples = self._last_sample
         samples = self._samples()
         if now > last_t:
@@ -234,9 +263,20 @@ class Heartbeat(object):
     def write_line(self):
         directory = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(directory, exist_ok=True)
+        record = _jsonsafe(self.line())
         with open(self.path, "a") as fout:
-            fout.write(json.dumps(_jsonsafe(self.line()), default=repr,
+            fout.write(json.dumps(record, default=repr,
                                   allow_nan=False) + "\n")
+        # the flight recorder keeps a condensed copy: a post-mortem
+        # dump then shows throughput/health context around the failure
+        from veles_tpu.observe.flight import flight
+        if flight.enabled:
+            flight.record(
+                "heartbeat", "heartbeat", wall=record.get("ts"),
+                args={key: record.get(key) for key in
+                      ("elapsed_s", "throughput_sps", "epoch",
+                       "health", "mfu_pct", "compile")
+                      if record.get(key) is not None})
 
     def _loop(self):
         try:

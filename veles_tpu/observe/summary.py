@@ -1,0 +1,185 @@
+"""Textual digests of trace files and flight dumps.
+
+CI logs and bug reports cannot attach a Perfetto UI; this module turns
+a trace (``--trace`` output or a merged cluster trace) or a flight
+dump into a few lines of text: per-track top-N spans by SELF time
+(span duration minus the duration of spans nested inside it — the
+number that says where time is actually spent, not merely enclosed)
+plus the last value of every counter track.
+
+``python -m veles_tpu.observe summary <trace.json|flight.json>`` is
+the CLI; :func:`digest_line` is the one-liner bench.py appends to its
+output when ``VELES_TRACE`` is set.
+"""
+
+import json
+
+__all__ = ["load", "summarize", "summarize_trace", "summarize_flight",
+           "render", "digest_line"]
+
+
+def load(path):
+    with open(path) as fin:
+        return json.load(fin)
+
+
+def _self_times(events):
+    """Per-(pid,tid) self time: sweep sorted complete events with a
+    stack (the same nesting walk validate_trace does), subtracting each
+    child's duration from its parent."""
+    per_track = {}
+    for event in events:
+        if event.get("ph") != "X":
+            continue
+        per_track.setdefault(
+            (event.get("pid"), event.get("tid")), []).append(event)
+    out = {}  # track -> {name: [self_us, total_us, count]}
+    for track, spans in per_track.items():
+        spans.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stats = out.setdefault(track, {})
+        stack = []  # [end_us, name]
+        for event in spans:
+            end = event["ts"] + event["dur"]
+            while stack and stack[-1][0] <= event["ts"] + 1.0:
+                stack.pop()
+            if stack:
+                parent = stats.get(stack[-1][1])
+                if parent is not None:
+                    parent[0] -= event["dur"]
+            entry = stats.setdefault(event["name"], [0.0, 0.0, 0])
+            entry[0] += event["dur"]
+            entry[1] += event["dur"]
+            entry[2] += 1
+            stack.append([end, event["name"]])
+    return out
+
+
+def _track_names(events):
+    """(pid,tid) -> "process/thread" display names from metadata."""
+    procs, threads = {}, {}
+    for event in events:
+        if event.get("ph") != "M":
+            continue
+        args = event.get("args") or {}
+        if event.get("name") == "process_name":
+            procs[event.get("pid")] = args.get("name", "")
+        elif event.get("name") == "thread_name":
+            threads[(event.get("pid"), event.get("tid"))] = \
+                args.get("name", "")
+    out = {}
+    for key, name in threads.items():
+        pid = key[0]
+        proc = procs.get(pid)
+        out[key] = "%s/%s" % (proc, name) if proc else \
+            "pid%s/%s" % (pid, name)
+    return out, procs
+
+
+def summarize_trace(doc, top=10):
+    events = doc.get("traceEvents", [])
+    names, procs = _track_names(events)
+    tracks = {}
+    for track, stats in _self_times(events).items():
+        label = names.get(track) or (
+            "%s/tid%s" % (procs.get(track[0], "pid%s" % track[0]),
+                          track[1]))
+        rows = sorted(
+            ((name, s[0] / 1e6, s[1] / 1e6, s[2])
+             for name, s in stats.items()),
+            key=lambda row: -row[1])[:top]
+        tracks[label] = [
+            {"name": name, "self_s": round(self_s, 6),
+             "total_s": round(total_s, 6), "count": count}
+            for name, self_s, total_s, count in rows]
+    counters = {}
+    for event in events:
+        if event.get("ph") == "C":
+            counters[event["name"]] = (
+                event.get("args") or {}).get("value")
+    return {"kind": "trace", "tracks": tracks, "counters": counters,
+            "events": sum(1 for e in events if e.get("ph") != "M")}
+
+
+def summarize_flight(doc, top=10):
+    tracks = {}
+    counters = {}
+    instants = {}
+    for event in doc.get("events", ()):
+        kind = event.get("kind")
+        thread = event.get("thread", "?")
+        if kind == "span":
+            stats = tracks.setdefault(thread, {})
+            entry = stats.setdefault(event["name"], [0.0, 0])
+            entry[0] += float(event.get("dur_s") or 0.0)
+            entry[1] += 1
+        elif kind == "counter":
+            counters[event["name"]] = (
+                event.get("args") or {}).get("value")
+        elif kind == "instant":
+            instants[event["name"]] = instants.get(event["name"], 0) + 1
+    rendered = {}
+    for thread, stats in tracks.items():
+        rows = sorted(((name, s[0], s[1]) for name, s in stats.items()),
+                      key=lambda row: -row[1])[:top]
+        rendered[thread] = [
+            {"name": name, "self_s": round(total, 6),
+             "total_s": round(total, 6), "count": count}
+            for name, total, count in rows]
+    return {"kind": "flight", "reason": doc.get("reason"),
+            "tracks": rendered, "counters": counters,
+            "instants": instants,
+            "events": len(doc.get("events", ()))}
+
+
+def summarize(doc, top=10):
+    """Dispatch on document shape: flight dump or trace file."""
+    if doc.get("kind") == "flight":
+        return summarize_flight(doc, top=top)
+    return summarize_trace(doc, top=top)
+
+
+def render(summary, out=None):
+    """Human-readable multi-line rendering (the CLI's output)."""
+    import sys
+    out = out if out is not None else sys.stdout
+    header = "%s digest: %d events" % (summary["kind"],
+                                       summary["events"])
+    if summary.get("reason"):
+        header += " (reason: %s)" % summary["reason"]
+    print(header, file=out)
+    for label in sorted(summary["tracks"]):
+        rows = summary["tracks"][label]
+        if not rows:
+            continue
+        print("  track %s:" % label, file=out)
+        for row in rows:
+            print("    %-32s self %10.4fs  total %10.4fs  x%d" %
+                  (row["name"], row["self_s"], row["total_s"],
+                   row["count"]), file=out)
+    if summary.get("counters"):
+        print("  counters (last values):", file=out)
+        for name in sorted(summary["counters"]):
+            print("    %-32s %s" % (name, summary["counters"][name]),
+                  file=out)
+    if summary.get("instants"):
+        print("  instants:", file=out)
+        for name in sorted(summary["instants"]):
+            print("    %-32s x%d" % (name, summary["instants"][name]),
+                  file=out)
+
+
+def digest_line(doc, top=3):
+    """One line: the global top-N spans by self time — what bench.py
+    appends to CI logs when VELES_TRACE is set."""
+    summary = summarize(doc, top=top)
+    merged = {}
+    for rows in summary["tracks"].values():
+        for row in rows:
+            entry = merged.setdefault(row["name"], [0.0, 0])
+            entry[0] += row["self_s"]
+            entry[1] += row["count"]
+    ranked = sorted(merged.items(), key=lambda kv: -kv[1][0])[:top]
+    spans = ", ".join("%s %.3fs x%d" % (name, s, c)
+                      for name, (s, c) in ranked) or "no spans"
+    return "trace digest: %d events; top self-time: %s" % (
+        summary["events"], spans)

@@ -31,8 +31,14 @@ import os
 import threading
 import time
 
+from veles_tpu.observe.flight import flight as _global_flight
+
 __all__ = ["SpanTracer", "tracer", "span", "instant", "traced",
-           "validate_trace"]
+           "validate_trace", "CHUNK_SCHEMA_VERSION"]
+
+#: schema of the bounded trace chunks slaves ship to the master
+#: (observe/cluster.py collects them, observe/merge.py stitches them)
+CHUNK_SCHEMA_VERSION = 1
 
 
 class _NullSpan(object):
@@ -73,15 +79,26 @@ class _Span(object):
 class SpanTracer(object):
     """Thread-safe trace-event recorder with a Perfetto-loadable dump."""
 
-    def __init__(self, max_events=1000000):
+    def __init__(self, max_events=1000000, flight=None, label=None):
         self.enabled = False
         self.dropped = 0
+        #: process/track label used by cross-process merge (e.g.
+        #: "master" / "slave:<mid>"); defaults to pid at merge time
+        self.label = label
         self._max_events = max_events
         self._events = []
         self._lock = threading.Lock()
         self._epoch = time.perf_counter()
+        # wall-clock anchor taken at the SAME instant as the
+        # perf_counter epoch: event ts (µs since epoch) + this anchor
+        # maps any event onto the wall clock, which is what cross-host
+        # trace merging needs (offset-corrected wall time is the only
+        # shared timeline two processes have)
+        self._epoch_wall = time.time()
         self._pid = os.getpid()
         self._tids = {}
+        self._tid_names = {}
+        self._flight = flight if flight is not None else _global_flight
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -90,8 +107,10 @@ class SpanTracer(object):
         with self._lock:
             self._events = []
             self._tids = {}
+            self._tid_names = {}
             self.dropped = 0
             self._epoch = time.perf_counter()
+            self._epoch_wall = time.time()
             self.enabled = True
         return self
 
@@ -100,8 +119,21 @@ class SpanTracer(object):
         return self
 
     @property
+    def active(self):
+        """True when an instrumented site should call in: full tracing
+        is on, OR the always-on flight recorder wants the event.  Hot
+        sites guard on this instead of ``enabled`` so the flight ring
+        stays populated in ordinary (untraced) runs."""
+        return self.enabled or self._flight.enabled
+
+    @property
     def events(self):
         return list(self._events)
+
+    def wall_time(self, when):
+        """Map a perf_counter reading onto the wall clock via the
+        start() anchor (cross-process correlation currency)."""
+        return self._epoch_wall + (when - self._epoch)
 
     # -- recording ---------------------------------------------------------
 
@@ -109,16 +141,22 @@ class SpanTracer(object):
         ident = threading.get_ident()
         tid = self._tids.get(ident)
         if tid is None:
+            name = threading.current_thread().name
             with self._lock:
                 tid = self._tids.get(ident)
                 if tid is None:
                     tid = len(self._tids) + 1
                     self._tids[ident] = tid
+                    self._tid_names[tid] = name
             self._append({
                 "name": "thread_name", "ph": "M", "pid": self._pid,
-                "tid": tid,
-                "args": {"name": threading.current_thread().name}})
+                "tid": tid, "args": {"name": name}})
         return tid
+
+    def tids_for(self, idents):
+        """Map thread idents -> this tracer's track ids (idents never
+        seen record no events, so they are simply absent)."""
+        return {self._tids[i] for i in idents if i in self._tids}
 
     def _append(self, event):
         if len(self._events) >= self._max_events:
@@ -132,7 +170,13 @@ class SpanTracer(object):
     def complete(self, name, start, dur, cat="span", args=None):
         """Record a complete ("X") event from perf_counter timings —
         the primitive every instrumented timer calls, so the trace and
-        the accumulated timers always report the SAME measurement."""
+        the accumulated timers always report the SAME measurement.
+        Always feeds the flight recorder's ring (compact tuple, no
+        serialization) so post-mortem dumps work without ``--trace``."""
+        flt = self._flight
+        if flt.enabled:
+            flt.record("span", name, cat, self.wall_time(start), dur,
+                       args)
         if not self.enabled:
             return
         event = {"name": name, "cat": cat, "ph": "X",
@@ -144,7 +188,7 @@ class SpanTracer(object):
 
     def span(self, name, cat="span", **args):
         """Context manager recording one complete event around a block."""
-        if not self.enabled:
+        if not self.enabled and not self._flight.enabled:
             return _NULL_SPAN
         return _Span(self, name, cat, args or None)
 
@@ -156,7 +200,7 @@ class SpanTracer(object):
 
             @functools.wraps(fn)
             def wrapper(*a, **kw):
-                if not self.enabled:
+                if not self.enabled and not self._flight.enabled:
                     return fn(*a, **kw)
                 start = time.perf_counter()
                 try:
@@ -169,6 +213,9 @@ class SpanTracer(object):
 
     def instant(self, name, cat="event", **args):
         """Record a point event (protocol messages, faults, rollbacks)."""
+        flt = self._flight
+        if flt.enabled:
+            flt.record("instant", name, cat, args=args or None)
         if not self.enabled:
             return
         event = {"name": name, "cat": cat, "ph": "i", "s": "t",
@@ -180,6 +227,9 @@ class SpanTracer(object):
 
     def counter(self, name, value, cat="counter"):
         """Record a counter sample (renders as a filled track)."""
+        flt = self._flight
+        if flt.enabled:
+            flt.record("counter", name, cat, args={"value": value})
         if not self.enabled:
             return
         self._append({"name": name, "cat": cat, "ph": "C",
@@ -187,16 +237,83 @@ class SpanTracer(object):
                       "pid": self._pid, "tid": self._tid(),
                       "args": {"value": value}})
 
+    # -- cross-process shipping --------------------------------------------
+
+    def take_chunk(self, max_events=4096, idents=None, extra=None):
+        """Pop up to ``max_events`` recorded events into a bounded,
+        self-describing chunk a slave can ship to its master
+        (docs/observability.md, distributed tracing).
+
+        ``idents`` (optional) restricts the chunk to events recorded by
+        those thread idents — the in-process two-node tests use it to
+        keep a shared tracer's master and slave events separable; real
+        one-process-per-role deployments ship everything.  Thread-name
+        metadata is carried as a ``threads`` map (the popped "M" events
+        may have shipped in an earlier chunk).  Returns None when there
+        is nothing to ship."""
+        with self._lock:
+            # the hot path appends WITHOUT this lock, so the buffer
+            # object must never be rebound here: examine a fixed-length
+            # prefix and splice it in place — concurrent appends land
+            # past index n on the SAME list and survive untouched
+            n = len(self._events)
+            if not n:
+                return None
+            tids = None if idents is None else self.tids_for(idents)
+            taken, kept = [], []
+            for index in range(n):
+                event = self._events[index]
+                # thread metadata never ships (the chunk's ``threads``
+                # map replaces it — popped "M" events would leave later
+                # chunks nameless); scoped chunks also keep foreign
+                # threads' events behind
+                if (len(taken) < max_events and event["ph"] != "M"
+                        and (tids is None or event["tid"] in tids)):
+                    taken.append(event)
+                else:
+                    kept.append(event)
+            self._events[:n] = kept
+            if not taken:
+                return None
+            threads = {str(e["tid"]): self._tid_names.get(e["tid"], "")
+                       for e in taken}
+            chunk = {
+                "schema": CHUNK_SCHEMA_VERSION,
+                "pid": self._pid,
+                "label": self.label,
+                "wall_epoch": self._epoch_wall,
+                "threads": threads,
+                "events": taken,
+            }
+            if extra:
+                chunk.update(extra)
+            return chunk
+
     # -- output ------------------------------------------------------------
 
     def save(self, path):
         """Write ``{"traceEvents": [...]}`` atomically — the JSON
         object form Perfetto and chrome://tracing both load."""
-        with self._lock:
+        # bounded acquire: save() also runs from the launcher's fatal-
+        # signal hook, which may interrupt the very thread holding the
+        # lock (take_chunk/save) — a dying process must still get its
+        # trace out (list() of the buffer is GIL-atomic regardless)
+        locked = self._lock.acquire(timeout=2.0)
+        try:
             doc = {"traceEvents": list(self._events),
                    "displayTimeUnit": "ms",
                    "otherData": {"tool": "veles_tpu.observe",
-                                 "dropped_events": self.dropped}}
+                                 "dropped_events": self.dropped,
+                                 # merge anchors: wall time of ts=0 and
+                                 # this process's identity, so a saved
+                                 # per-process file can join a merged
+                                 # cross-host timeline offline
+                                 "wall_epoch": self._epoch_wall,
+                                 "pid": self._pid,
+                                 "label": self.label}}
+        finally:
+            if locked:
+                self._lock.release()
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
         tmp = path + ".tmp"

@@ -1,0 +1,320 @@
+"""XLA-layer introspection: recompiles, device memory, achieved MFU.
+
+The runtime above XLA is otherwise blind to three failure/perf modes
+the TPU-systems literature calls out as the ones that matter:
+
+- **recompile storms** — a shape or donation mistake that silently
+  recompiles the step every iteration costs orders of magnitude more
+  than any kernel win.  :class:`CompileWatcher` counts backend
+  compilation events via ``jax.monitoring`` (``compile.count`` /
+  ``compile.seconds`` registry metrics) and tracks the jit-cache size
+  of registered functions (the fused step, the eval dispatch), warning
+  the first time a watched function recompiles past its expected
+  signature count;
+- **device-memory growth** — :func:`device_memory_gauges` publishes
+  ``memory_stats()`` per device where the backend provides it (TPU),
+  falling back to a live-array census (``jax.live_arrays()``) where it
+  does not (CPU), as ``xla.mem.*`` gauges;
+- **achieved MFU** — :func:`set_step_flops` records the XLA cost
+  model's FLOP count for the compiled fused step (the same
+  ``cost_analysis()`` number bench.py reports), and
+  :func:`mfu_snapshot` divides by the recent median step time and the
+  chip's peak to publish a live ``xla.mfu_pct`` gauge the heartbeat
+  and web-status health block carry — cross-checkable against
+  ``bench.py``'s offline ``MFU.json``.
+
+Everything here imports jax lazily and is called OFF the step path
+(compile time, heartbeat thread, decision class end), preserving the
+observe-package invariant that telemetry never adds a host sync.
+"""
+
+import os
+import threading
+
+from veles_tpu.observe.metrics import percentiles
+from veles_tpu.observe.metrics import registry as _registry
+
+__all__ = ["CompileWatcher", "watcher", "ensure_installed", "watch",
+           "poll_recompiles", "device_memory_gauges", "set_step_flops",
+           "peak_flops", "mfu_snapshot", "compile_snapshot",
+           "PEAK_BF16_TFLOPS"]
+
+#: bf16 MXU peak TFLOP/s by device-kind substring (public spec sheets);
+#: bench.py shares this table for its offline MFU context.
+PEAK_BF16_TFLOPS = (
+    ("v6", 918.0), ("v5p", 459.0), ("v5", 197.0), ("v4", 275.0),
+    ("v3", 123.0), ("v2", 45.0),
+)
+
+#: the jax.monitoring duration event emitted once per XLA backend
+#: compilation (jaxpr trace / MLIR lowering events are deliberately
+#: not counted: only backend compiles cost real seconds at scale)
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+
+class CompileWatcher(object):
+    """Count XLA compilations and detect per-function recompiles."""
+
+    def __init__(self, registry=None, warn_after=2):
+        self.registry = registry if registry is not None else _registry
+        #: cache entries a watched function may legitimately grow to
+        #: before a recompile warning (the fused step compiles once per
+        #: dropout/poison signature, so 2 is the healthy ceiling)
+        self.warn_after = warn_after
+        self.installed = False
+        self._lock = threading.Lock()
+        self._watched = {}  # name -> [fn, last_size, warned]
+
+    # -- global compile accounting ----------------------------------------
+
+    def install(self):
+        """Register the jax.monitoring listener (idempotent; a missing
+        or old jax disables the counter, never the caller)."""
+        with self._lock:
+            if self.installed:
+                return True
+            try:
+                from jax import monitoring
+                monitoring.register_event_duration_secs_listener(
+                    self._on_duration)
+            except Exception:
+                return False
+            self.installed = True
+            return True
+
+    def _on_duration(self, event, duration, **kwargs):
+        if not event.endswith(_COMPILE_EVENT_SUFFIX):
+            return
+        self.registry.counter("compile.count").inc()
+        self.registry.counter("compile.seconds").inc(float(duration))
+        from veles_tpu.observe.trace import tracer
+        if tracer.active:
+            tracer.instant("xla.compile", cat="xla",
+                           seconds=round(float(duration), 4))
+
+    # -- per-function recompile detection ----------------------------------
+
+    def watch(self, fn, name):
+        """Track a jitted function's compilation-cache size (pjit's
+        ``_cache_size``); functions without one are ignored."""
+        if not hasattr(fn, "_cache_size"):
+            return False
+        with self._lock:
+            self._watched[name] = [fn, 0, False]
+        return True
+
+    def unwatch(self, name):
+        with self._lock:
+            self._watched.pop(name, None)
+
+    def poll(self, warn=None):
+        """Refresh watched cache sizes; returns {name: size}.  Called
+        off the hot path (heartbeat thread, compile time).  The first
+        time a function's cache exceeds ``warn_after`` entries a
+        recompile-storm warning is logged and a ``compile.recompiles``
+        counter bumped by the growth."""
+        with self._lock:
+            watched = list(self._watched.items())
+        sizes = {}
+        for name, entry in watched:
+            fn, last, warned = entry
+            try:
+                size = int(fn._cache_size())
+            except Exception:
+                continue
+            sizes[name] = size
+            if size > last:
+                if last:  # growth past the first compile = recompile
+                    self.registry.counter(
+                        "compile.recompiles").inc(size - last)
+                entry[1] = size
+            if size > self.warn_after and not warned:
+                entry[2] = True
+                import logging
+                logging.getLogger("xla").warning(
+                    "recompile storm suspected: %s has %d compiled "
+                    "signatures (expected <= %d) — check for varying "
+                    "shapes/dtypes or re-donated buffers",
+                    name, size, self.warn_after)
+                if warn is not None:
+                    warn(name, size)
+        return sizes
+
+
+#: process-wide watcher (the fused trainer installs + registers into it)
+watcher = CompileWatcher()
+
+
+def ensure_installed():
+    return watcher.install()
+
+
+def watch(fn, name):
+    return watcher.watch(fn, name)
+
+
+def poll_recompiles():
+    return watcher.poll()
+
+
+def compile_snapshot(reg=None):
+    """{"count", "seconds", "recompiles"} from the registry — always a
+    complete dict (zeros before the first compile), so heartbeat
+    consumers can rely on the keys existing."""
+    reg = reg if reg is not None else _registry
+    count = reg.peek("compile.count")
+    seconds = reg.peek("compile.seconds")
+    recompiles = reg.peek("compile.recompiles")
+    return {
+        "count": int(count.value) if count is not None else 0,
+        "seconds": round(float(seconds.value), 4)
+        if seconds is not None else 0.0,
+        "recompiles": int(recompiles.value)
+        if recompiles is not None else 0,
+    }
+
+
+# -- device memory -----------------------------------------------------------
+
+
+def device_memory_gauges(reg=None):
+    """Publish per-device memory gauges; returns the flat dict.
+
+    Prefers the backend's ``memory_stats()`` (TPU/GPU expose
+    bytes_in_use / peak_bytes_in_use); where unavailable (CPU) falls
+    back to a live-array census — the sum of ``nbytes`` over
+    ``jax.live_arrays()`` — which tracks the same leak/growth signal
+    with framework-side accounting."""
+    reg = reg if reg is not None else _registry
+    out = {}
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    have_stats = False
+    for index, device in enumerate(devices):
+        stats = None
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        have_stats = True
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                name = "xla.mem.%s.d%d" % (key, index)
+                reg.gauge(name).set(int(stats[key]))
+                out[name] = int(stats[key])
+    if not have_stats:
+        try:
+            live = sum(int(getattr(arr, "nbytes", 0))
+                       for arr in jax.live_arrays())
+        except Exception:
+            return out
+        reg.gauge("xla.mem.live_bytes").set(live)
+        out["xla.mem.live_bytes"] = live
+    return out
+
+
+# -- FLOPs / MFU -------------------------------------------------------------
+
+
+def set_step_flops(flops, reg=None):
+    """Record the cost-analysis FLOP count of ONE fused train step
+    (published by the fused trainer right after compile)."""
+    reg = reg if reg is not None else _registry
+    reg.gauge("xla.step_flops").set(float(flops))
+
+
+_peak_cache = {}
+_peak_lock = threading.Lock()
+
+
+def _measured_peak():
+    """Fallback peak for chips without a spec-table entry (host CPU
+    under JAX_PLATFORMS=cpu): the achieved FLOP/s of a small f32
+    matmul, measured once and cached.  MFU against a measured matmul
+    ceiling is the honest definition available on such backends — and
+    it keeps ``mfu_pct`` live (non-null) on development runs so the
+    plumbing is exercised before a TPU ever sees it."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    n = 384
+    a = jnp.asarray(numpy.random.RandomState(7)
+                    .rand(n, n).astype(numpy.float32))
+    matmul = jax.jit(lambda x, y: x @ y)
+    jax.block_until_ready(matmul(a, a))  # compile outside the timing
+    best = None
+    for _ in range(3):
+        start = time.perf_counter()
+        jax.block_until_ready(matmul(a, a))
+        elapsed = time.perf_counter() - start
+        if best is None or elapsed < best:
+            best = elapsed
+    return 2.0 * n * n * n / max(best, 1e-9)
+
+
+def peak_flops():
+    """This process's peak FLOP/s reference for MFU, resolved once:
+    ``VELES_PEAK_TFLOPS`` env override -> TPU device-kind spec table
+    -> measured matmul ceiling (CPU dev runs).  None when jax itself
+    is unusable."""
+    with _peak_lock:
+        if "peak" in _peak_cache:
+            return _peak_cache["peak"]
+        peak = None
+        env = os.environ.get("VELES_PEAK_TFLOPS", "")
+        if env:
+            try:
+                peak = float(env) * 1e12
+            except ValueError:
+                peak = None
+        if peak is None:
+            try:
+                import jax
+                kind = jax.local_devices()[0].device_kind.lower()
+                for key, tflops in PEAK_BF16_TFLOPS:
+                    if key in kind:
+                        peak = tflops * 1e12
+                        break
+            except Exception:
+                pass
+        if peak is None:
+            try:
+                peak = _measured_peak()
+            except Exception:
+                peak = None
+        _peak_cache["peak"] = peak
+        return peak
+
+
+def mfu_snapshot(reg=None):
+    """Live achieved-MFU percentage, or None when the inputs are not
+    yet published (no compiled step, no timed steps).  Publishes the
+    ``xla.mfu_pct`` gauge as a side effect so health_snapshot and the
+    web-status dashboard pick it up.  Uses the p50 of the recent
+    step-time window: MFU is a steady-state number and a median
+    ignores the compile-step outlier by construction."""
+    reg = reg if reg is not None else _registry
+    flops_gauge = reg.peek("xla.step_flops")
+    hist = reg.peek("step.train_s")
+    if flops_gauge is None or flops_gauge.value is None or hist is None:
+        return None
+    window = hist.window_values()
+    if not window:
+        return None
+    step_s = percentiles(window, ps=(50,)).get("p50")
+    if not step_s or step_s <= 0:
+        return None
+    peak = peak_flops()
+    if not peak:
+        return None
+    mfu = 100.0 * float(flops_gauge.value) / step_s / peak
+    mfu = round(mfu, 3)
+    reg.gauge("xla.mfu_pct").set(mfu)
+    return mfu

@@ -30,6 +30,8 @@ from veles_tpu import chaos, health
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
+from veles_tpu.observe.cluster import TraceCollector
+from veles_tpu.observe.flight import flight as _flight
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.network_common import (
@@ -134,6 +136,13 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         #: respawn delay backs off on THIS, not on global blacklist
         #: size, and resets once the slave applies a productive update
         self._respawn_attempts = {}
+        #: run-scoped trace id: propagated to every slave in the
+        #: handshake ack so the whole job's spans — master and slave —
+        #: stitch under ONE id in the merged cluster trace
+        self.trace_id = new_id()
+        #: shipped slave trace chunks + per-slave clock offsets
+        #: (docs/observability.md, distributed tracing)
+        self.trace_collector = TraceCollector()
         self.quarantined = 0
         self.slaves = {}
         self._waiting = deque()     # parked requesters (sync points)
@@ -293,6 +302,34 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             await self._serve_job(conn)
         elif mtype == "update":
             await self._apply_update(conn, msg, payload)
+        elif mtype == "clock_probe":
+            # NTP-style offset handshake (observe/cluster.py): answer
+            # IN the event loop — a thread hop here would inflate the
+            # apparent one-way delay the estimate divides by
+            now = time.time()
+            self._send(conn.writer, {
+                "type": "clock_probe_ack", "t0": msg.get("t0"),
+                "t1": now, "t2": now})
+        elif mtype == "clock_report":
+            offset = msg.get("offset")
+            if isinstance(offset, (int, float)):
+                # the client reports "server ahead by offset", i.e.
+                # slave_wall + offset = master_wall — exactly the
+                # additive correction merge_parts applies
+                self.trace_collector.set_offset(
+                    conn.slave.mid, float(offset), msg.get("delay"))
+                self.debug("slave %s clock offset %.6fs (delay %.6fs)",
+                           conn.slave.id[:8], offset,
+                           msg.get("delay") or -1.0)
+        elif mtype == "trace_chunk":
+            try:
+                chunk = unpack_payload(payload, msg.get("codec", "none"))
+            except Exception as exc:
+                self.warning("undecodable trace chunk from slave %s "
+                             "dropped (%s: %s)", conn.slave.id[:8],
+                             type(exc).__name__, exc)
+            else:
+                self.trace_collector.add_chunk(conn.slave.mid, chunk)
         return conn
 
     def _blacklist(self, mid):
@@ -336,7 +373,10 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         slave = SlaveDescription(sid, mid, msg.get("pid", 0),
                                  msg.get("power", 1.0))
         conn = _SlaveConn(slave, reader, writer)
-        ack = {"type": "handshake_ack", "id": sid}
+        # the run's trace id rides the protocol header: every span or
+        # chunk the slave records correlates back to THIS master run
+        ack = {"type": "handshake_ack", "id": sid,
+               "trace": self.trace_id}
         epoch = getattr(getattr(self.workflow, "loader", None),
                         "epoch_number", None)
         if epoch is not None:
@@ -398,11 +438,15 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                 if fault.action == "stall":
                     await asyncio.sleep(fault.param or 0.5)
         job_id = new_id()
-        conn.jobs_out[job_id] = time.time()
+        # perf_counter, not time.time: these stamps feed the adaptive
+        # timeout and the job-latency stats, and a wall-clock NTP step
+        # would fake a straggler (or hide one)
+        conn.jobs_out[job_id] = time.perf_counter()
         self.jobs_dispatched += 1
         _registry.counter("server.jobs_dispatched").inc()
         _tracer.instant("proto.job_out", cat="proto",
-                        slave=conn.slave.id[:8], job=job_id[:8])
+                        slave=conn.slave.id[:8], job=job_id[:8],
+                        trace=self.trace_id[:8])
         self._send(conn.writer, {"type": "job", "job_id": job_id},
                    payload=data, conn=conn)
 
@@ -411,7 +455,7 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         job_id = msg.get("job_id")
         started = conn.jobs_out.pop(job_id, None)
         if started is not None:
-            elapsed = time.time() - started
+            elapsed = time.perf_counter() - started
             conn.job_times.append(elapsed)
             self._all_job_times.append(elapsed)
         # numerics quarantine (docs/health.md): validate BEFORE
@@ -421,7 +465,8 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         # requeues exactly like a slave death, so recovery is exact.
         _tracer.instant("proto.update_in", cat="proto",
                         slave=conn.slave.id[:8],
-                        job=str(job_id or "")[:8])
+                        job=str(job_id or "")[:8],
+                        trace=self.trace_id[:8])
         if not await self._in_thread(health.all_finite, update):
             self.quarantined += 1
             _registry.counter("server.quarantined").inc()
@@ -432,6 +477,9 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                 "quarantining slave %s (mid %s): non-finite update "
                 "payload dropped, blacklisted for %.0fs",
                 conn.slave.id[:8], conn.slave.mid, self.blacklist_ttl)
+            # black-box dump: the quarantine decision plus the ring of
+            # spans/heartbeats leading up to it, loadable post-mortem
+            _flight.dump(reason="quarantine")
             self._send(conn.writer, {"type": "update_ack", "result": 0})
             self._drop(conn, "poisoned update")
             try:
@@ -480,7 +528,7 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             if not self._paused:
                 await self._release_parked()
             threshold = self._timeout_threshold()
-            now = time.time()
+            now = time.perf_counter()
             for conn in list(self.slaves.values()):
                 overdue = [jid for jid, t0 in conn.jobs_out.items()
                            if now - t0 > threshold]

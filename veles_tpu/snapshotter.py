@@ -40,6 +40,7 @@ from veles_tpu import chaos
 from veles_tpu.config import root
 from veles_tpu.health import RollbackExhausted
 from veles_tpu.mutable import Bool
+from veles_tpu.observe.flight import flight as _flight
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.units import Unit
@@ -633,6 +634,9 @@ class SnapshotterBase(Unit):
                 self.rollback_budget, reason or "unspecified")
             _tracer.instant("snapshot.rollback", cat="snapshot",
                             path=path, reason=reason)
+            # the pre-rollback timeline is about to be overwritten by
+            # the restored state's — preserve it in a black-box dump
+            _flight.dump(reason="rollback")
             return path
         raise SnapshotError(
             "no verified snapshot to roll back to in %s (%s)" %

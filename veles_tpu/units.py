@@ -308,9 +308,11 @@ class Unit(Distributable, metaclass=UnitRegistry):
         elapsed = time.perf_counter() - start
         self.timers["run"] += elapsed
         self.run_calls += 1
-        if _tracer.enabled:
+        if _tracer.active:
             # the trace span and the accumulated timer are the SAME
-            # measurement — print_stats and Perfetto cannot disagree
+            # measurement — print_stats and Perfetto cannot disagree.
+            # .active (tracing on OR flight ring on) so the black-box
+            # recorder sees unit spans in ordinary untraced runs too
             _tracer.complete(self.name, start, elapsed, cat="unit")
         self._ran = True
         if self.timings:

@@ -434,8 +434,18 @@ class StatusReporter(object):
 
     def snapshot(self):
         from veles_tpu.observe.metrics import health_snapshot
+        from veles_tpu.observe.metrics import registry as _registry
         decision = getattr(self.workflow, "decision", None)
         launcher = self.workflow.launcher
+        if _registry.peek("xla.step_flops") is not None:
+            # refresh the live MFU gauge from the recent step-time
+            # window so the health block carries it (reporter thread:
+            # off the step path by construction)
+            try:
+                from veles_tpu.observe import xla_introspect
+                xla_introspect.mfu_snapshot()
+            except Exception:
+                pass
         return {
             "id": self.session_id,
             "workflow": type(self.workflow).__name__,

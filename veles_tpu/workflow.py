@@ -240,7 +240,7 @@ class Workflow(Unit):
             self._running_ = False
             elapsed = time.perf_counter() - start
             self._run_time_ += elapsed
-            if _tracer.enabled:
+            if _tracer.active:
                 _tracer.complete("%s.run" % self.name, start, elapsed,
                                  cat="workflow")
             self.event("run", "end")
@@ -285,7 +285,7 @@ class Workflow(Unit):
             elapsed = time.perf_counter() - start
             self._method_timers[name] = (
                 self._method_timers.get(name, 0.0) + elapsed)
-            if _tracer.enabled:
+            if _tracer.active:
                 _tracer.complete(name, start, elapsed, cat="distributed")
 
     def generate_data_for_master(self):
