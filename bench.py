@@ -70,6 +70,9 @@ SECTION_EST = {
     # two small MLP programs (MNIST-784 head + an AlexNet-shaped input
     # head), each compiled once and A/B'd with the pipeline on/off
     "pipeline_ab": 90.0,
+    # compile-only flat-vs-bucketed SPMD collective audit (small MLP,
+    # two cheap compiles, no execution)
+    "comm_bucketed": 45.0,
 }
 
 # a section whose dominant cost (the one-time server compile) loosely
@@ -783,6 +786,74 @@ ALEXNET_PRECISION_NOTE = (
     "amortize.")
 
 
+def bench_comm_bucketed(small):
+    """Compile-only audit of the SPMD bucketed gradient all-reduce on
+    this host's devices (docs/distributed.md): lower the flat and the
+    bucketed data-parallel step of a small MLP, count the gradient
+    all-reduce ops in the optimized HLO, and report the modeled
+    overlap — the same receipt SCALING.json carries for the full
+    AlexNet, cheap enough to ride every bench round.  Skipped on
+    single-device hosts (no data axis to reduce over)."""
+    import jax
+
+    from veles_tpu.compiler import LayerPlan, build_train_step
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.parallel import make_mesh
+    from veles_tpu.parallel.analysis import parse_collective_ops
+    from veles_tpu.parallel.bucketed import overlap_model
+
+    n = len(jax.devices())
+    if n < 2:
+        return {"skipped": "single device: no data axis"}
+    mesh = make_mesh({"data": n})
+    # small mode shrinks the model (fewer/smaller buckets, faster
+    # compiles) but keeps >1 bucket so the audit still bites
+    hidden, classes, fan_in = (64, 10, 196) if small else (256, 10, 784)
+    hyper = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    plans = [LayerPlan(All2AllTanh, hyper=hyper),
+             LayerPlan(All2AllSoftmax, hyper=hyper)]
+    rng = numpy.random.RandomState(0)
+
+    def layer(fi, fo):
+        return {"weights": rng.rand(fi, fo).astype(numpy.float32),
+                "bias": numpy.zeros(fo, numpy.float32),
+                "accum_weights": numpy.zeros((fi, fo), numpy.float32),
+                "accum_bias": numpy.zeros(fo, numpy.float32),
+                "accum2_weights": None, "accum2_bias": None}
+    state = [layer(fan_in, hidden), layer(hidden, classes)]
+    grad_bytes = 4 * (fan_in * hidden + hidden +
+                      hidden * classes + classes)
+    batch = 8 * n
+    x = jax.ShapeDtypeStruct((batch, fan_in), numpy.float32)
+    y = jax.ShapeDtypeStruct((batch,), numpy.int32)
+    bucket_mb = 0.02 if small else 0.25  # ~3-4 buckets either way
+
+    def audit(mb):
+        step = build_train_step(plans, mesh=mesh, grad_bucket_mb=mb,
+                                donate=False)
+        hlo = step.lower(state, x, y,
+                         numpy.float32(batch)).compile().as_text()
+        return [op["bytes"] for op in parse_collective_ops(hlo)
+                if op["kind"] == "all-reduce" and op["bytes"] >= 1024]
+
+    flat_ops = audit(float("inf"))
+    bucket_ops = audit(bucket_mb)
+    model = overlap_model(grad_bytes, len(bucket_ops), n,
+                          step_seconds=None)
+    return {
+        "n_devices": n,
+        "grad_bytes": grad_bytes,
+        "bucket_mb": bucket_mb,
+        "flat_allreduce_ops": len(flat_ops),
+        "bucketed_allreduce_ops": len(bucket_ops),
+        "bucketed_op_bytes": bucket_ops,
+        "t_comm_ms_model": round(model["t_comm_s"] * 1e3, 4),
+        "ok": (len(flat_ops) == 1
+               and len(bucket_ops) > 1
+               and sum(bucket_ops) == sum(flat_ops)),
+    }
+
+
 def _build_native():
     from veles_tpu import native
     native.build_native()
@@ -921,6 +992,13 @@ def main():
     pipeline_res = section("pipeline_ab", lambda: bench_pipeline(small))
     if pipeline_res is not None:
         extras["pipeline_ab"] = pipeline_res
+
+    # SPMD comm audit: flat vs bucketed collective op counts + modeled
+    # overlap (compile-only; skipped on single-device hosts)
+    comm_res = section("comm_bucketed",
+                       lambda: bench_comm_bucketed(small))
+    if comm_res is not None:
+        extras["comm_bucketed"] = comm_res
 
     # AlexNet rows, one program (= one ~60-200 s server compile) each.
     # Batch 256 bf16 = the throughput/MFU sweet spot and the only

@@ -1,9 +1,15 @@
 """Slave-side control plane client.
 
-TPU-native counterpart of reference veles/client.py:404.  Preserved
-capabilities: checksum handshake with computing-power report, the
-job -> do_job -> update cycle, ASYNC-SLAVE pipelining (request the next
-job while the previous update is still in flight, reference
+TPU-native counterpart of reference veles/client.py:404.  Like the
+Server, this plane is DEMOTED since the SPMD split
+(docs/distributed.md): per-step gradients ride ICI inside the compiled
+shard_map step, so the update payloads a slave ships here are small
+control records (membership, loader bookkeeping, metrics) — the
+protocol's elasticity semantics matter, its bandwidth no longer does.
+
+Preserved capabilities: checksum handshake with computing-power report,
+the job -> do_job -> update cycle, ASYNC-SLAVE pipelining (request the
+next job while the previous update is still in flight, reference
 client.py:278-354), reconnection with an attempt budget, and
 ``death_probability`` fault injection for chaos testing
 (client.py:303-307).

@@ -143,10 +143,21 @@ def build_forward(plans):
     return forward
 
 
-def _build_step_fn(plans, loss):
+def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
+                   row_offset_fn=None):
     """The raw (unjitted) train-step function shared by
     build_train_step (which jits one minibatch per dispatch) and
-    build_train_epoch (which lax.scans it — one dispatch per epoch)."""
+    build_train_epoch (which lax.scans it — one dispatch per epoch).
+
+    SPMD hooks (used by the shard_map data plane, None elsewhere):
+    ``grad_sync(grads)`` runs right after the backward — the bucketed
+    cross-device all-reduce slots in here, BEFORE the numerics guard,
+    so a poisoned gradient on ANY shard makes every replica skip the
+    same step bit-exactly.  ``metric_sync(scalar)`` globalizes the
+    loss/aux scalars (psum over the data axis).  ``row_offset_fn()``
+    returns this shard's global row offset so the mse tail mask keys
+    on GLOBAL row indices (a short minibatch's padded rows live in the
+    last shard)."""
     import jax
     import jax.numpy as jnp
 
@@ -167,8 +178,10 @@ def _build_step_fn(plans, loss):
         # mse
         out2 = out.reshape(out.shape[0], -1)
         t2 = target.reshape(target.shape[0], -1)
-        mask = (jnp.arange(out2.shape[0]) < batch_size
-                ).astype(out2.dtype)[:, None]
+        rows = jnp.arange(out2.shape[0])
+        if row_offset_fn is not None:
+            rows = rows + row_offset_fn()
+        mask = (rows < batch_size).astype(out2.dtype)[:, None]
         diff = (out2 - t2) * mask
         # aux: per-sample mean over features, summed over samples — the
         # same definition EvaluatorMSE uses, so train and eval epoch
@@ -193,6 +206,15 @@ def _build_step_fn(plans, loss):
                 lambda g: g + grad_poison.astype(g.dtype), grads)
         if loss_poison is not None:
             loss_value = loss_value + loss_poison
+        if grad_sync is not None:
+            # SPMD data plane: bucketed all-reduce of the LOCAL grads.
+            # Poisons inject before the sync so a chaos fault on one
+            # shard spreads (like a real bad chip) and the finiteness
+            # guard below skips the step uniformly on every replica.
+            grads = grad_sync(grads)
+        if metric_sync is not None:
+            loss_value = metric_sync(loss_value)
+            aux = metric_sync(aux)
 
         # numerics guard: one all-isfinite reduction over the loss and
         # the global grad-norm.  A single inf/nan anywhere in the
@@ -281,7 +303,9 @@ def step_compiler_options():
 
 def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
                      state_shardings=None, batch_sharding=None,
-                     donate=True, compiler_options=None):
+                     donate=True, compiler_options=None,
+                     grad_bucket_mb=None, grad_compress=None,
+                     grad_allreduce_impl="psum"):
     """Compile fn(state, x, labels_or_targets, batch_size) ->
     (new_state, metrics).
 
@@ -297,8 +321,28 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
     short minibatches don't retrigger compilation.
     ``compiler_options``: per-program XLA options (see
     :func:`step_compiler_options` for the tuned per-chip set).
+
+    Distributed variants (docs/distributed.md):
+
+    - ``mesh`` + ``state_shardings``: the annotation (pjit) path — XLA
+      inserts the data-parallel gradient psum from the shardings.
+    - ``mesh`` + ``grad_bucket_mb``: the SPMD shard_map path — the
+      inner loop is explicit per-device code and the gradient merge is
+      a BUCKETED all-reduce (parallel/bucketed.py): one collective per
+      ~``grad_bucket_mb`` MB of gradients, issued in backward
+      production order so the wire time overlaps the remaining
+      backward.  ``float("inf")`` means one flat bucket (the
+      bit-equality reference).  ``grad_compress="bf16"`` halves the
+      wire bytes (numerics-guard + trainer fallback own the risk);
+      ``grad_allreduce_impl`` picks ``"psum"`` (default) or ``"ring"``
+      (explicit ppermute ring from parallel/ring.py).
     """
     import jax
+
+    if mesh is not None and grad_bucket_mb is not None:
+        return _build_spmd_train_step(
+            plans, loss, mesh, data_axis, grad_bucket_mb, grad_compress,
+            grad_allreduce_impl, donate, compiler_options)
 
     step = _build_step_fn(plans, loss)
 
@@ -324,8 +368,95 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
                          grad_poison=None, loss_poison=None):
             return jitted(state, x, target, batch_size, step_key,
                           grad_poison, loss_poison)
+        sharded_step.lower = _fixed_arity_lower(jitted)
         return sharded_step
     return jax.jit(step, **jit_kwargs)
+
+
+def _fixed_arity_lower(jitted):
+    """A ``.lower`` for the fixed-arity step wrappers, so callers that
+    introspect the compiled program (step-FLOPs publication, the
+    collective-bytes receipts) work on the wrapped paths too."""
+    def lower(state, x, target, batch_size, step_key=None,
+              grad_poison=None, loss_poison=None):
+        return jitted.lower(state, x, target, batch_size, step_key,
+                            grad_poison, loss_poison)
+    return lower
+
+
+def _build_spmd_train_step(plans, loss, mesh, data_axis, grad_bucket_mb,
+                           grad_compress, grad_allreduce_impl, donate,
+                           compiler_options):
+    """The pure-SPMD data plane: shard_map over ``mesh``'s data axis,
+    per-device backward on the local batch shard, bucketed gradient
+    all-reduce (parallel/bucketed.py), replicated update.  State and
+    metrics ride replicated; batch/targets are sharded on the leading
+    dim.  Returns the same fixed-arity step the other paths do."""
+    import math as _math
+
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu.parallel import bucketed as _bucketed
+    from veles_tpu.parallel.mesh import shard_map
+
+    n = mesh.shape[data_axis]
+    bucket_bytes = (float("inf") if _math.isinf(float(grad_bucket_mb))
+                    else float(grad_bucket_mb) * 2.0 ** 20)
+
+    def grad_sync(grads):
+        return _bucketed.bucketed_all_reduce(
+            grads, data_axis, bucket_bytes=bucket_bytes,
+            impl=grad_allreduce_impl, compress=grad_compress,
+            axis_size=n)
+
+    def metric_sync(value):
+        return lax.psum(value, data_axis)
+
+    def row_offset_fn():
+        # recomputed lazily inside the traced step: local row count is
+        # not known until the batch shard's shape is
+        return lax.axis_index(data_axis) * _local_rows[0]
+
+    _local_rows = [0]
+    raw = _build_step_fn(plans, loss, grad_sync=grad_sync,
+                         metric_sync=metric_sync,
+                         row_offset_fn=row_offset_fn)
+
+    def local_step(state, x, target, batch_size, step_key,
+                   grad_poison, loss_poison):
+        _local_rows[0] = x.shape[0]
+        if step_key is not None:
+            # distinct dropout stream per shard: the pjit path draws
+            # ONE mask over the global batch; the SPMD shards must not
+            # all reuse the same per-row noise
+            step_key = jax.random.fold_in(
+                step_key, lax.axis_index(data_axis))
+        return raw(state, x, target, batch_size, step_key,
+                   grad_poison, loss_poison)
+
+    spmd = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(data_axis), P(data_axis), P(), P(), P(), P()),
+        out_specs=(P(), P()), check_vma=False)
+
+    jit_kwargs = {}
+    if compiler_options:
+        jit_kwargs["compiler_options"] = compiler_options
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    jitted = jax.jit(spmd, **jit_kwargs)
+
+    def spmd_step(state, x, target, batch_size, step_key=None,
+                  grad_poison=None, loss_poison=None):
+        return jitted(state, x, target, batch_size, step_key,
+                      grad_poison, loss_poison)
+    spmd_step.lower = _fixed_arity_lower(jitted)
+    spmd_step.mesh = mesh
+    spmd_step.data_axis = data_axis
+    spmd_step.bucket_bytes = bucket_bytes
+    return spmd_step
 
 
 def _labels_sharding(mesh, data_axis, loss):

@@ -14,7 +14,7 @@ import math
 import numpy
 
 __all__ = ["all_finite", "DivergenceError", "RollbackExhausted",
-           "is_finite_metric"]
+           "is_finite_metric", "PoisonedUpdate"]
 
 
 class DivergenceError(RuntimeError):
@@ -26,6 +26,19 @@ class DivergenceError(RuntimeError):
 class RollbackExhausted(DivergenceError):
     """The bounded rollback retry budget is spent and the run still
     diverges; continuing would loop rollback -> divergence forever."""
+
+
+class PoisonedUpdate(RuntimeError):
+    """A slave update failed the inline finiteness validation
+    (``Workflow.apply_update_validated``).  Raised BEFORE the poisoned
+    part touched any state; the server's quarantine path treats it
+    exactly like a failed pre-walk (drop + TTL blacklist + requeue)."""
+
+    def __init__(self, unit=None):
+        name = type(unit).__name__ if unit is not None else "?"
+        super(PoisonedUpdate, self).__init__(
+            "non-finite update part for unit %s" % name)
+        self.unit_name = name
 
 
 def is_finite_metric(metric):

@@ -85,6 +85,13 @@ class _FarmMaster(object):
     ``submit()`` resumes them through the server's parked-requester
     release (clients never poll — see client.py's 'wait' handling)."""
 
+    #: farm results are opaque job payloads, not per-unit control
+    #: records: keep the Server's all-or-nothing finiteness prewalk (a
+    #: NaN fitness/result quarantines the worker BEFORE results[] or
+    #: the duration stats mutate) rather than the SPMD planes' inline
+    #: validate-during-apply (docs/distributed.md)
+    update_validation = "prewalk"
+
     def __init__(self, checksum, speculation_factor=2.0,
                  min_speculation_s=5.0, context=None):
         self.checksum = checksum
@@ -155,6 +162,16 @@ class _FarmMaster(object):
                     copies[slave.id] = now
                     return (self.epoch, i, self._specs[i])
             return False            # park until an update frees work
+
+    def apply_update_validated(self, update, slave):
+        """Inline-validation form for farms that opt in
+        (``update_validation = "inline"``): a farm update is ONE
+        opaque part, so validate-then-apply is already a single
+        traversal."""
+        from veles_tpu import health
+        if not health.all_finite(update):
+            raise health.PoisonedUpdate(self)
+        return self.apply_data_from_slave(update, slave)
 
     def apply_data_from_slave(self, update, slave):
         epoch, i, result = update

@@ -101,6 +101,17 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                  "window via VELES_PROFILE_WINDOW=start:stop, "
                  "default 5:25)")
         parser.add_argument(
+            "--grad-bucket-mb", type=float, default=None, metavar="MB",
+            help="SPMD data plane: target size of the gradient "
+                 "all-reduce buckets overlapped with the backward "
+                 "pass (default ~25; 'inf' = one flat bucket; "
+                 "docs/distributed.md)")
+        parser.add_argument(
+            "--grad-compress", default=None, choices=["bf16"],
+            help="compress gradient all-reduce wire traffic; guarded "
+                 "by the numerics watchdog with automatic f32 "
+                 "fallback on a poisoned step")
+        parser.add_argument(
             "--resume", default="", metavar="auto|PATH",
             help="restore the workflow from a snapshot before "
                  "initialize: 'auto' resumes from the newest validated "
@@ -123,6 +134,13 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "metrics_path": getattr(args, "metrics_path", ""),
             "profile": getattr(args, "profile", ""),
         })
+        train_cfg = {}
+        if getattr(args, "grad_bucket_mb", None) is not None:
+            train_cfg["grad_bucket_mb"] = args.grad_bucket_mb
+        if getattr(args, "grad_compress", None) is not None:
+            train_cfg["grad_compress"] = args.grad_compress
+        if train_cfg:
+            root.common.train.update(train_cfg)
         if getattr(args, "resume", ""):
             root.common.snapshot.update({"resume": args.resume})
 

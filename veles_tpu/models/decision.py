@@ -152,8 +152,16 @@ class DecisionBase(Unit):
         total = 0
         consec = 0
         for unit in self.health_sources:
-            total += int(unit.skip_count)
-            consec = max(consec, int(unit.consecutive_skips))
+            skips = int(unit.skip_count)
+            unit_consec = int(unit.consecutive_skips)
+            total += skips
+            consec = max(consec, unit_consec)
+            hook = getattr(unit, "on_health_sync", None)
+            if hook is not None:
+                # ride the existing sync: e.g. the fused trainer's
+                # bf16-compression -> f32 fallback reacts to fresh
+                # skips here without ever adding a per-step host sync
+                hook(skips=skips, consec=unit_consec)
         # publish to the telemetry registry HERE — this is the existing
         # once-per-class device sync, so dashboards/heartbeats read the
         # counters as plain ints without ever touching the device

@@ -53,6 +53,16 @@ class FusedTrainer(Unit):
         self.pipeline = kwargs.get("pipeline", False)
         self.pipeline_depth = kwargs.get("pipeline_depth", 1)
         self._prefetcher = None
+        #: SPMD data plane (docs/distributed.md): with a mesh, the
+        #: step compiles as shard_map over ``data_axis`` and the
+        #: gradient merge is the bucketed overlapped all-reduce
+        #: (parallel/bucketed.py) instead of a flat pjit psum
+        self.mesh = kwargs.get("mesh")
+        self.data_axis = kwargs.get("data_axis", "data")
+        self.grad_bucket_mb = kwargs.get("grad_bucket_mb")
+        #: "bf16" halves gradient wire bytes; auto-falls back to f32
+        #: when the health watchdog sees a skipped (non-finite) step
+        self.grad_compress = kwargs.get("grad_compress")
         # evaluator-compatible surface for DecisionGD / DecisionMSE
         self.n_err = 0
         self.mse_sum = 0.0
@@ -72,10 +82,45 @@ class FusedTrainer(Unit):
         #: XLA cost-model FLOPs of one compiled step (None until the
         #: first step ran; 0.0 when cost analysis is unavailable)
         self._step_flops_ = None
+        #: comm receipt state (SPMD mode): published once, at the
+        #: first post-compile step whose wall time is clean
+        self._comm_published_ = False
+        #: skip count already attributed at the last health sync —
+        #: growth while compression is on triggers the f32 fallback
+        self._compress_skips_seen_ = 0
+
+    def _restore_mesh(self):
+        """Rebuild the SPMD mesh after unpickling (a Mesh holds live
+        device handles, so snapshots carry its AXES instead): same
+        shape when the host still has the devices; a single-axis
+        (pure-DP) mesh re-spans whatever devices exist now; a
+        multi-axis shape that no longer fits fails LOUDLY rather than
+        silently degrading to a single-device step."""
+        axes = getattr(self, "_spmd_axes_", None)
+        if not axes or self.mesh is not None:
+            return
+        from veles_tpu.parallel import auto_mesh, make_mesh
+        try:
+            self.mesh = make_mesh(dict(axes))
+        except ValueError as exc:
+            if len(axes) == 1:
+                self.mesh = auto_mesh(next(iter(axes)))
+                self.warning(
+                    "resumed SPMD mesh %s does not fit this host "
+                    "(%s); re-spanning the data axis over %d devices",
+                    dict(axes), exc,
+                    self.mesh.shape[next(iter(axes))])
+            else:
+                raise ValueError(
+                    "cannot rebuild the resumed SPMD mesh %s on this "
+                    "host: %s — re-fuse with an explicit mesh"
+                    % (dict(axes), exc))
 
     def initialize(self, device=None, **kwargs):
         self.device = device
+        self._restore_mesh()
         if (self.pipeline and self._prefetcher is None
+                and self.mesh is None
                 and device is not None
                 and getattr(device, "exists", False)
                 and self.sw.workflow_mode == "standalone"):
@@ -99,9 +144,25 @@ class FusedTrainer(Unit):
         _xla.ensure_installed()
         plans = workflow_plan(self.sw)
         self._plans = plans
-        self._step_fn = build_train_step(
-            plans, loss=self.loss, donate=True,
-            compiler_options=step_compiler_options())
+        # the step that triggers a (re)compile pays the compile in its
+        # wall time; the comm receipt must be sized on a CLEAN step,
+        # so publication waits two iterations past ANY compile (the
+        # bf16->f32 fallback recompiles mid-run)
+        self._compiled_at_iter_ = self._iteration
+        if self.mesh is not None:
+            from veles_tpu.parallel.bucketed import DEFAULT_BUCKET_MB
+            bucket_mb = (self.grad_bucket_mb
+                         if self.grad_bucket_mb is not None
+                         else DEFAULT_BUCKET_MB)
+            self._step_fn = build_train_step(
+                plans, loss=self.loss, mesh=self.mesh,
+                data_axis=self.data_axis, grad_bucket_mb=bucket_mb,
+                grad_compress=self.grad_compress, donate=True,
+                compiler_options=step_compiler_options())
+        else:
+            self._step_fn = build_train_step(
+                plans, loss=self.loss, donate=True,
+                compiler_options=step_compiler_options())
         forward = build_forward(plans)
 
         # eval metrics fused INTO the forward dispatch: one async call
@@ -123,6 +184,12 @@ class FusedTrainer(Unit):
                 return jnp.sum(jnp.mean(diff * diff, axis=1) * mask)
         self._eval_metrics = jax.jit(eval_metrics)
         self._state = extract_state(self.sw)
+        if self.mesh is not None:
+            # replicate over the WHOLE mesh (copies — the unit Arrays
+            # stay authoritative on host); eval reuses these replicated
+            # params, so its jit runs on the same device set
+            from veles_tpu.parallel.api import replicate
+            self._state = replicate(self.mesh, self._state)
         self._has_dropout = any(
             p.static.get("dropout_ratio") is not None for p in plans)
         # recompile detection (docs/observability.md): each of these
@@ -172,6 +239,81 @@ class FusedTrainer(Unit):
         except Exception as exc:
             self.debug("step cost analysis unavailable: %s", exc)
 
+    def _stage_sharded(self, arr):
+        """Stage one minibatch Array onto the mesh, leading dim over
+        ``data_axis``.  Multi-host processes stitch their local slice
+        (parallel.shard_host_batch); single-process meshes device_put
+        the full batch.  The host buffer is COPIED first: XLA:CPU's
+        device_put adopts host memory zero-copy, and the loader refills
+        ``mem`` on the next serve (the PR 1 hazard)."""
+        from veles_tpu.parallel.api import shard_host_batch
+        arr.map_read()
+        host = numpy.array(arr.mem)
+        if host.shape[0] % self.mesh.shape[self.data_axis]:
+            raise ValueError(
+                "minibatch rows %d not divisible by mesh axis %r=%d"
+                % (host.shape[0], self.data_axis,
+                   self.mesh.shape[self.data_axis]))
+        return shard_host_batch(self.mesh, host, self.data_axis)
+
+    def _publish_comm(self, step_seconds):
+        """One-time comm receipt (SPMD mode): the exact bucket
+        partition the compiled step runs (plan_buckets is
+        deterministic) plus the modeled overlap schedule, published as
+        ``comm.*`` gauges and per-bucket spans (docs/observability.md).
+        ``step_seconds`` is the first clean post-compile step wall."""
+        import jax
+
+        from veles_tpu.parallel import bucketed as _bucketed
+        self._comm_published_ = True
+        try:
+            grads_like = [{"weights": s["weights"], "bias": s["bias"]}
+                          for s in self._state]
+            leaves = jax.tree_util.tree_leaves(grads_like)
+            receipt = _bucketed.comm_receipt(
+                leaves, self.mesh.shape[self.data_axis],
+                bucket_bytes=getattr(self._step_fn, "bucket_bytes",
+                                     None),
+                step_seconds=step_seconds,
+                compress=self.grad_compress)
+            _bucketed.publish_comm_receipt(receipt)
+            self.info(
+                "SPMD comm: %d bucket(s), %.1f MB gradients, modeled "
+                "overlap %.1f%%",
+                len(receipt["bucket_bytes"]),
+                receipt["allreduce_bytes"] / 2.0 ** 20,
+                receipt["model"]["overlap_pct"])
+        except Exception as exc:
+            self.debug("comm receipt unavailable: %s", exc)
+
+    def on_health_sync(self, skips, consec):
+        """Health-watchdog hook (decision._health_counters, the
+        existing once-per-class device sync): a skipped step while
+        bf16 gradient compression is on means the compressed wire
+        format may have produced the non-finite — fall back to f32
+        (drop the compiled step; the next run() recompiles) rather
+        than risk skipping every step of a run that f32 would carry.
+        The skipped update itself was already discarded bit-exactly by
+        the in-graph guard, so the fallback costs one recompile and
+        nothing else (docs/health.md)."""
+        if (self.grad_compress is not None
+                and skips > self._compress_skips_seen_):
+            self.warning(
+                "non-finite step under %s gradient compression; "
+                "falling back to f32 all-reduce (recompile)",
+                self.grad_compress)
+            _registry.counter("comm.compress_fallbacks").inc()
+            # write the live fused state back into the unit Arrays
+            # BEFORE dropping it: the recompile re-extracts from the
+            # Arrays, whose old device buffers were donated into the
+            # compressed step and no longer exist
+            self.sync()
+            self.grad_compress = None
+            self._step_fn = None
+            self._state = None
+            self._comm_published_ = False
+        self._compress_skips_seen_ = skips
+
     def sync(self):
         """Write the fused state back into the unit Arrays (on demand:
         snapshots, plotting, package export)."""
@@ -191,7 +333,12 @@ class FusedTrainer(Unit):
         is_train = loader.minibatch_class == TRAIN
         prefetched = (self._prefetcher.current
                       if self._prefetcher is not None else None)
-        if prefetched is not None:
+        if self.mesh is not None:
+            x = self._stage_sharded(loader.minibatch_data)
+            target = self._stage_sharded(
+                loader.minibatch_labels if self.loss == "softmax"
+                else loader.minibatch_targets)
+        elif prefetched is not None:
             # pipelined path: the worker already filled + H2D'd this
             # minibatch one step ahead; its device arrays ARE the input
             x = prefetched.data
@@ -266,6 +413,14 @@ class FusedTrainer(Unit):
                     params, x, target, batch_size)
         self.n_samples = int(batch_size)
         elapsed = time.perf_counter() - t0
+        if (is_train and self.mesh is not None
+                and not self._comm_published_
+                and self._iteration >=
+                getattr(self, "_compiled_at_iter_", 0) + 2):
+            # the first post-compile step's wall includes the compile;
+            # this one is the first clean step time the overlap model
+            # can be sized on
+            self._publish_comm(elapsed)
         if is_train:
             self._m_train_step_.observe(elapsed)
             self._m_steps_.inc()
@@ -313,6 +468,11 @@ class FusedTrainer(Unit):
         state["_state"] = None
         state["_eval_metrics"] = None
         state["_plans"] = None
+        # a Mesh holds live device handles, so only its AXES pickle;
+        # initialize() -> _restore_mesh rebuilds it on resume
+        state["mesh"] = None
+        state["_spmd_axes_"] = (dict(self.mesh.shape)
+                                if self.mesh is not None else None)
         # re-created (and re-attached to the loader) at initialize
         state["_prefetcher"] = None
         # concretize lazy device metrics for the pickle
@@ -329,7 +489,8 @@ class FusedTrainer(Unit):
 
 
 def fuse_standard_workflow(sw, dropout_seed=0, pipeline=False,
-                           pipeline_depth=1):
+                           pipeline_depth=1, mesh=None, data_axis="data",
+                           grad_bucket_mb=None, grad_compress=None):
     """Rewire a StandardWorkflow: loader -> FusedTrainer -> decision.
 
     The forward/GD units stay constructed (they own the param Arrays and
@@ -337,10 +498,30 @@ def fuse_standard_workflow(sw, dropout_seed=0, pipeline=False,
     additionally overlaps host fill + H2D of minibatch k+1 with step k
     (pipeline_input.Prefetcher); it falls back to the synchronous serve
     on devices without real hardware or in distributed modes.
+
+    ``mesh`` switches the trainer to the SPMD data plane: the step
+    compiles as shard_map over ``data_axis`` with the bucketed
+    overlapped gradient all-reduce (``grad_bucket_mb``, default ~25 MB
+    via ``--grad-bucket-mb``; ``grad_compress="bf16"`` via
+    ``--grad-compress``).  With a mesh the master-slave protocol
+    carries CONTROL records only — per-step gradients ride ICI — so
+    the workflow flips to the single-traversal inline update
+    validation (docs/distributed.md, ``Workflow.update_validation``).
     """
+    from veles_tpu.config import root
+    train_cfg = root.common.train
+    if grad_bucket_mb is None:
+        grad_bucket_mb = train_cfg.get("grad_bucket_mb")
+    if grad_compress is None:
+        grad_compress = train_cfg.get("grad_compress")
     trainer = FusedTrainer(sw, sw, dropout_seed=dropout_seed,
                            pipeline=pipeline,
-                           pipeline_depth=pipeline_depth)
+                           pipeline_depth=pipeline_depth,
+                           mesh=mesh, data_axis=data_axis,
+                           grad_bucket_mb=grad_bucket_mb,
+                           grad_compress=grad_compress)
+    if mesh is not None:
+        sw.update_validation = "inline"
     # detach the old chain from control flow
     for unit in sw.forwards + [sw.evaluator] + sw.gds:
         unit.unlink_all()

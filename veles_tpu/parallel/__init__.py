@@ -10,7 +10,10 @@ requeue) stay in veles_tpu.server/client as a host-side concern.
 - mesh.py     — mesh discovery/construction (devices -> named axes)
 - api.py      — shard/replicate placement helpers + DP/TP sharding
                 rules for the fused train step
-- ring.py     — ring + Ulysses sequence-parallel attention
+- bucketed.py — size-targeted gradient buckets all-reduced in backward
+                production order (the overlap-credited SPMD data plane)
+- ring.py     — ring + Ulysses sequence-parallel attention, plus the
+                explicit ppermute ring all-reduce
 - pipeline.py — GPipe wavefront pipeline parallelism
 - moe.py      — sharded mixture-of-experts
 """
@@ -20,4 +23,7 @@ from veles_tpu.parallel.api import (  # noqa: F401
     replicate, shard_batch, mlp_state_shardings, batch_sharding,
     shard_host_batch)
 from veles_tpu.parallel.ring import (  # noqa: F401
-    ring_attention, ulysses_attention)
+    ring_attention, ulysses_attention, ring_all_reduce)
+from veles_tpu.parallel.bucketed import (  # noqa: F401
+    DEFAULT_BUCKET_MB, BucketPlan, plan_buckets, bucketed_all_reduce,
+    flat_all_reduce, comm_receipt, publish_comm_receipt)

@@ -7,7 +7,8 @@ the ICI scaling model), and handy for eyeballing sharding regressions.
 
 import re
 
-__all__ = ["parse_collective_bytes", "collective_bytes"]
+__all__ = ["parse_collective_bytes", "parse_collective_ops",
+           "collective_bytes"]
 
 _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
                 "f64": 8, "s64": 8, "u64": 8, "pred": 1, "s8": 1,
@@ -22,6 +23,39 @@ _COLLECTIVES = ("all-reduce(", "all-reduce-start(",
                 "collective-permute(", "collective-permute-start(")
 
 
+def _base(kind):
+    return kind.rstrip("(").replace("-start", "")
+
+
+def parse_collective_ops(hlo_text, kinds=_COLLECTIVES):
+    """Per-OP collective inventory of optimized HLO text: a list of
+    ``{"kind", "bytes"}`` in program order.  This is how the bucketed
+    gradient all-reduce is audited (scripts/scaling.py, the dist smoke
+    test): the flat path shows ONE ~250 MB all-reduce, the bucketed
+    path one op per bucket — if XLA's combiner ever re-fuses them, the
+    op count collapses and the regression is visible here."""
+    ops = []
+    for line in hlo_text.splitlines():
+        if "=" not in line:
+            continue
+        for kind in kinds:
+            if kind not in line:
+                continue
+            shapes_part = line.split("=", 1)[1].split(kind, 1)[0]
+            nbytes = 0
+            for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", shapes_part):
+                if dt not in _DTYPE_BYTES:
+                    continue
+                count = 1
+                for d in dims.split(","):
+                    if d:
+                        count *= int(d)
+                nbytes += count * _DTYPE_BYTES[dt]
+            ops.append({"kind": _base(kind), "bytes": nbytes})
+            break
+    return ops
+
+
 def parse_collective_bytes(hlo_text, kinds=_COLLECTIVES):
     """Sum result bytes of collective ops in optimized HLO text.
 
@@ -30,26 +64,9 @@ def parse_collective_bytes(hlo_text, kinds=_COLLECTIVES):
     ("all-reduce-start" -> "all-reduce").  Returns {kind: bytes} with a
     "total" key.
     """
-    def base(kind):
-        return kind.rstrip("(").replace("-start", "")
-
-    out = {base(kind): 0 for kind in kinds}
-    for line in hlo_text.splitlines():
-        if "=" not in line:
-            continue
-        for kind in kinds:
-            if kind not in line:
-                continue
-            shapes_part = line.split("=", 1)[1].split(kind, 1)[0]
-            for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", shapes_part):
-                if dt not in _DTYPE_BYTES:
-                    continue
-                count = 1
-                for d in dims.split(","):
-                    if d:
-                        count *= int(d)
-                out[base(kind)] += count * _DTYPE_BYTES[dt]
-            break
+    out = {_base(kind): 0 for kind in kinds}
+    for op in parse_collective_ops(hlo_text, kinds):
+        out[op["kind"]] += op["bytes"]
     out["total"] = sum(out.values())
     return out
 

@@ -1,4 +1,4 @@
-"""Long-context attention over the sequence axis of a mesh.
+"""Latency-hiding ring collectives over mesh axes.
 
 The reference (2015-era) has no attention; SURVEY.md section 5 marks
 long-context as "no reference behavior to match".  This framework still
@@ -12,9 +12,18 @@ ships it as a first-class capability of the parallel layer, TPU-native:
 - :func:`ulysses_attention` — the all-to-all alternative: resharding
   (seq-sharded -> head-sharded) with ``lax.all_to_all``, full local
   attention per head group, and the inverse all-to-all back.
+- :func:`ring_all_reduce` — the same ppermute ring pattern applied to
+  gradient summation: chunked reduce-scatter + all-gather, the
+  explicit spelling of the bandwidth-optimal 2(n-1)/n ring bound that
+  parallel/bucketed.py's per-bucket schedule models.  ``psum`` remains
+  the default impl (XLA lowers it to the platform's tuned collective);
+  the explicit ring is for meshes/toolchains where the hand-pipelined
+  chunk rotation wins, and as the executable form of the scaling
+  model's assumptions.
 
-Both support causal masking with globally-correct positions and are
-exact (tested against a single-device oracle on the virtual mesh).
+The attention variants support causal masking with globally-correct
+positions and are exact (tested against a single-device oracle on the
+virtual mesh).
 """
 
 import math
@@ -26,7 +35,50 @@ from jax.sharding import PartitionSpec as P
 
 from veles_tpu.parallel.mesh import shard_map
 
-__all__ = ["ring_attention", "ulysses_attention", "attention_reference"]
+__all__ = ["ring_attention", "ulysses_attention", "attention_reference",
+           "ring_all_reduce"]
+
+
+def ring_all_reduce(x, axis_name, n_shards):
+    """Sum a 1-D vector over ``axis_name`` with an explicit ring:
+    chunked reduce-scatter then all-gather via ``lax.ppermute``.
+
+    Each of the 2(n-1) steps moves one 1/n chunk to the next neighbor,
+    so per-step wire time is 1/n of the payload — the pipelining that
+    makes the ring bandwidth-optimal and lets a scheduler overlap the
+    early hops with unrelated compute.  ``n_shards`` is the static
+    axis size (callers inside shard_map know it from the mesh).
+
+    Summation ORDER differs from ``lax.psum`` (partial sums travel the
+    ring), so results are ULP-close but not bit-equal to psum; the
+    bit-equality guarantees in parallel/bucketed.py hold within one
+    impl, not across impls.
+    """
+    if n_shards == 1:
+        return x
+    length = x.shape[0]
+    pad = (-length) % n_shards
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+    chunks = x.reshape(n_shards, -1)
+    me = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+    # reduce-scatter: after n-1 rotations shard i owns the fully
+    # reduced chunk (i+1) % n
+    for step in range(n_shards - 1):
+        send = (me - step) % n_shards
+        recv = (me - step - 1) % n_shards
+        block = lax.ppermute(
+            jnp.take(chunks, send, axis=0), axis_name, perm)
+        chunks = chunks.at[recv].add(block)
+    # all-gather: rotate the reduced chunks around the ring
+    for step in range(n_shards - 1):
+        send = (me + 1 - step) % n_shards
+        block = lax.ppermute(
+            jnp.take(chunks, send, axis=0), axis_name, perm)
+        chunks = chunks.at[(me - step) % n_shards].set(block)
+    out = chunks.reshape(-1)
+    return out[:length] if pad else out
 
 
 def attention_reference(q, k, v, causal=False):

@@ -1,7 +1,16 @@
 """Master-side control plane: job farming with elastic-failure semantics.
 
-TPU-native counterpart of reference veles/server.py:659.  Preserved
-capabilities (SURVEY.md section 2.6/5):
+TPU-native counterpart of reference veles/server.py:659.  Since the
+SPMD split (docs/distributed.md) this plane is deliberately DEMOTED to
+what it is uniquely good at — membership, elasticity, quarantine, and
+checkpoint coordination: per-step gradients ride ICI inside the
+compiled shard_map step (parallel/bucketed.py), never this protocol.
+Update payloads are control records, which is what lets the master
+validate them with the single-traversal inline walk
+(``Workflow.apply_update_validated``) instead of a separate
+whole-payload prewalk.
+
+Preserved capabilities (SURVEY.md section 2.6/5):
 
 - handshake validating the workflow CHECKSUM, slave id assignment;
 - per-slave state tracking (the reference's fysom FSM collapses to a
@@ -75,6 +84,13 @@ class _SlaveConn(object):
 
 class Server(Logger, metaclass=CommandLineArgumentsRegistry):
     """Serve a workflow's jobs to connecting slaves."""
+
+    #: sentinel returned by the update validator when a payload failed
+    #: the finiteness check (either mode) -> quarantine path
+    _POISONED = object()
+    #: sentinel for an apply that raised (already acked 0); distinct
+    #: from a legal None return, which counts as a served update
+    _FAILED = object()
 
     @classmethod
     def init_parser(cls, parser):
@@ -352,6 +368,13 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         return True
 
     async def _handshake(self, msg, reader, writer):
+        if self._finishing:
+            # a join racing shutdown must not allocate per-slave
+            # resources (shm segments): the event loop may be torn
+            # down before this handler's cleanup path ever runs,
+            # leaking the segments past process exit
+            self._send(writer, {"type": "stop"})
+            return None
         checksum = msg.get("checksum")
         mid = msg.get("mid", "?")
         if checksum != self.workflow.checksum:
@@ -458,16 +481,40 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             elapsed = time.perf_counter() - started
             conn.job_times.append(elapsed)
             self._all_job_times.append(elapsed)
-        # numerics quarantine (docs/health.md): validate BEFORE
-        # apply_data_from_slave — a NaN delta merged into the global
-        # weights poisons every other slave's next job.  The offender
-        # is dropped and TTL-blacklisted; its reserved minibatch
-        # requeues exactly like a slave death, so recovery is exact.
+        # numerics quarantine (docs/health.md): a NaN payload merged
+        # into global state poisons every other slave's next job.
+        # Validation + apply run in ONE executor hop; workflows whose
+        # updates are control-plane records only (the SPMD split,
+        # update_validation == "inline") validate each part DURING the
+        # apply's own traversal — one payload walk — while legacy
+        # delta-shipping workflows keep the all-or-nothing prewalk.
         _tracer.instant("proto.update_in", cat="proto",
                         slave=conn.slave.id[:8],
                         job=str(job_id or "")[:8],
                         trace=self.trace_id[:8])
-        if not await self._in_thread(health.all_finite, update):
+
+        def check_and_apply():
+            inline = getattr(self.workflow, "apply_update_validated",
+                             None)
+            if inline is not None and getattr(
+                    self.workflow, "update_validation",
+                    "prewalk") == "inline":
+                try:
+                    return inline(update, conn.slave)
+                except health.PoisonedUpdate:
+                    return Server._POISONED
+            if not health.all_finite(update):
+                return Server._POISONED
+            return self.workflow.apply_data_from_slave(
+                update, conn.slave)
+
+        try:
+            result = await self._in_thread(check_and_apply)
+        except Exception:
+            self.exception("update application failed")
+            self._send(conn.writer, {"type": "update_ack", "result": 0})
+            result = Server._FAILED
+        if result is Server._POISONED:
             self.quarantined += 1
             _registry.counter("server.quarantined").inc()
             _tracer.instant("proto.quarantine", cat="proto",
@@ -487,18 +534,16 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             except Exception:
                 pass
             return
-        try:
-            result = await self._in_thread(
-                self.workflow.apply_data_from_slave, update, conn.slave)
+        if result is not Server._FAILED:
+            # a None return is a LEGAL apply (the IDistributable
+            # contract declares no return value) — count and ack it
+            # exactly like the pre-demotion code did
             self.updates_applied += 1
             _registry.counter("server.updates_applied").inc()
             # a productive update resets the slave's respawn backoff
             self._respawn_attempts.pop(conn.slave.mid, None)
             self._send(conn.writer, {"type": "update_ack",
                                      "result": 1 if result else 0})
-        except Exception:
-            self.exception("update application failed")
-            self._send(conn.writer, {"type": "update_ack", "result": 0})
         if self._finishing:
             self._broadcast_stop()
             return

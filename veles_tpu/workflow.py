@@ -321,6 +321,43 @@ class Workflow(Unit):
                     part, slave)
         return True
 
+    #: how the Server validates update payloads (docs/distributed.md):
+    #: "prewalk" — a standalone ``health.all_finite`` pass over the
+    #: WHOLE update before any part applies (all-or-nothing; required
+    #: while per-step parameter deltas ride the protocol, because a
+    #: partially-applied update would break the exact-requeue
+    #: guarantee); "inline" — single-traversal validate-during-apply
+    #: below (the SPMD split sets this: updates are control records
+    #: only, gradients ride ICI inside the compiled step).
+    update_validation = "prewalk"
+
+    def apply_update_validated(self, data, slave=None):
+        """Single-traversal master update path: each unit's part is
+        finiteness-validated immediately before ITS apply — one walk
+        over the payload instead of the prewalk-then-apply double walk
+        — raising :class:`veles_tpu.health.PoisonedUpdate` before the
+        poisoned part mutates anything.
+
+        Contract: only valid when updates carry CONTROL records
+        (loader bookkeeping, decision metrics), i.e. when the SPMD
+        data plane owns the gradients.  Parts applied before a later
+        part's poison was found stay applied; with control-only
+        payloads the server's drop + requeue recovers them exactly
+        like a slave death mid-session, whereas per-step parameter
+        deltas would need the all-or-nothing prewalk (see
+        ``update_validation``)."""
+        from veles_tpu import health
+        units = self._distributed_units()
+        for unit, part in zip(units, data):
+            if part is None:
+                continue
+            if not health.all_finite(part):
+                raise health.PoisonedUpdate(unit)
+            self._timed_method(
+                "apply_data_from_slave", unit.apply_data_from_slave,
+                part, slave)
+        return True
+
     def generate_initial_data_for_slave(self, slave=None):
         # The False "not ready" sentinel has no meaning at connect time;
         # normalise it to None so it is never applied as a payload.
