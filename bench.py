@@ -73,6 +73,9 @@ SECTION_EST = {
     # compile-only flat-vs-bucketed SPMD collective audit (small MLP,
     # two cheap compiles, no execution)
     "comm_bucketed": 45.0,
+    # AOT serving ladder A/B (small MLP, 3-4 cheap compiles, ~2 s of
+    # closed-loop measurement per leg)
+    "serve_ab": 40.0,
 }
 
 # a section whose dominant cost (the one-time server compile) loosely
@@ -854,6 +857,83 @@ def bench_comm_bucketed(small):
     }
 
 
+def bench_serve_ab(small):
+    """Serving-path A/B (docs/serving.md): sequential single-sample
+    inference through the AOT engine vs continuous batching under a
+    closed-loop client pool, percentiles at the headline (the TPU
+    in-datacenter paper's framing: inference is latency-bound, so the
+    tail is the number, not the mean).  Small MLP, so the cost is a few
+    sub-second compiles plus ~2 s of measurement per leg; the full
+    closed-loop *sweep* (offered-load knee) lives in
+    scripts/serve_load.py -> BENCH_serve.json."""
+    import threading as _threading
+
+    from veles_tpu.backends import Device
+    from veles_tpu.observe.metrics import percentiles as _percentiles
+    from veles_tpu.compiler import LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.serve import AOTEngine, ContinuousBatcher
+
+    fan_in, hidden, classes = (196, 64, 10) if small else (784, 256, 10)
+    rng = numpy.random.RandomState(0)
+    plans = [LayerPlan(All2AllTanh), LayerPlan(All2AllSoftmax)]
+    params = [
+        {"weights": rng.rand(fan_in, hidden).astype(numpy.float32),
+         "bias": numpy.zeros(hidden, numpy.float32)},
+        {"weights": rng.rand(hidden, classes).astype(numpy.float32),
+         "bias": numpy.zeros(classes, numpy.float32)},
+    ]
+    ladder = (1, 8, 32) if small else (1, 8, 32, 128)
+    engine = AOTEngine(plans, params, (fan_in,), ladder=ladder,
+                       device=Device())
+    receipt = engine.compile()
+    samples = rng.rand(256, fan_in).astype(numpy.float32)
+    duration = 1.0 if small else 2.0
+
+    def leg(run_one, clients):
+        latencies, lock = [], _threading.Lock()
+        stop_at = time.perf_counter() + duration
+
+        def client(k):
+            mine = []
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                run_one(samples[(k * 31 + len(mine)) % len(samples)])
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                latencies.extend(mine)
+
+        threads = [_threading.Thread(target=client, args=(k,))
+                   for k in range(clients)]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        ps = _percentiles(latencies)
+        return {"clients": clients,
+                "requests": len(latencies),
+                "requests_per_sec": round(len(latencies) / elapsed, 1),
+                **{p: round(v * 1e3, 3) for p, v in ps.items()}}
+
+    sequential = leg(engine.infer, clients=1)
+    batcher = ContinuousBatcher(engine, max_delay_s=0.002).start()
+    try:
+        batched = leg(lambda s: batcher.infer(s, timeout=30.0),
+                      clients=8 if small else 32)
+    finally:
+        batcher.stop()
+    return {
+        "compile_receipt": receipt,
+        "sequential": sequential,       # p50/p95/p99 in ms
+        "batched": batched,
+        "throughput_x": round(
+            batched["requests_per_sec"]
+            / max(sequential["requests_per_sec"], 1e-9), 2),
+    }
+
+
 def _build_native():
     from veles_tpu import native
     native.build_native()
@@ -999,6 +1079,12 @@ def main():
                        lambda: bench_comm_bucketed(small))
     if comm_res is not None:
         extras["comm_bucketed"] = comm_res
+
+    # serving A/B: AOT-ladder sequential vs continuously-batched, with
+    # p50/p95/p99 request-latency columns (docs/serving.md)
+    serve_res = section("serve_ab", lambda: bench_serve_ab(small))
+    if serve_res is not None:
+        extras["serve_ab"] = serve_res
 
     # AlexNet rows, one program (= one ~60-200 s server compile) each.
     # Batch 256 bf16 = the throughput/MFU sweet spot and the only

@@ -37,6 +37,16 @@ point               module                     actions
                                                poisons err_output)
 ``step.loss``       models.fused (train step)  nan (non-finite loss,
                                                gradients untouched)
+``serve.drop``      serve.batcher (submit)     drop (request shed with
+                                               503 + retry_after)
+``serve.stall``     serve.batcher (worker)     stall (worker sleeps
+                                               ``param`` s before the
+                                               batch — trips the
+                                               latency SLO watch)
+``serve.oom``       serve.batcher (dispatch)   oom (simulated
+                                               RESOURCE_EXHAUSTED —
+                                               batcher caps the ladder
+                                               and replays in chunks)
 ==================  =========================  =========================
 
 Activation: programmatic (``chaos.install(FaultPlan(...))`` /

@@ -16,7 +16,7 @@ import subprocess
 import sys
 import uuid
 
-from veles_tpu.http_util import BackgroundHTTPServer
+from veles_tpu.http_util import BackgroundHTTPServer, RequestTimer
 
 __all__ = ["FrontendServer"]
 
@@ -103,11 +103,13 @@ class FrontendServer(object):
                         json.dumps(self.token))
         server_self = self
 
-        class PageHandler(tornado.web.RequestHandler):
+        # RequestTimer: perf_counter request timing (tornado's own
+        # request_time() is time.time-based; docs/observability.md)
+        class PageHandler(RequestTimer, tornado.web.RequestHandler):
             def get(self):
                 self.write(page)
 
-        class RunHandler(tornado.web.RequestHandler):
+        class RunHandler(RequestTimer, tornado.web.RequestHandler):
             def post(self):
                 payload = json.loads(self.request.body or b"{}")
                 argv = payload.get("argv") or []
@@ -138,7 +140,7 @@ class FrontendServer(object):
                                                for a in argv)
                 self.write({"pid": server_self.process.pid})
 
-        class StatusHandler(tornado.web.RequestHandler):
+        class StatusHandler(RequestTimer, tornado.web.RequestHandler):
             def get(self):
                 proc = server_self.process
                 self.write({

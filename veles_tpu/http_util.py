@@ -4,11 +4,47 @@ Four services (web status, RESTful API, forge, frontend composer) run
 the same serve-in-a-daemon-thread pattern; this is the one copy.  Bind
 errors propagate to the caller instead of dying silently inside the
 thread.
+
+:class:`RequestTimer` is the shared per-request timing mixin.  Tornado's
+own ``request.request_time()`` is ``time.time``-based — NTP-unsafe and
+inconsistent with every other timer in the repo since the PR 5
+perf_counter sweep (docs/observability.md) — so handlers mix this in
+instead: wall time measured with ``time.perf_counter`` between
+``prepare()`` and ``on_finish()``, published to the ``http.request_s``
+histogram and, when the tracer/flight recorder is active, as an
+``http.request`` span tagged with method/path/status.
 """
 
 import threading
+import time
 
-__all__ = ["BackgroundHTTPServer"]
+__all__ = ["BackgroundHTTPServer", "RequestTimer"]
+
+
+class RequestTimer(object):
+    """Mixin for tornado ``RequestHandler`` subclasses (list it FIRST
+    so the MRO runs its hooks): perf_counter request timing into the
+    metrics registry + tracer.  Costs two attribute writes and one
+    histogram observation per request."""
+
+    def prepare(self):
+        self._veles_started_ = time.perf_counter()
+        return super(RequestTimer, self).prepare()
+
+    def on_finish(self):
+        started = getattr(self, "_veles_started_", None)
+        if started is not None:
+            elapsed = time.perf_counter() - started
+            from veles_tpu.observe.metrics import registry
+            from veles_tpu.observe.trace import tracer
+            registry.histogram("http.request_s").observe(elapsed)
+            if tracer.active:
+                tracer.complete(
+                    "http.request", started, elapsed, cat="http",
+                    args={"method": self.request.method,
+                          "path": self.request.path,
+                          "status": self.get_status()})
+        return super(RequestTimer, self).on_finish()
 
 
 class BackgroundHTTPServer(object):

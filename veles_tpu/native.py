@@ -16,33 +16,46 @@ import threading
 
 import numpy
 
-__all__ = ["NativeWorkflow", "build_native", "native_available"]
+__all__ = ["NativeWorkflow", "build_native", "native_available",
+           "source_digest"]
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_ROOT, "native")
 
 
-def _source_digest():
-    """Hash of every native source file: the cache key.  An
-    existence-only check against a shared cache dir would keep serving
-    a stale .so across source changes and checkouts."""
-    import hashlib
-    digest = hashlib.sha256()
-    for dirpath, _, filenames in sorted(os.walk(_NATIVE_DIR)):
-        for filename in sorted(filenames):
-            if filename.endswith((".cc", ".h", ".txt")):
-                path = os.path.join(dirpath, filename)
-                digest.update(filename.encode())
-                with open(path, "rb") as fin:
-                    digest.update(fin.read())
-    return digest.hexdigest()[:16]
+def source_digest():
+    """Hash of every native source file: the cache key (computed once,
+    on first use — importing this module must not walk the source
+    tree).  An existence-only check against a shared cache dir would
+    keep serving a stale .so across source changes and checkouts.
+    ``serve/engine.py``'s ``model_digest`` is the same pattern applied
+    to the AOT compile cache: digest-keyed cache dirs, content (not
+    existence) as the key."""
+    global _digest
+    if _digest is None:
+        import hashlib
+        digest = hashlib.sha256()
+        for dirpath, _, filenames in sorted(os.walk(_NATIVE_DIR)):
+            for filename in sorted(filenames):
+                if filename.endswith((".cc", ".h", ".txt")):
+                    path = os.path.join(dirpath, filename)
+                    digest.update(filename.encode())
+                    with open(path, "rb") as fin:
+                        digest.update(fin.read())
+        _digest = digest.hexdigest()[:16]
+    return _digest
 
 
-_BUILD_DIR = os.path.join(
-    os.environ.get("XDG_CACHE_HOME",
-                   os.path.expanduser("~/.cache")),
-    "veles_tpu", "native_build", _source_digest())
-_LIB_PATH = os.path.join(_BUILD_DIR, "libveles_tpu_native.so")
+def _lib_path():
+    """Digest-keyed build dir + library path, resolved lazily."""
+    build_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.expanduser("~/.cache")),
+        "veles_tpu", "native_build", source_digest())
+    return build_dir, os.path.join(build_dir, "libveles_tpu_native.so")
+
+
+_digest = None
 _build_lock = threading.Lock()
 _lib = None
 
@@ -50,16 +63,17 @@ _lib = None
 def build_native(force=False):
     """Build (or rebuild) the shared library; returns its path."""
     with _build_lock:
-        if os.path.exists(_LIB_PATH) and not force:
-            return _LIB_PATH
-        os.makedirs(_BUILD_DIR, exist_ok=True)
+        build_dir, lib_path = _lib_path()
+        if os.path.exists(lib_path) and not force:
+            return lib_path
+        os.makedirs(build_dir, exist_ok=True)
         subprocess.run(
             ["cmake", "-DCMAKE_BUILD_TYPE=Release", _NATIVE_DIR],
-            cwd=_BUILD_DIR, check=True, capture_output=True)
+            cwd=build_dir, check=True, capture_output=True)
         subprocess.run(
             ["cmake", "--build", ".", "-j"],
-            cwd=_BUILD_DIR, check=True, capture_output=True)
-        return _LIB_PATH
+            cwd=build_dir, check=True, capture_output=True)
+        return lib_path
 
 
 def native_available():

@@ -48,8 +48,20 @@ PEAK_BF16_TFLOPS = (
 
 #: the jax.monitoring duration event emitted once per XLA backend
 #: compilation (jaxpr trace / MLIR lowering events are deliberately
-#: not counted: only backend compiles cost real seconds at scale)
+#: not counted: only backend compiles cost real seconds at scale).
+#: NOTE this event fires around ``compile_or_get_cached``, so a
+#: persistent-cache HIT still bumps ``compile.count`` — the cache
+#: events below are what separate "asked XLA for an executable" from
+#: "actually built one" (serve engine cold/warm receipts key on it)
 _COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+#: jax.monitoring point events emitted by the persistent compilation
+#: cache (jax/_src/compiler.py): a hit means the executable was
+#: DESERIALIZED, not rebuilt, so real new compiles = count - hits
+_CACHE_EVENT_COUNTERS = (
+    ("/jax/compilation_cache/cache_hits", "compile.cache_hits"),
+    ("/jax/compilation_cache/cache_misses", "compile.cache_misses"),
+)
 
 
 class CompileWatcher(object):
@@ -77,6 +89,7 @@ class CompileWatcher(object):
                 from jax import monitoring
                 monitoring.register_event_duration_secs_listener(
                     self._on_duration)
+                monitoring.register_event_listener(self._on_event)
             except Exception:
                 return False
             self.installed = True
@@ -91,6 +104,12 @@ class CompileWatcher(object):
         if tracer.active:
             tracer.instant("xla.compile", cat="xla",
                            seconds=round(float(duration), 4))
+
+    def _on_event(self, event, **kwargs):
+        for name, counter in _CACHE_EVENT_COUNTERS:
+            if event == name:
+                self.registry.counter(counter).inc()
+                return
 
     # -- per-function recompile detection ----------------------------------
 
@@ -158,20 +177,25 @@ def poll_recompiles():
 
 
 def compile_snapshot(reg=None):
-    """{"count", "seconds", "recompiles"} from the registry — always a
-    complete dict (zeros before the first compile), so heartbeat
-    consumers can rely on the keys existing."""
+    """{"count", "seconds", "recompiles", "cache_hits", "cache_misses"}
+    from the registry — always a complete dict (zeros before the first
+    compile), so heartbeat consumers can rely on the keys existing.
+    ``count`` includes persistent-cache hits (the backend event wraps
+    the cache lookup); ``count - cache_hits`` is the number of
+    executables XLA actually built, the serve engine's warm-restart
+    receipt (docs/serving.md)."""
     reg = reg if reg is not None else _registry
-    count = reg.peek("compile.count")
-    seconds = reg.peek("compile.seconds")
-    recompiles = reg.peek("compile.recompiles")
-    return {
-        "count": int(count.value) if count is not None else 0,
-        "seconds": round(float(seconds.value), 4)
-        if seconds is not None else 0.0,
-        "recompiles": int(recompiles.value)
-        if recompiles is not None else 0,
-    }
+    out = {}
+    for key, name, cast in (
+            ("count", "compile.count", int),
+            ("seconds", "compile.seconds",
+             lambda v: round(float(v), 4)),
+            ("recompiles", "compile.recompiles", int),
+            ("cache_hits", "compile.cache_hits", int),
+            ("cache_misses", "compile.cache_misses", int)):
+        metric = reg.peek(name)
+        out[key] = cast(metric.value) if metric is not None else cast(0)
+    return out
 
 
 # -- device memory -----------------------------------------------------------

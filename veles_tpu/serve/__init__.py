@@ -1,0 +1,35 @@
+"""Production inference serving (docs/serving.md).
+
+The libVeles role of the reference — a standalone, load-and-run
+inference runtime — rebuilt TPU-idiomatically in three layers:
+
+- :mod:`veles_tpu.serve.engine` — :class:`AOTEngine`: ahead-of-time
+  compiled executables over a ladder of padded batch shapes, backed by
+  a persistent, model-digest-keyed XLA compilation cache so a restarted
+  server performs 0 new backend compiles (receipt:
+  ``engine.compile_receipt`` via the ``compile.count`` /
+  ``compile.cache_hits`` counters);
+- :mod:`veles_tpu.serve.batcher` — :class:`ContinuousBatcher`: a worker
+  thread draining the request queue into the largest fitting rung with
+  a bounded queue-delay, ping-pong host staging (the PR 1 machinery),
+  load shedding (``ServeOverload`` -> HTTP 503 + retry_after) and
+  p50/p99 latency SLO tripwires;
+- :mod:`veles_tpu.serve.service` — :class:`ServeService`: the tornado
+  front (``/infer``, ``/healthz``, ``/metrics.json``), async handlers
+  so concurrent clients actually co-batch.
+
+``python -m veles_tpu.serve --snapshot model.pickle`` serves a trained
+snapshot; ``scripts/serve_load.py`` is the closed-loop load generator
+behind ``BENCH_serve.json``.
+"""
+
+from veles_tpu.serve.batcher import (  # noqa: F401
+    ContinuousBatcher, ServeOverload, serve_snapshot)
+from veles_tpu.serve.engine import (  # noqa: F401
+    AOTEngine, DEFAULT_LADDER, enable_persistent_cache, model_digest)
+from veles_tpu.serve.service import (  # noqa: F401
+    ServeService, format_result)
+
+__all__ = ["AOTEngine", "ContinuousBatcher", "ServeOverload",
+           "ServeService", "DEFAULT_LADDER", "enable_persistent_cache",
+           "format_result", "model_digest", "serve_snapshot"]

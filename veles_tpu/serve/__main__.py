@@ -1,0 +1,133 @@
+"""``python -m veles_tpu.serve`` — stand up the inference service.
+
+Serves a trained workflow snapshot (the crash-consistent pickles
+``snapshotter.py`` writes) behind the AOT engine + continuous batcher,
+with the persistent compilation cache ON by default so a restart of
+this process performs zero new backend compiles:
+
+    python -m veles_tpu.serve --snapshot mnist_current.pickle \\
+        --port 8080 --ladder 1,8,32,128 --max-delay-ms 2 \\
+        --slo-p50-ms 20 --slo-p99-ms 100
+
+``--demo`` trains a tiny blobs MLP in-process instead (a smoke target
+for the load generator and the docs walkthrough).
+"""
+
+import argparse
+import sys
+import time
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="python -m veles_tpu.serve",
+        description="AOT-compiled, continuously-batched inference "
+                    "service")
+    source = parser.add_mutually_exclusive_group(required=True)
+    source.add_argument("--snapshot", help="trained workflow snapshot "
+                        "(snapshotter export) to serve")
+    source.add_argument("--demo", action="store_true",
+                        help="train a tiny demo MLP and serve it")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--path", default="/infer")
+    parser.add_argument("--ladder", default="1,8,32,128",
+                        help="comma-separated batch-shape ladder")
+    parser.add_argument("--max-delay-ms", type=float, default=2.0,
+                        help="max continuous-batching queue delay")
+    parser.add_argument("--max-queue", type=int, default=256,
+                        help="pending-request bound before 503 shedding")
+    parser.add_argument("--cache-root", default=None,
+                        help="persistent compile-cache root (default: "
+                        "~/.cache/veles_tpu/serve_cache; 'none' "
+                        "disables)")
+    parser.add_argument("--slo-p50-ms", type=float, default=None)
+    parser.add_argument("--slo-p99-ms", type=float, default=None)
+    parser.add_argument("--duration", type=float, default=None,
+                        help="serve for N seconds then exit (default: "
+                        "until interrupted)")
+    return parser
+
+
+def _demo_workflow():
+    import numpy
+
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    from veles_tpu.prng import RandomGenerator
+
+    class BlobsLoader(FullBatchLoader):
+        """Deterministic 4-class Gaussian blobs (the test zoo's demo)."""
+
+        def load_data(self):
+            self.class_lengths[:] = [0, 64, 256]
+            self._calc_class_end_offsets()
+            self.create_originals((16,))
+            rng = numpy.random.RandomState(99)
+            centers = rng.randn(4, 16) * 2.0
+            for i in range(self.total_samples):
+                label = i % 4
+                self.original_data.mem[i] = (
+                    centers[label] + rng.randn(16) * 0.3)
+                self.original_labels[i] = label
+
+    sw = StandardWorkflow(
+        DummyWorkflow().workflow,
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+            {"type": "softmax", "output_sample_shape": 4,
+             "learning_rate": 0.05, "gradient_moment": 0.9},
+        ],
+        loader_factory=lambda w: BlobsLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator("serve-demo", seed=1)),
+        decision_config=dict(max_epochs=3),
+    )
+    sw.initialize(device=Device(backend="cpu"))
+    sw.run()
+    return sw
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.demo:
+        sw = _demo_workflow()
+    else:
+        from veles_tpu.workflow import restore_workflow
+        sw = restore_workflow(args.snapshot)
+
+    from veles_tpu.serve import AOTEngine, ServeService
+    ladder = tuple(int(b) for b in args.ladder.split(","))
+    cache_kwargs = {}
+    if args.cache_root != "none":
+        cache_kwargs["persistent_cache"] = True
+        if args.cache_root:
+            cache_kwargs["cache_root"] = args.cache_root
+    engine = AOTEngine.from_workflow(sw, ladder=ladder, **cache_kwargs)
+    receipt = engine.compile()
+    loader = getattr(sw, "loader", None)
+    service = ServeService(
+        engine, port=args.port, path=args.path,
+        labels_mapping=getattr(loader, "reversed_labels_mapping", None),
+        max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
+        slo_p50_ms=args.slo_p50_ms, slo_p99_ms=args.slo_p99_ms)
+    service.start_background()
+    print("serving on http://127.0.0.1:%d%s  (compile receipt: %s)"
+          % (service.port, args.path, receipt))
+    try:
+        if args.duration is not None:
+            time.sleep(args.duration)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,406 @@
+"""Continuous-batching request queue over the AOT engine.
+
+One worker thread drains pending requests into the largest fitting
+ladder rung: the first request of a batch waits at most ``max_delay_s``
+for company (the latency/throughput knob), the tail is zero-padded up
+to the rung, and the batch runs on **ping-pong host staging buffers**
+(the PR 1 ``memory.Array.stage_init/stage_begin/stage_put`` machinery)
+so the next batch's host fill overlaps the current batch's transfer.
+``stage_put`` goes through ``Device.put``, which on XLA:CPU makes the
+XLA-owned copy that the zero-copy ``device_put`` hazard demands (see
+``CPUDevice.put``) — the staged host buffer is never aliased by a live
+executable input, donated or not.
+
+Overload protocol (mirrors the distributed server's TTL-blacklist
+rejects, docs/distributed.md): past ``max_queue`` pending requests,
+:meth:`ContinuousBatcher.submit` raises :class:`ServeOverload` carrying
+a ``retry_after`` estimate instead of growing the queue without bound;
+the HTTP front turns it into ``503 {"retry_after": ...}`` and a
+well-behaved client sleeps it out, exactly like a blacklisted slave.
+
+Degradation: an OOM-shaped engine failure (`RESOURCE_EXHAUSTED` /
+``MemoryError``) permanently caps the ladder below the failing rung and
+replays the batch in capped chunks — serving gets slower, not dead.
+Other engine failures fail only that batch's requests and keep the
+worker alive.
+
+SLO watch: per-request end-to-end latency feeds the ``serve.latency_s``
+histogram; every ``slo_check_every`` batches the recent window's
+p50/p99 are compared against the configured thresholds and each breach
+bumps ``serve.slo_violations`` + records a trace/flight-recorder
+instant, so a post-mortem dump shows *when* the tail blew up, next to
+the batch spans that did it.
+
+Chaos points (docs/health.md table): ``serve.drop`` (submit-side shed),
+``serve.stall`` (worker sleeps ``param`` seconds — trips the SLO
+watch), ``serve.oom`` (simulated RESOURCE_EXHAUSTED — exercises the
+degrade path).
+"""
+
+import queue
+import threading
+import time
+
+import numpy
+
+from veles_tpu import chaos
+from veles_tpu.logger import Logger
+from veles_tpu.memory import Array
+from veles_tpu.observe.metrics import percentiles
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.observe.trace import tracer as _tracer
+
+__all__ = ["ContinuousBatcher", "ServeOverload", "serve_snapshot"]
+
+
+class ServeOverload(Exception):
+    """Load shed: the queue is full (or chaos dropped the request).
+    ``retry_after`` (seconds) marks the rejection transient — the HTTP
+    layer ships it as 503 + retry_after, like the server blacklist."""
+
+    def __init__(self, message, retry_after=0.1):
+        super(ServeOverload, self).__init__(message)
+        self.retry_after = float(retry_after)
+
+
+class _Request(object):
+    __slots__ = ("sample", "enqueued", "done", "result", "error",
+                 "cancelled")
+
+    def __init__(self, sample):
+        self.sample = sample
+        self.enqueued = time.perf_counter()
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+        #: set by a caller that gave up on the request (e.g. a batch
+        #: payload that shed partway through submission); the worker
+        #: drops it at dispatch instead of computing for nobody
+        self.cancelled = False
+
+
+def _oom_shaped(exc):
+    return isinstance(exc, MemoryError) or \
+        "RESOURCE_EXHAUSTED" in str(exc) or \
+        "Out of memory" in str(exc)
+
+
+class ContinuousBatcher(Logger):
+    """Worker thread turning a request stream into padded-rung batches.
+
+    ``max_delay_s`` bounds how long the OLDEST request of a forming
+    batch waits for more arrivals; ``max_queue`` bounds pending
+    requests before :meth:`submit` sheds; ``slo_p50_ms``/``slo_p99_ms``
+    arm the SLO watch (None disables a threshold)."""
+
+    def __init__(self, engine, max_delay_s=0.002, max_queue=256,
+                 slo_p50_ms=None, slo_p99_ms=None, slo_check_every=4,
+                 **kwargs):
+        super(ContinuousBatcher, self).__init__(**kwargs)
+        self.engine = engine
+        self.max_delay_s = float(max_delay_s)
+        self.max_queue = int(max_queue)
+        self.slo_p50_ms = slo_p50_ms
+        self.slo_p99_ms = slo_p99_ms
+        self.slo_check_every = max(1, int(slo_check_every))
+        self._q = queue.Queue()
+        self._thread = None
+        self._stop_ = False
+        self._rung_cap = engine.max_batch
+        self._stage = {}      # rung -> (Array, [slot])
+        self._batches_since_check = 0
+        self._slo_breached = False
+        # metrics resolved once (docs/observability.md serve set)
+        self._m_depth = _registry.gauge("serve.queue_depth")
+        self._m_batch = _registry.histogram("serve.batch_size")
+        self._m_latency = _registry.histogram("serve.latency_s")
+        self._m_requests = _registry.counter("serve.requests")
+        self._m_batches = _registry.counter("serve.batches")
+        self._m_padded = _registry.counter("serve.padded_rows")
+        self._m_shed = _registry.counter("serve.shed")
+        self._m_errors = _registry.counter("serve.errors")
+        self._m_slo = _registry.counter("serve.slo_violations")
+        self._m_depth.set(0)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def running(self):
+        return self._thread is not None
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop_ = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-batcher")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the worker and JOIN it (the test suite's thread-leak
+        fixture enforces this); pending requests fail with overload so
+        no caller blocks forever on a dead queue."""
+        self._stop_ = True
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10)
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            req.error = ServeOverload("server shutting down",
+                                      retry_after=1.0)
+            req.done.set()
+        self._m_depth.set(0)
+
+    # -- submit side --------------------------------------------------------
+
+    def _retry_after(self):
+        """Transient-backoff estimate: the queue drained at the recent
+        per-batch pace, bounded to something a client will tolerate."""
+        window = self._m_latency.window_values()
+        p50 = percentiles(window, ps=(50,)).get("p50") if window else None
+        per_batch = p50 if p50 else 0.05
+        depth = self._q.qsize()
+        return min(5.0, max(0.05, per_batch * (
+            1 + depth / float(self.engine.max_batch))))
+
+    def submit(self, sample):
+        """Enqueue one sample; returns the pending request.  Raises
+        :class:`ServeOverload` when shedding (full queue or chaos
+        ``serve.drop``)."""
+        if self._thread is None or self._stop_:
+            raise ServeOverload("batcher not running", retry_after=1.0)
+        if chaos.plan is not None:
+            fault = chaos.plan.fire("serve.drop")
+            if fault is not None:
+                self._m_shed.inc()
+                raise ServeOverload("chaos: request dropped",
+                                    retry_after=self._retry_after())
+        if self._q.qsize() >= self.max_queue:
+            self._m_shed.inc()
+            retry = self._retry_after()
+            if _tracer.active:
+                _tracer.instant("serve.shed", cat="serve",
+                                depth=self._q.qsize(),
+                                retry_after=round(retry, 4))
+            raise ServeOverload(
+                "queue full (%d pending)" % self._q.qsize(),
+                retry_after=retry)
+        sample = numpy.ascontiguousarray(sample, self.engine.dtype)
+        if sample.shape != self.engine.sample_shape:
+            raise ValueError("expected sample shape %s, got %s" %
+                             (self.engine.sample_shape, sample.shape))
+        req = _Request(sample)
+        self._q.put(req)
+        if self._stop_:
+            # lost the race with a concurrent stop(): its drain may
+            # have already run, so complete the request here — nobody
+            # else will, and the caller must not block out its timeout
+            req.error = ServeOverload("server shutting down",
+                                      retry_after=1.0)
+            req.done.set()
+            raise req.error
+        self._m_depth.set(self._q.qsize())
+        return req
+
+    def infer(self, sample, timeout=30.0):
+        """Blocking submit: returns the output row (numpy) or raises
+        the request's error."""
+        req = self.submit(sample)
+        if not req.done.wait(timeout):
+            raise TimeoutError("inference timed out after %.1fs"
+                               % timeout)
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- worker side --------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop_:
+            try:
+                first = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            batch = self._collect(first)
+            self._m_depth.set(self._q.qsize())
+            try:
+                self._run_batch(batch)
+            except Exception as exc:  # never kill the worker
+                self._m_errors.inc()
+                self.exception("serve batch failed")
+                for req in batch:
+                    if not req.done.is_set():
+                        req.error = exc
+                        req.done.set()
+
+    def _collect(self, first):
+        """Grow a batch around the oldest pending request: drain
+        whatever is already queued instantly, then wait out the
+        remaining queue-delay budget for stragglers."""
+        batch = [first]
+        limit = min(self._rung_cap, self.engine.max_batch)
+        deadline = first.enqueued + self.max_delay_s
+        while len(batch) < limit and not self._stop_:
+            remaining = deadline - time.perf_counter()
+            try:
+                if remaining <= 0:
+                    batch.append(self._q.get_nowait())
+                else:
+                    batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _staging(self, rung):
+        arr_slot = self._stage.get(rung)
+        if arr_slot is None:
+            arr = Array(numpy.zeros(
+                (rung,) + self.engine.sample_shape, self.engine.dtype))
+            arr.stage_init(2)
+            arr_slot = self._stage[rung] = [arr, 0]
+        return arr_slot
+
+    def _run_batch(self, batch):
+        if chaos.plan is not None:
+            fault = chaos.plan.fire("serve.stall")
+            if fault is not None:
+                # a stalled device/runtime: latency climbs, the SLO
+                # watch must notice (tests/test_serve.py)
+                time.sleep(fault.param if fault.param else 0.05)
+        batch = [req for req in batch if not req.cancelled]
+        if not batch:
+            return
+        n = len(batch)
+        rung = self.engine.rung_for(n, cap=self._rung_cap)
+        if n > rung:  # capped ladder (post-OOM degrade): chunk
+            for i in range(0, n, rung):
+                self._run_batch(batch[i:i + rung])
+            return
+        start = time.perf_counter()
+        arr, slot = self._staging(rung)
+        arr.stage_begin(slot)
+        self._stage[rung][1] = slot ^ 1
+        mem = arr.mem
+        for i, req in enumerate(batch):
+            mem[i] = req.sample
+        if n < rung:
+            mem[n:] = 0  # deterministic padding (bit-equality contract)
+            self._m_padded.inc(rung - n)
+        x_dev = arr.stage_put(self.engine.device)
+        try:
+            if chaos.plan is not None:
+                fault = chaos.plan.fire("serve.oom")
+                if fault is not None:
+                    raise MemoryError(
+                        "RESOURCE_EXHAUSTED: chaos serve.oom (rung %d)"
+                        % rung)
+            out = self.engine.run(x_dev, rung)
+            # the ONE host sync of the whole batch (the old RESTfulAPI
+            # synced per request)
+            host = numpy.asarray(out)
+        except Exception as exc:
+            self._degrade_or_fail(batch, rung, exc)
+            return
+        done = time.perf_counter()
+        self._m_batches.inc()
+        self._m_requests.inc(n)
+        self._m_batch.observe(n)
+        for i, req in enumerate(batch):
+            req.result = host[i].copy()
+            self._m_latency.observe(done - req.enqueued)
+            req.done.set()
+        if _tracer.active:
+            _tracer.complete("serve.batch", start, done - start,
+                             cat="serve", args={"n": n, "rung": rung})
+        self._batches_since_check += 1
+        if self._batches_since_check >= self.slo_check_every:
+            self._batches_since_check = 0
+            self._check_slo()
+
+    def _degrade_or_fail(self, batch, rung, exc):
+        self._m_errors.inc()
+        if _oom_shaped(exc) and rung > self.engine.ladder[0]:
+            # permanent cap below the failing rung, replay in chunks:
+            # slower beats dead, and the cap note reaches the logs +
+            # health block (serve.rung_cap gauge)
+            smaller = [r for r in self.engine.ladder if r < rung]
+            self._rung_cap = smaller[-1]
+            _registry.gauge("serve.rung_cap").set(self._rung_cap)
+            self.warning(
+                "engine OOM at rung %d (%s); capping ladder at %d and "
+                "replaying", rung, exc, self._rung_cap)
+            if _tracer.active:
+                _tracer.instant("serve.degrade", cat="serve",
+                                rung=rung, cap=self._rung_cap)
+            self._run_batch(batch)
+            return
+        self.error("engine failure at rung %d: %s", rung, exc)
+        for req in batch:
+            req.error = exc
+            req.done.set()
+
+    def _check_slo(self):
+        if self.slo_p50_ms is None and self.slo_p99_ms is None:
+            return
+        window = self._m_latency.window_values()
+        if not window:
+            return
+        ps = percentiles(window, ps=(50, 99))
+        p50_ms = ps["p50"] * 1e3
+        p99_ms = ps["p99"] * 1e3
+        breaches = []
+        if self.slo_p50_ms is not None and p50_ms > self.slo_p50_ms:
+            breaches.append(("p50", p50_ms, self.slo_p50_ms))
+        if self.slo_p99_ms is not None and p99_ms > self.slo_p99_ms:
+            breaches.append(("p99", p99_ms, self.slo_p99_ms))
+        for which, measured, budget in breaches:
+            self._m_slo.inc()
+            # instant -> trace AND the always-on flight ring, so a
+            # post-mortem dump carries the breach next to its batches
+            _tracer.instant(
+                "serve.slo_violation", cat="serve", slo=which,
+                measured_ms=round(measured, 3),
+                budget_ms=round(budget, 3))
+        if breaches and not self._slo_breached:
+            # log on the ENTER edge only: the counter/instants carry
+            # the per-check record, a sustained breach must not flood
+            # the log at batch rate
+            self.warning("SLO violation began: %s", "; ".join(
+                "%s %.2fms > %.2fms budget" % b for b in breaches))
+        elif self._slo_breached and not breaches:
+            self.info("SLO recovered (window p50 %.2fms p99 %.2fms)",
+                      p50_ms, p99_ms)
+        self._slo_breached = bool(breaches)
+
+
+#: serve health keys surfaced to web_status / heartbeats
+def serve_snapshot(reg=None):
+    """The serving health block as a flat plain-data dict: queue depth,
+    SLO violations, shed/error counts, latency percentiles (ms) and
+    mean batch size.  Empty dict when nothing ever served — dashboards
+    show the block only on serving processes."""
+    reg = reg if reg is not None else _registry
+    out = {}
+    for name, short in (("serve.queue_depth", "queue_depth"),
+                        ("serve.slo_violations", "slo_violations"),
+                        ("serve.requests", "requests"),
+                        ("serve.shed", "shed"),
+                        ("serve.errors", "errors"),
+                        ("serve.rung_cap", "rung_cap")):
+        metric = reg.peek(name)
+        if metric is not None and metric.value is not None:
+            out[short] = metric.value
+    hist = reg.peek("serve.latency_s")
+    if hist is not None and hist.count:
+        snap = hist.snapshot()
+        for p in ("p50", "p95", "p99"):
+            if snap.get(p) is not None:
+                out["%s_ms" % p] = round(snap[p] * 1e3, 3)
+    batch = reg.peek("serve.batch_size")
+    if batch is not None and batch.count:
+        out["batch_mean"] = round(batch.snapshot()["mean"], 2)
+    return out

@@ -1,0 +1,308 @@
+"""AOT inference engine: a ladder of pre-compiled per-shape executables.
+
+The reference's libVeles served a fixed workflow from a standalone C++
+runtime: no tracing, no JIT, load-and-run.  The JAX analog is
+ahead-of-time compilation — ``jax.jit(forward).lower(...).compile()``
+against a small *ladder* of padded batch shapes (default 1/8/32/128),
+so at serve time a request batch is padded up to the smallest fitting
+rung and dispatched to an executable that already exists.  The old
+``RESTfulAPI._compile`` path jit-compiled lazily on the first request
+of each new batch shape, which put multi-second XLA compiles on the
+latency path exactly when traffic changed — the failure mode the TPU
+in-datacenter paper's latency-percentile framing punishes hardest.
+
+Cold start is handled by the **persistent compilation cache**:
+:func:`enable_persistent_cache` points ``jax_compilation_cache_dir`` at
+a directory keyed by :func:`model_digest` (the architecture + shape
+fingerprint, the same pattern as ``native.source_digest`` for the C++
+runtime's build cache) and drops the min-compile-time/entry-size
+floors so every rung persists.  A restarted server then *deserializes*
+its ladder instead of rebuilding it: ``compile_receipt["new_compiles"]``
+is 0, asserted via the ``compile.count`` / ``compile.cache_hits``
+counters of :mod:`veles_tpu.observe.xla_introspect` (the backend-compile
+monitoring event fires even on a cache hit, so the receipt subtracts
+hits — see that module).
+
+Numerics note (tests/test_serve.py): on XLA:CPU all rungs >= the vector
+width (8 is safely past it) produce bit-identical per-row results, and
+padding rows never leak into real rows (no cross-row reduction except
+the per-row softmax), so continuous batching preserves bit-equality
+with sequential serving *within* those rungs.  The rung-1 executable
+lowers to a different vector-matrix kernel and may differ by ~1 ulp;
+deployments that need strict batch-size-invariant bits should start
+the ladder at 8.
+
+Input donation is enabled only where the backend actually honors it
+(TPU/GPU); XLA:CPU ignores donation with a warning, so ``donate="auto"``
+skips it there.
+"""
+
+import hashlib
+import os
+
+import numpy
+
+from veles_tpu.logger import Logger
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.observe.trace import tracer as _tracer
+
+__all__ = ["AOTEngine", "model_digest", "enable_persistent_cache",
+           "DEFAULT_LADDER"]
+
+#: default batch-shape ladder: singles stay latency-optimal, 128 is the
+#: throughput rung (past it, padding waste beats batching gains for the
+#: model sizes this repo serves)
+DEFAULT_LADDER = (1, 8, 32, 128)
+
+
+def model_digest(plans, params, sample_shape, extra=None):
+    """Architecture fingerprint for the persistent-cache directory key.
+
+    Hashes what determines the COMPILED PROGRAM — layer classes, static
+    configs, parameter shapes/dtypes, the input sample shape, and the
+    jax version — and deliberately NOT the weight values: retraining
+    the same architecture must keep hitting the same cache (the HLO is
+    identical), while any shape or topology change must miss.  Same
+    role as ``native.source_digest`` for the C++ runtime's build cache.
+    """
+    import jax
+    digest = hashlib.sha256()
+    digest.update(("jax:%s" % jax.__version__).encode())
+    digest.update(repr(tuple(sample_shape)).encode())
+    if extra:
+        digest.update(repr(extra).encode())
+    for plan, entry in zip(plans, params):
+        digest.update(plan.forward_cls.__name__.encode())
+        digest.update(repr(sorted(plan.static.items())).encode())
+        for key in sorted(entry):
+            leaf = entry[key]
+            if leaf is None:
+                digest.update(("%s:none" % key).encode())
+            else:
+                digest.update(("%s:%s:%s" % (
+                    key, tuple(leaf.shape),
+                    numpy.dtype(leaf.dtype).str)).encode())
+    return digest.hexdigest()[:16]
+
+
+def enable_persistent_cache(digest, cache_root=None):
+    """Point JAX's persistent compilation cache at a digest-keyed dir
+    and make it catch EVERYTHING; returns the directory.
+
+    Overrides the generic cache ``backends._enable_persistent_compile_
+    cache`` may have set: that one keeps jax's 1-second min-compile-time
+    floor (tuned for 20-40 s conv-net compiles over a TPU tunnel),
+    which silently refuses to persist the sub-second executables a
+    small serving ladder compiles — exactly the ones a restarted server
+    needs back.  Serving owns its process, so the global config flip is
+    deliberate."""
+    import jax
+    root = cache_root or os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "veles_tpu", "serve_cache")
+    path = os.path.join(root, digest)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # knob absent on old jax: size floor stays, cache still on
+    # jax's cache SINGLETON binds to the directory at the process's
+    # first compile and ignores later config updates ("cache is
+    # disabled/not initialized"): any compile before this call —
+    # device probing, another subsystem's jit — would silently strand
+    # the ladder outside the digest dir.  Reset so the next use
+    # re-initializes at the new path.
+    try:
+        from jax._src import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:
+        pass  # private API drift: stale binding beats a crash
+    return path
+
+
+class AOTEngine(Logger):
+    """Pre-compiled per-(model, batch-shape) executables + padded run.
+
+    ``plans``/``params`` are the :mod:`veles_tpu.compiler` forward plan
+    and the ``[{"weights", "bias"}]`` parameter list (host numpy or
+    device arrays); ``sample_shape`` the per-sample input shape.  After
+    :meth:`compile`, :meth:`run` dispatches a device batch on an exact
+    rung and :meth:`infer` is the host-convenience (and sequential-
+    reference) path: chunk, pad, run, slice.
+    """
+
+    def __init__(self, plans, params, sample_shape,
+                 ladder=DEFAULT_LADDER, device=None, cache_root=None,
+                 persistent_cache=False, donate="auto",
+                 dtype=numpy.float32, **kwargs):
+        super(AOTEngine, self).__init__(**kwargs)
+        if not plans:
+            raise ValueError("AOTEngine needs a non-empty plan list")
+        self.plans = list(plans)
+        self.params = [dict(entry) for entry in params]
+        self.sample_shape = tuple(int(s) for s in sample_shape)
+        self.ladder = tuple(sorted({int(b) for b in ladder}))
+        if not self.ladder or self.ladder[0] < 1:
+            raise ValueError("ladder must hold positive batch sizes")
+        if device is None:
+            from veles_tpu.backends import Device
+            device = Device()
+        self.device = device
+        self.dtype = numpy.dtype(dtype)
+        self.donate = donate
+        self.digest = model_digest(plans, self.params, self.sample_shape)
+        self.cache_dir = None
+        if persistent_cache or cache_root is not None:
+            self.cache_dir = enable_persistent_cache(
+                self.digest, cache_root)
+        self.compile_receipt = None
+        self._compiled = {}
+        self._params_dev = None
+
+    @classmethod
+    def from_workflow(cls, sw, **kwargs):
+        """Build from a trained StandardWorkflow: extracts the forward
+        plan + parameters exactly like the old ``RESTfulAPI._compile``
+        did, plus the loader's sample shape, and inherits the
+        workflow's device."""
+        from veles_tpu.compiler import extract_state, workflow_plan
+        plans = workflow_plan(sw)
+        state = extract_state(sw)
+        params = [{"weights": s["weights"], "bias": s["bias"]}
+                  for s in state]
+        loader = getattr(sw, "loader", None)
+        if "sample_shape" in kwargs:
+            sample_shape = kwargs.pop("sample_shape")
+        elif loader is not None and loader.minibatch_data:
+            sample_shape = tuple(loader.minibatch_data.shape[1:])
+        else:
+            raise ValueError("workflow has no loader shape; pass "
+                             "sample_shape=")
+        kwargs.setdefault("device", getattr(sw.forwards[0], "device",
+                                            None))
+        return cls(plans, params, sample_shape, **kwargs)
+
+    # -- compilation --------------------------------------------------------
+
+    @property
+    def max_batch(self):
+        return self.ladder[-1]
+
+    def _donate_argnums(self):
+        if self.donate == "auto":
+            try:
+                platform = self.device.jax_device.platform
+            except Exception:
+                platform = "cpu"
+            # XLA:CPU ignores input-output aliasing for these programs
+            # and warns per compile; donation only buys anything where
+            # the backend honors it
+            return (1,) if platform != "cpu" else ()
+        return (1,) if self.donate else ()
+
+    def compile(self):
+        """Lower + compile every rung; returns the compile receipt.
+
+        The receipt is the cold/warm-start proof (docs/serving.md):
+        ``backend_compiles`` counts compile REQUESTS (jax's monitoring
+        event fires even on a persistent-cache hit), ``cache_hits``
+        the executables deserialized from disk, ``new_compiles`` their
+        difference — 0 on a warm restart."""
+        import time
+
+        import jax
+
+        from veles_tpu.compiler import build_forward
+        from veles_tpu.observe import xla_introspect
+
+        xla_introspect.ensure_installed()
+        before = xla_introspect.compile_snapshot()
+        start = time.perf_counter()
+        put = self.device.put
+        self._params_dev = [
+            {key: (None if leaf is None else put(numpy.asarray(leaf)))
+             for key, leaf in entry.items()}
+            for entry in self.params]
+        forward = build_forward(self.plans)
+        donate = self._donate_argnums()
+        for rung in self.ladder:
+            x_aval = jax.ShapeDtypeStruct(
+                (rung,) + self.sample_shape, self.dtype)
+            with _tracer.span("serve.compile", cat="serve", rung=rung):
+                jitted = jax.jit(forward, donate_argnums=donate)
+                self._compiled[rung] = jitted.lower(
+                    self._params_dev, x_aval).compile()
+        elapsed = time.perf_counter() - start
+        after = xla_introspect.compile_snapshot()
+        requests = after["count"] - before["count"]
+        hits = after["cache_hits"] - before["cache_hits"]
+        self.compile_receipt = {
+            "rungs": list(self.ladder),
+            "backend_compiles": requests,
+            "cache_hits": hits,
+            "new_compiles": max(0, requests - hits),
+            "seconds": round(elapsed, 4),
+            "cache_dir": self.cache_dir,
+        }
+        _registry.gauge("serve.aot_rungs").set(len(self.ladder))
+        _registry.gauge("serve.compile_s").set(round(elapsed, 4))
+        self.info(
+            "AOT ladder %s compiled in %.2fs (%d compile requests, "
+            "%d cache hits -> %d new backend compiles)%s",
+            list(self.ladder), elapsed, requests, hits,
+            self.compile_receipt["new_compiles"],
+            " cache=%s" % self.cache_dir if self.cache_dir else "")
+        return self.compile_receipt
+
+    # -- dispatch -----------------------------------------------------------
+
+    def rung_for(self, n, cap=None):
+        """Smallest ladder rung holding ``n`` samples (the largest rung
+        when ``n`` overflows it — callers chunk).  ``cap`` bounds the
+        answer (the batcher's OOM-degrade path)."""
+        top = self.ladder[-1] if cap is None else cap
+        for rung in self.ladder:
+            if rung > top:
+                break
+            if rung >= n:
+                return rung
+        return min(top, self.ladder[-1])
+
+    def run(self, x_dev, rung):
+        """Dispatch one pre-compiled executable on an exact-rung device
+        batch; returns the device-side output (no host sync)."""
+        return self._compiled[rung](self._params_dev, x_dev)
+
+    def infer(self, x):
+        """Host-side convenience: pad/chunk ``x`` through the ladder
+        and return the output rows as ONE numpy array.
+
+        This is also the sequential reference path the batching
+        bit-equality test compares against: a single sample goes
+        through the smallest rung, exactly like a lone queued request
+        would."""
+        x = numpy.ascontiguousarray(x, self.dtype)
+        if x.shape == self.sample_shape:
+            x = x[None]
+        if x.shape[1:] != self.sample_shape:
+            raise ValueError("expected sample shape %s, got %s" %
+                             (self.sample_shape, x.shape[1:]))
+        if self._params_dev is None:
+            raise RuntimeError("AOTEngine.compile() not called")
+        out, i, n = [], 0, x.shape[0]
+        while i < n:
+            take = min(self.max_batch, n - i)
+            rung = self.rung_for(take)
+            if take == rung:
+                chunk = x[i:i + rung]
+            else:
+                chunk = numpy.zeros((rung,) + self.sample_shape,
+                                    self.dtype)
+                chunk[:take] = x[i:i + take]
+            result = self.run(self.device.put(chunk), rung)
+            out.append(numpy.asarray(result)[:take])
+            i += take
+        return numpy.concatenate(out) if len(out) > 1 else out[0]

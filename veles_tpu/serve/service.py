@@ -1,0 +1,202 @@
+"""HTTP front for the serving subsystem.
+
+``POST <path> {"input": sample-or-batch}`` answers like the original
+``RESTfulAPI`` contract (``{"result": label(s), "probabilities":
+[...]}``) but the handler is *async*: the tornado IO loop hands the
+blocking batcher wait to a thread pool and keeps accepting requests,
+so concurrent clients actually co-batch — a synchronous handler would
+serialize the queue and continuous batching could never see more than
+one request at a time.
+
+Besides inference the service exposes the operational surface:
+
+- ``GET /healthz`` — the serve health block (queue depth, SLO
+  violations, latency percentiles), the engine's compile receipt and
+  the model digest; what a load balancer or the web-status dashboard
+  polls;
+- ``GET /metrics.json`` — the full metrics-registry snapshot.
+
+Overload answers ``503`` with a ``retry_after`` hint (the blacklist
+protocol's shape); per-request wall time lands in the ``http.request_s``
+histogram and a per-request ``serve.request`` span via the
+``http_util.RequestTimer`` mixin (perf_counter, not tornado's
+``time.time``-based ``request_time``).
+"""
+
+import json
+import threading
+
+import numpy
+
+from veles_tpu.http_util import BackgroundHTTPServer, RequestTimer
+from veles_tpu.logger import Logger
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.serve.batcher import ContinuousBatcher, ServeOverload
+from veles_tpu.serve.batcher import serve_snapshot
+
+__all__ = ["ServeService", "format_result"]
+
+
+def format_result(probs, labels_mapping=None):
+    """Shape a probability block into the REST response contract:
+    argmax label(s) mapped through the loader's reverse mapping, plus
+    the raw probabilities."""
+    probs = numpy.asarray(probs)
+    single = probs.ndim == 1
+    block = probs[None] if single else probs
+    labels = block.argmax(axis=1)
+    mapping = labels_mapping or {}
+    named = [mapping.get(int(l), int(l)) for l in labels]
+    return {"result": named[0] if single or len(named) == 1 else named,
+            "probabilities": block.tolist()}
+
+
+class ServeService(Logger):
+    """Tornado service over an :class:`AOTEngine` + batcher.
+
+    ``batcher`` may be shared (the RESTful unit passes its own); when
+    None one is built from ``batcher_kwargs`` and owned (started and
+    stopped with the service)."""
+
+    def __init__(self, engine, batcher=None, port=0, path="/infer",
+                 labels_mapping=None, executor_workers=64,
+                 **batcher_kwargs):
+        super(ServeService, self).__init__()
+        self.engine = engine
+        self._owns_batcher = batcher is None
+        self.batcher = batcher if batcher is not None else \
+            ContinuousBatcher(engine, **batcher_kwargs)
+        self.path = path
+        self.labels_mapping = labels_mapping or {}
+        self.samples_served = 0
+        self._served_lock = threading.Lock()
+        self._executor = None
+        self._executor_workers = int(executor_workers)
+        self._server = None
+        self._port = port
+
+    @property
+    def port(self):
+        return self._server.port if self._server is not None \
+            else self._port
+
+    # -- request handling (executor thread) ---------------------------------
+
+    def infer_payload(self, sample):
+        """Blocking inference for one payload: a single sample or a
+        batch.  Batch payloads are submitted row-by-row, so their rows
+        co-batch with every other in-flight request — a large payload
+        does not monopolize a rung.  A payload that sheds partway
+        through submission cancels its already-queued rows (the worker
+        drops them at dispatch) so a 503'd request never leaves orphan
+        work computing for nobody."""
+        x = numpy.asarray(sample, self.engine.dtype)
+        if x.shape == self.engine.sample_shape:
+            x = x[None]
+        requests = []
+        try:
+            for row in x:
+                requests.append(self.batcher.submit(row))
+        except Exception:
+            for req in requests:
+                req.cancelled = True
+            raise
+        probs = []
+        for req in requests:
+            if not req.done.wait(30.0):
+                raise TimeoutError("inference timed out")
+            if req.error is not None:
+                raise req.error
+            probs.append(req.result)
+        with self._served_lock:
+            self.samples_served += len(probs)
+        return format_result(numpy.stack(probs), self.labels_mapping)
+
+    # -- HTTP ---------------------------------------------------------------
+
+    def _make_app(self):
+        import tornado.web
+
+        svc = self
+
+        class InferHandler(RequestTimer, tornado.web.RequestHandler):
+            async def post(self):
+                import asyncio
+                try:
+                    body = json.loads(self.request.body)
+                    payload = body["input"]
+                except Exception as exc:
+                    self.set_status(400)
+                    self.write({"error": "bad request: %s" % exc})
+                    return
+                loop = asyncio.get_event_loop()
+                try:
+                    answer = await loop.run_in_executor(
+                        svc._executor, svc.infer_payload, payload)
+                except ServeOverload as exc:
+                    # the blacklist protocol's transient-reject shape
+                    self.set_status(503)
+                    self.set_header("Retry-After",
+                                    "%.3f" % exc.retry_after)
+                    self.write({"error": str(exc),
+                                "retry_after": exc.retry_after})
+                except (ValueError, TypeError) as exc:
+                    self.set_status(400)
+                    self.write({"error": str(exc)})
+                except Exception as exc:
+                    self.set_status(500)
+                    self.write({"error": str(exc)})
+                else:
+                    self.write(answer)
+
+        class HealthHandler(RequestTimer, tornado.web.RequestHandler):
+            def get(self):
+                self.write({
+                    "status": "ok",
+                    "model_digest": svc.engine.digest,
+                    "ladder": list(svc.engine.ladder),
+                    "compile": svc.engine.compile_receipt,
+                    "serve": serve_snapshot(),
+                })
+
+        class MetricsHandler(RequestTimer, tornado.web.RequestHandler):
+            def get(self):
+                self.set_header("Content-Type", "application/json")
+                self.write(json.dumps(_registry.snapshot(),
+                                      default=repr))
+
+        return tornado.web.Application([
+            (self.path, InferHandler),
+            (r"/healthz", HealthHandler),
+            (r"/metrics.json", MetricsHandler),
+        ])
+
+    def start_background(self):
+        from concurrent.futures import ThreadPoolExecutor
+        # waiting requests only block on an Event, so workers are
+        # cheap; the pool bounds in-flight HTTP requests, the batcher's
+        # max_queue bounds admitted ones
+        self._executor = ThreadPoolExecutor(
+            max_workers=self._executor_workers,
+            thread_name_prefix="serve-http")
+        if self._owns_batcher:
+            self.batcher.start()
+        self._server = BackgroundHTTPServer(self._make_app(),
+                                            port=self._port)
+        thread = self._server.start()
+        self.info("serve endpoint on http://127.0.0.1:%d%s "
+                  "(healthz, metrics.json)", self.port, self.path)
+        return thread
+
+    def stop(self):
+        # order matters: close the listener (no new work), fail the
+        # batcher's pending requests (unblocks executor tasks), THEN
+        # join the executor so no worker thread outlives the service
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        if self._owns_batcher:
+            self.batcher.stop()
+        if self._executor is not None:
+            self._executor.shutdown(wait=self._owns_batcher)
+            self._executor = None

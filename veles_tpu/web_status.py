@@ -389,19 +389,25 @@ class WebStatusServer(Logger):
             spark = _sparkline(
                 _metric_history(self.store.get_history(sid)),
                 label=False)
+            def cell(k):
+                value = s.get(k)
+                if value is None:
+                    return ""
+                if k in ("metrics", "health", "serve"):
+                    return json.dumps(value)
+                return str(value)
             cells = "".join(
-                "<td>%s</td>" % html.escape(
-                    json.dumps(s.get(k)) if k in ("metrics", "health")
-                    else str(s.get(k, "")))
+                "<td>%s</td>" % html.escape(cell(k))
                 for k in ("workflow", "mode", "epoch", "metrics",
-                          "health", "slaves", "updated"))
+                          "health", "serve", "slaves", "updated"))
             rows.append(
                 "<tr><td><a href='/session/%s'>%s</a></td>%s<td>%s</td>"
                 "</tr>" % (quote(sid, safe=""),
                            html.escape(sid), cells, spark))
         return ("<table><tr><th>id</th><th>workflow</th><th>mode</th>"
                 "<th>epoch</th><th>metrics</th><th>health</th>"
-                "<th>slaves</th><th>updated</th><th>trend</th></tr>"
+                "<th>serve</th><th>slaves</th><th>updated</th>"
+                "<th>trend</th></tr>"
                 "%s</table>"
                 % "\n".join(rows))
 
@@ -435,6 +441,7 @@ class StatusReporter(object):
     def snapshot(self):
         from veles_tpu.observe.metrics import health_snapshot
         from veles_tpu.observe.metrics import registry as _registry
+        from veles_tpu.serve.batcher import serve_snapshot
         decision = getattr(self.workflow, "decision", None)
         launcher = self.workflow.launcher
         if _registry.peek("xla.step_flops") is not None:
@@ -460,6 +467,10 @@ class StatusReporter(object):
             # blacklist/quarantine from the server — reading them here
             # never forces a device sync
             "health": health_snapshot(),
+            # serving health (docs/serving.md): queue depth, SLO
+            # violations, latency percentiles — populated only on
+            # processes that run the serve subsystem
+            "serve": serve_snapshot() or None,
         }
 
     def _post_json(self, path, payload):
