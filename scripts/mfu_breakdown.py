@@ -15,6 +15,16 @@ is visible in the record (see "model_vs_measured_ratio").
 Writes MFU.json:  {measured: {...}, layers: [...], conclusion: "..."}
 
     python scripts/mfu_breakdown.py [--batch 256] [--dtype bfloat16]
+
+Pass filtering (the weather methodology, docs/kernels.md): every
+timing median — the measured step, the forward-only split — rides the
+jitter-FILTERED passes: a pass whose chain slope comes out
+non-positive measured the tunnel's weather, not the program (one such
+pass contaminated the published 48.8% capture, see MFU.json's
+weather_note), and is auto-discarded by ``bench._filter_passes``.
+The spread block records ``passes`` (raw), ``passes_used``
+(retained) and the per-pass ``slopes`` so the filter's effect is
+auditable from the committed record alone.
 """
 
 import argparse
@@ -144,13 +154,17 @@ def _measure_forward_only(plans, state, batch, peak_flops,
         float(v)
         return time.perf_counter() - start
 
+    from bench import _filter_passes, _spread
     slopes = []
     for _ in range(5):
         t1, t2 = chain(4), chain(24)
         slopes.append((t2 - t1) / 20)
-    per = float(numpy.median(slopes))
+    # the published median rides the jitter-filtered passes; the spread
+    # block records passes_used + every per-pass slope (see main())
+    per = float(numpy.median(_filter_passes(slopes)))
     row = {"step_ms": round(per * 1e3, 3),
-           "images_per_sec": round(batch / per, 1)}
+           "images_per_sec": round(batch / per, 1),
+           "spread": _spread(slopes)}
     if flops:
         row["xla_flops_per_step_g"] = round(flops / 1e9, 2)
         row["tflops"] = round(flops / per / 1e12, 1)

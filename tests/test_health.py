@@ -440,7 +440,9 @@ def test_matmul_debug_guard_raises_with_stats(monkeypatch):
     out = matmul_mod.matmul(a, b)
     assert not numpy.isfinite(numpy.asarray(out)).all()
     # guard on: raises with operand stats naming the non-finite count
-    monkeypatch.setattr(matmul_mod, "_DEBUG_NONFINITE", True)
+    # (the flag lives in ops.common — the kernels' one env contract)
+    common_mod = importlib.import_module("veles_tpu.ops.common")
+    monkeypatch.setattr(common_mod, "DEBUG_NONFINITE", True)
     with pytest.raises(FloatingPointError) as excinfo:
         matmul_mod.matmul(a, b)
     message = str(excinfo.value)
@@ -460,7 +462,8 @@ def test_matmul_debug_guard_names_bf16_domain(monkeypatch):
     out = matmul_mod.matmul(a, b)
     if numpy.isfinite(numpy.asarray(out)).all():
         pytest.skip("interpret-mode decomposition stayed finite here")
-    monkeypatch.setattr(matmul_mod, "_DEBUG_NONFINITE", True)
+    common_mod = importlib.import_module("veles_tpu.ops.common")
+    monkeypatch.setattr(common_mod, "DEBUG_NONFINITE", True)
     with pytest.raises(FloatingPointError) as excinfo:
         matmul_mod.matmul(a, b)
     assert "bf16x3 domain" in str(excinfo.value)

@@ -106,29 +106,71 @@ def adopt_state(sw, new_state, device=None):
         arr.detach_device()   # ...then collect, dropping references
 
 
-def _forward_for_loss(plans, params, x, key=None):
+def _forward_for_loss(plans, params, x, key=None, remat=False):
     """Forward pass; returns (pre-softmax logits | final output).
 
     ``key``: dropout rng; None (inference / keyless step) makes dropout
     layers identity (inverted dropout needs no eval-time rescale).
+
+    ``remat=True`` wraps each layer's apply in ``jax.checkpoint``: the
+    backward recomputes the layer forward instead of holding its
+    activations live across the whole gradient graph — part of the
+    backward-decongestion set (docs/kernels.md).  Recomputation replays
+    identical ops, so gradients stay bit-identical; it trades MXU time
+    for activation HBM pressure and is off by default.
     """
     from veles_tpu.models.all2all import All2All, All2AllSoftmax
     from veles_tpu.models.dropout import DropoutForward
+    import jax
+
+    def layer(fn):
+        return jax.checkpoint(fn) if remat else fn
+
     h = x
     for i, (plan, p) in enumerate(zip(plans, params)):
         if plan.forward_cls is All2AllSoftmax:
             # keep logits for a numerically-stable CE
-            h = All2All.apply(p, h)
+            h = layer(All2All.apply)(p, h)
         elif issubclass(plan.forward_cls, DropoutForward):
             if key is not None:
-                import jax
                 mask = DropoutForward.make_mask(
                     jax.random.fold_in(key, i), h.shape,
                     plan.static.get("dropout_ratio", 0.5), h.dtype)
                 h = h * mask
         else:
-            h = plan.forward_cls.apply(p, h, **plan.static)
+            h = layer(functools.partial(
+                plan.forward_cls.apply, **plan.static))(p, h)
     return h
+
+
+def _chain_grad_barriers(grads):
+    """Backward-decongestion scheduling hint (docs/kernels.md): thread
+    the per-layer gradient dicts through ``lax.optimization_barrier``
+    in backward PRODUCTION order (last layer first — its grads exist
+    first), so XLA cannot hoist every layer's wgrad to the end of the
+    schedule and pile them onto the MXU at once.  The barrier is an
+    identity — results are bit-identical with or without the chain
+    (tests/test_pallas_bwd.py proves it); only the schedule changes.
+    Mirrors parallel/bucketed.py's collective chaining."""
+    import jax
+    from jax import lax
+
+    barrier = getattr(lax, "optimization_barrier", None)
+    if barrier is None:  # jax API drift: hint only, never required
+        return grads
+    out = list(grads)
+    token = None
+    for idx in range(len(out) - 1, -1, -1):
+        leaves, treedef = jax.tree_util.tree_flatten(out[idx])
+        if not leaves:
+            continue
+        if token is None:
+            chained = barrier(tuple(leaves))
+        else:
+            chained = barrier(tuple(leaves) + (token,))[:-1]
+        token = chained[0]
+        out[idx] = jax.tree_util.tree_unflatten(treedef, list(chained))
+    return out
 
 
 def build_forward(plans):
@@ -144,7 +186,8 @@ def build_forward(plans):
 
 
 def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
-                   row_offset_fn=None):
+                   row_offset_fn=None, bwd_schedule=None,
+                   bwd_remat=False):
     """The raw (unjitted) train-step function shared by
     build_train_step (which jits one minibatch per dispatch) and
     build_train_epoch (which lax.scans it — one dispatch per epoch).
@@ -157,14 +200,25 @@ def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
     loss/aux scalars (psum over the data axis).  ``row_offset_fn()``
     returns this shard's global row offset so the mse tail mask keys
     on GLOBAL row indices (a short minibatch's padded rows live in the
-    last shard)."""
+    last shard).
+
+    Backward decongestion (docs/kernels.md): ``bwd_schedule`` (None ->
+    follow the VELES_PALLAS_BWD knob) threads the per-layer gradients
+    through an optimization_barrier chain in backward production order
+    — a pure scheduling hint, bit-identical results; ``bwd_remat``
+    checkpoints each layer's forward to cut activation pressure."""
     import jax
     import jax.numpy as jnp
+
+    if bwd_schedule is None:
+        from veles_tpu.ops.common import pallas_bwd_enabled
+        bwd_schedule = pallas_bwd_enabled()
 
     hypers = [p.hyper_full() for p in plans]
 
     def loss_fn(params, x, target, batch_size, key):
-        out = _forward_for_loss(plans, params, x, key)
+        out = _forward_for_loss(plans, params, x, key,
+                                remat=bwd_remat)
         if loss == "softmax":
             labels = target
             valid = labels >= 0
@@ -206,6 +260,11 @@ def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
                 lambda g: g + grad_poison.astype(g.dtype), grads)
         if loss_poison is not None:
             loss_value = loss_value + loss_poison
+        if bwd_schedule:
+            # scheduling hint only — identity on values (see
+            # _chain_grad_barriers); sits before the all-reduce so the
+            # buckets also issue in production order
+            grads = _chain_grad_barriers(grads)
         if grad_sync is not None:
             # SPMD data plane: bucketed all-reduce of the LOCAL grads.
             # Poisons inject before the sync so a chaos fault on one
@@ -305,7 +364,8 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
                      state_shardings=None, batch_sharding=None,
                      donate=True, compiler_options=None,
                      grad_bucket_mb=None, grad_compress=None,
-                     grad_allreduce_impl="psum"):
+                     grad_allreduce_impl="psum", bwd_schedule=None,
+                     bwd_remat=False):
     """Compile fn(state, x, labels_or_targets, batch_size) ->
     (new_state, metrics).
 
@@ -336,15 +396,23 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
       wire bytes (numerics-guard + trainer fallback own the risk);
       ``grad_allreduce_impl`` picks ``"psum"`` (default) or ``"ring"``
       (explicit ppermute ring from parallel/ring.py).
+
+    Backward scheduling (docs/kernels.md): ``bwd_schedule`` (None ->
+    the VELES_PALLAS_BWD knob) chains per-layer gradients through
+    optimization_barriers in backward production order — bit-identical
+    values, decongested MXU schedule; ``bwd_remat`` checkpoints layer
+    forwards (recompute-over-store).
     """
     import jax
 
     if mesh is not None and grad_bucket_mb is not None:
         return _build_spmd_train_step(
             plans, loss, mesh, data_axis, grad_bucket_mb, grad_compress,
-            grad_allreduce_impl, donate, compiler_options)
+            grad_allreduce_impl, donate, compiler_options,
+            bwd_schedule, bwd_remat)
 
-    step = _build_step_fn(plans, loss)
+    step = _build_step_fn(plans, loss, bwd_schedule=bwd_schedule,
+                          bwd_remat=bwd_remat)
 
     jit_kwargs = {}
     if compiler_options:
@@ -386,7 +454,8 @@ def _fixed_arity_lower(jitted):
 
 def _build_spmd_train_step(plans, loss, mesh, data_axis, grad_bucket_mb,
                            grad_compress, grad_allreduce_impl, donate,
-                           compiler_options):
+                           compiler_options, bwd_schedule=None,
+                           bwd_remat=False):
     """The pure-SPMD data plane: shard_map over ``mesh``'s data axis,
     per-device backward on the local batch shard, bucketed gradient
     all-reduce (parallel/bucketed.py), replicated update.  State and
@@ -422,7 +491,9 @@ def _build_spmd_train_step(plans, loss, mesh, data_axis, grad_bucket_mb,
     _local_rows = [0]
     raw = _build_step_fn(plans, loss, grad_sync=grad_sync,
                          metric_sync=metric_sync,
-                         row_offset_fn=row_offset_fn)
+                         row_offset_fn=row_offset_fn,
+                         bwd_schedule=bwd_schedule,
+                         bwd_remat=bwd_remat)
 
     def local_step(state, x, target, batch_size, step_key,
                    grad_poison, loss_poison):

@@ -49,9 +49,19 @@ def conv2d(x, w, strides, padding, pet=None):
 
 
 class Conv(ForwardBase):
-    """y = activation(conv2d(x, W) + b)."""
+    """y = activation(conv2d(x, W) + b).
+
+    With the ``VELES_PALLAS_BWD`` knob on (docs/kernels.md), ``apply``
+    routes through the ``ops.conv_vjp.conv_act`` custom_vjp: the
+    forward HLO is bit-identical (same conv + bias + activation
+    composition), but the backward the fused step differentiates is
+    the hand-scheduled family — fused activation-backward/bias-grad
+    epilogue in the Pallas wgrad tiles, dgrad as the explicit
+    lhs-dilated conv.  ``ACTIVATION`` names the epilogue.
+    """
 
     MAPPING = "conv"
+    ACTIVATION = "linear"
 
     def __init__(self, workflow, **kwargs):
         super(Conv, self).__init__(workflow, **kwargs)
@@ -66,11 +76,23 @@ class Conv(ForwardBase):
         return z
 
     @classmethod
-    def apply(cls, params, x, *, padding=(0, 0, 0, 0), sliding=(1, 1)):
+    def apply(cls, params, x, *, padding=(0, 0, 0, 0), sliding=(1, 1),
+              pallas_bwd=None):
         import jax.numpy as jnp
         W = params["weights"]
         if x.ndim == 3:
             x = x[..., None]
+        if pallas_bwd is None:
+            from veles_tpu.ops.common import pallas_bwd_enabled
+            pallas_bwd = pallas_bwd_enabled()
+        if pallas_bwd:
+            # forward-identical custom_vjp carrying the hand-scheduled
+            # backward (ops/conv_vjp.py); pallas_bwd=False restores
+            # the stock autodiff path below bit-exactly
+            from veles_tpu.ops.conv_vjp import conv_act
+            return conv_act(x, W, params.get("bias"),
+                            activation=cls.ACTIVATION, padding=padding,
+                            sliding=sliding)
         left, top, right, bottom = padding
         sx, sy = sliding
         # preferred_element_type=f32 + cast breaks the conv transpose
@@ -124,19 +146,23 @@ class Conv(ForwardBase):
 
 class ConvTanh(Conv):
     MAPPING = "conv_tanh"
+    ACTIVATION = "tanh"
     _activate = staticmethod(All2AllTanh._activate)
 
 
 class ConvRELU(Conv):
     MAPPING = "conv_relu"
+    ACTIVATION = "relu_log"
     _activate = staticmethod(All2AllRELU._activate)
 
 
 class ConvStrictRELU(Conv):
     MAPPING = "conv_str"
+    ACTIVATION = "strict_relu"
     _activate = staticmethod(All2AllStrictRELU._activate)
 
 
 class ConvSigmoid(Conv):
     MAPPING = "conv_sigmoid"
+    ACTIVATION = "sigmoid"
     _activate = staticmethod(All2AllSigmoid._activate)

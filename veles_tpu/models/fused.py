@@ -228,16 +228,38 @@ class FusedTrainer(Unit):
             # jax without Lowered.cost_analysis we just skip FLOPs
             # publication (mfu stays null) instead
             cost = self._step_fn.lower(*args, **kwargs).cost_analysis()
-            if isinstance(cost, (list, tuple)):  # per-program variants
-                flops = sum(float(c.get("flops", 0.0)) for c in cost
-                            if isinstance(c, dict))
-            else:
-                flops = float((cost or {}).get("flops", 0.0))
+            flops = self._cost_flops(cost)
             if flops > 0:
                 self._step_flops_ = flops
                 _xla.set_step_flops(flops)
+            # forward-only FLOPs from the eval dispatch's lowering (the
+            # same layer composition as the step's forward): feeds the
+            # live fwd/bwd attribution — bwd.step_ms / bwd.mfu_pct
+            # gauges next to mfu_pct (xla_introspect.bwd_snapshot,
+            # docs/kernels.md)
+            params = [{"weights": aval(s["weights"]),
+                       "bias": aval(s["bias"])} for s in self._state]
+            if self.loss == "softmax":
+                fwd_cost = self._eval_metrics.lower(
+                    params, aval(x), aval(target)).cost_analysis()
+            else:
+                fwd_cost = self._eval_metrics.lower(
+                    params, aval(x), aval(target),
+                    aval(batch_size)).cost_analysis()
+            fwd_flops = self._cost_flops(fwd_cost)
+            if 0 < fwd_flops < flops:
+                _xla.set_fwd_flops(fwd_flops)
         except Exception as exc:
             self.debug("step cost analysis unavailable: %s", exc)
+
+    @staticmethod
+    def _cost_flops(cost):
+        """One flops extraction for cost_analysis()'s dict/list-of-dict
+        return variants across jax releases."""
+        if isinstance(cost, (list, tuple)):
+            return sum(float(c.get("flops", 0.0)) for c in cost
+                       if isinstance(c, dict))
+        return float((cost or {}).get("flops", 0.0))
 
     def _stage_sharded(self, arr):
         """Stage one minibatch Array onto the mesh, leading dim over

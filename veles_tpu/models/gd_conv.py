@@ -7,7 +7,7 @@ same way the hand-written CUDA backward kernels did, but fused and
 MXU-tiled.
 """
 
-from veles_tpu.models.conv import Conv, _norm_padding
+from veles_tpu.models.conv import _norm_padding
 from veles_tpu.models.gd import (
     GDRELU, GDSigmoid, GDStrictRELU, GDTanh, GradientDescent)
 from veles_tpu.models.nn_units import GradientDescentBase
@@ -27,23 +27,40 @@ class GDConv(GradientDescent):
     def backward_static(self):
         return {"padding": self.padding, "sliding": self.sliding}
 
+    #: epilogue name for the fused conv-VJP family (matches the
+    #: forward class's ACTIVATION; docs/kernels.md)
+    ACTIVATION = "linear"
+
     @classmethod
     def backward(cls, state, hyper, x, y, err_output, *, solver,
                  include_bias, need_err_input,
                  padding=(0, 0, 0, 0), sliding=(1, 1)):
-        import jax
         import jax.numpy as jnp
+
+        from veles_tpu.ops.common import pallas_bwd_enabled
         W = state["weights"]
-        err = cls._activation_grad(y, err_output).astype(x.dtype)
-
-        def lin(W_, x_):
-            return Conv.apply({"weights": W_, "bias": None}, x_,
-                              padding=padding, sliding=sliding)
-
-        _, vjp = jax.vjp(lin, W, x)
-        grad_w, err_input = vjp(err)
-        if not need_err_input:
-            err_input = None
+        if pallas_bwd_enabled():
+            # hand-scheduled backward (ops/conv_vjp.py): activation
+            # backward + bias reduction fused into the Pallas wgrad
+            # tiles, dgrad as the explicit lhs-dilated conv.  The
+            # finite_guard below sees the same grad tensors either
+            # way, so a poisoned step still skips bit-exactly.
+            from veles_tpu.ops.conv_vjp import fused_conv_vjp
+            err_input, grad_w, grad_b_raw = fused_conv_vjp(
+                x, W, y, err_output, activation=cls.ACTIVATION,
+                padding=padding, sliding=sliding,
+                include_bias=include_bias,
+                need_err_input=need_err_input)
+        else:
+            # the ONE stock formulation (also fused_conv_vjp's
+            # many-tap fallback), so the bit-exact knob-off contract
+            # has a single definition to hold to
+            from veles_tpu.ops.conv_vjp import _autodiff_conv_vjp
+            err_input, grad_w, grad_b_raw = _autodiff_conv_vjp(
+                x, W, y, err_output, activation=cls.ACTIVATION,
+                padding=padding, sliding=sliding,
+                include_bias=include_bias,
+                need_err_input=need_err_input)
 
         grad_w = GradientDescentBase.regularized(
             grad_w.astype(jnp.float32), W, hyper["weights_decay"],
@@ -59,9 +76,9 @@ class GDConv(GradientDescent):
         grad_b = None
         if include_bias:
             b = state["bias"]
-            grad_b = err.astype(jnp.float32).sum(axis=(0, 1, 2))
             grad_b = GradientDescentBase.regularized(
-                grad_b, b, hyper["weights_decay_bias"], hyper["l1_vs_l2"])
+                grad_b_raw, b, hyper["weights_decay_bias"],
+                hyper["l1_vs_l2"])
             new_b, acc_b, acc2_b = GradientDescentBase.solver_update(
                 solver, b, grad_b.astype(b.dtype), state["accum_bias"],
                 state["accum2_bias"], hyper["learning_rate_bias"],
@@ -78,19 +95,23 @@ class GDConv(GradientDescent):
 
 class GDConvTanh(GDConv):
     MAPPING = "conv_tanh"
+    ACTIVATION = "tanh"
     _activation_grad = staticmethod(GDTanh._activation_grad)
 
 
 class GDConvRELU(GDConv):
     MAPPING = "conv_relu"
+    ACTIVATION = "relu_log"
     _activation_grad = staticmethod(GDRELU._activation_grad)
 
 
 class GDConvStrictRELU(GDConv):
     MAPPING = "conv_str"
+    ACTIVATION = "strict_relu"
     _activation_grad = staticmethod(GDStrictRELU._activation_grad)
 
 
 class GDConvSigmoid(GDConv):
     MAPPING = "conv_sigmoid"
+    ACTIVATION = "sigmoid"
     _activation_grad = staticmethod(GDSigmoid._activation_grad)

@@ -47,6 +47,24 @@ class GDMaxPooling(GDPoolingBase):
     MAPPING = "max_pooling"
     FORWARD_CLS = MaxPooling
 
+    @classmethod
+    def backward(cls, state, hyper, x, y, err_output, *, solver,
+                 include_bias, need_err_input, window=None,
+                 sliding=None):
+        from veles_tpu.ops.common import pallas_bwd_enabled
+        if pallas_bwd_enabled():
+            # scheduled select-and-scatter kernel (ops/pool_bwd.py),
+            # fed the STORED forward output y — no pooling recompute,
+            # and the incoming err cascade multiplies the routing mask
+            # inside the kernel (docs/kernels.md)
+            from veles_tpu.ops.pool_bwd import max_pool_bwd
+            return max_pool_bwd(x, y, err_output, window=window,
+                                sliding=sliding), {}
+        return super(GDMaxPooling, cls).backward(
+            state, hyper, x, y, err_output, solver=solver,
+            include_bias=include_bias, need_err_input=need_err_input,
+            window=window, sliding=sliding)
+
 
 class GDMaxAbsPooling(GDPoolingBase):
     MAPPING = "maxabs_pooling"

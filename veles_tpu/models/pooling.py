@@ -83,10 +83,20 @@ class MaxPooling(PoolingBase):
     MAPPING = "max_pooling"
 
     @classmethod
-    def apply(cls, params, x, *, window, sliding):
+    def apply(cls, params, x, *, window, sliding, pallas_bwd=None):
         from jax import lax
         if x.ndim == 3:
             x = x[..., None]
+        if pallas_bwd is None:
+            from veles_tpu.ops.common import pallas_bwd_enabled
+            pallas_bwd = pallas_bwd_enabled()
+        if pallas_bwd:
+            # same reduce_window forward, backward = the scheduled
+            # select-and-scatter Pallas kernel (ops/pool_bwd.py,
+            # docs/kernels.md); pallas_bwd=False keeps the stock
+            # autodiff select-and-scatter below bit-exactly
+            from veles_tpu.ops.pool_bwd import max_pool
+            return max_pool(x, window=window, sliding=sliding)
         return _pool(x, window, sliding, -numpy.inf, lax.max)
 
 

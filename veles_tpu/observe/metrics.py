@@ -199,6 +199,10 @@ _HEALTH_KEYS = (
     # XLA introspection (observe/xla_introspect.py): live achieved-MFU
     # and compile accounting ride the same health surface
     ("xla.mfu_pct", "mfu_pct"),
+    # backward attribution (docs/kernels.md): the fwd/bwd split next
+    # to the whole-step MFU, refreshed by the same mfu_snapshot tick
+    ("bwd.mfu_pct", "bwd_mfu_pct"),
+    ("bwd.step_ms", "bwd_step_ms"),
     ("compile.count", "compiles"),
     ("compile.recompiles", "recompiles"),
 )

@@ -36,8 +36,8 @@ from veles_tpu.observe.metrics import registry as _registry
 
 __all__ = ["CompileWatcher", "watcher", "ensure_installed", "watch",
            "poll_recompiles", "device_memory_gauges", "set_step_flops",
-           "peak_flops", "mfu_snapshot", "compile_snapshot",
-           "PEAK_BF16_TFLOPS"]
+           "set_fwd_flops", "peak_flops", "mfu_snapshot",
+           "bwd_snapshot", "compile_snapshot", "PEAK_BF16_TFLOPS"]
 
 #: bf16 MXU peak TFLOP/s by device-kind substring (public spec sheets);
 #: bench.py shares this table for its offline MFU context.
@@ -252,6 +252,16 @@ def set_step_flops(flops, reg=None):
     reg.gauge("xla.step_flops").set(float(flops))
 
 
+def set_fwd_flops(flops, reg=None):
+    """Record the cost-analysis FLOP count of the FORWARD-only program
+    (the fused trainer's eval dispatch — same layer composition as the
+    train step's forward).  Together with ``xla.step_flops`` this is
+    what lets :func:`bwd_snapshot` attribute the step between forward
+    and backward+update (docs/kernels.md)."""
+    reg = reg if reg is not None else _registry
+    reg.gauge("xla.fwd_flops").set(float(flops))
+
+
 _peak_cache = {}
 _peak_lock = threading.Lock()
 
@@ -325,6 +335,13 @@ def mfu_snapshot(reg=None):
     step-time window: MFU is a steady-state number and a median
     ignores the compile-step outlier by construction."""
     reg = reg if reg is not None else _registry
+    # the backward attribution refreshes on the same tick (heartbeat /
+    # web-status reporter both call mfu_snapshot), so the fwd/bwd
+    # split can never lag the whole-step number it decomposes.  It
+    # runs FIRST: bwd.step_ms needs only the train/eval histograms,
+    # so it must survive this function's own early returns (no FLOPs
+    # gauge, no peak rating)
+    bwd_snapshot(reg)
     flops_gauge = reg.peek("xla.step_flops")
     hist = reg.peek("step.train_s")
     if flops_gauge is None or flops_gauge.value is None or hist is None:
@@ -342,3 +359,47 @@ def mfu_snapshot(reg=None):
     mfu = round(mfu, 3)
     reg.gauge("xla.mfu_pct").set(mfu)
     return mfu
+
+
+def bwd_snapshot(reg=None):
+    """Backward+update attribution (docs/kernels.md): ``bwd.step_ms``
+    and ``bwd.mfu_pct`` gauges next to the whole-step ``xla.mfu_pct``,
+    so heartbeats and web_status carry the fwd/bwd split — the offline
+    MFU.json ``backward_attribution`` block, live.
+
+    Derived, no new host syncs: the eval dispatch IS the forward-only
+    program and its ``step.eval_s`` histogram is already measured, so
+    bwd time = p50(train step) - p50(eval step) and bwd FLOPs =
+    ``xla.step_flops`` - ``xla.fwd_flops`` (both published by the
+    fused trainer's one-time cost analysis).  Approximation caveat:
+    the eval forward skips dropout masking and the loss tail, so the
+    split attributes those few percent to the backward side.  Returns
+    {"bwd_step_ms", "bwd_mfu_pct"} or None while any input is missing
+    (no eval steps yet, cost analysis unavailable)."""
+    reg = reg if reg is not None else _registry
+    train_hist = reg.peek("step.train_s")
+    eval_hist = reg.peek("step.eval_s")
+    step_gauge = reg.peek("xla.step_flops")
+    fwd_gauge = reg.peek("xla.fwd_flops")
+    if train_hist is None or eval_hist is None:
+        return None
+    train_win = train_hist.window_values()
+    eval_win = eval_hist.window_values()
+    if not train_win or not eval_win:
+        return None
+    train_s = percentiles(train_win, ps=(50,)).get("p50")
+    eval_s = percentiles(eval_win, ps=(50,)).get("p50")
+    if not train_s or not eval_s or train_s <= eval_s:
+        return None
+    bwd_s = train_s - eval_s
+    out = {"bwd_step_ms": round(bwd_s * 1e3, 3)}
+    reg.gauge("bwd.step_ms").set(out["bwd_step_ms"])
+    peak = peak_flops()
+    if (peak and step_gauge is not None and fwd_gauge is not None
+            and step_gauge.value and fwd_gauge.value
+            and step_gauge.value > fwd_gauge.value):
+        bwd_flops = float(step_gauge.value) - float(fwd_gauge.value)
+        out["bwd_mfu_pct"] = round(
+            100.0 * bwd_flops / bwd_s / peak, 3)
+        reg.gauge("bwd.mfu_pct").set(out["bwd_mfu_pct"])
+    return out

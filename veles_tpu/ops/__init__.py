@@ -16,10 +16,17 @@ random.cl (xorshift)          ops.random — xorshift128+/1024* bit-exact,
 mean_disp_normalizer.cl       ops.normalize
 join.jcl                      ops.join
 benchmark.cl                  ops.benchmark (autotune + power rating)
+(gradient kernels, new)       ops.conv_vjp — fused conv-VJP family
+                              (epilogue+bias+wgrad Pallas kernel,
+                              lhs-dilated dgrad); ops.pool_bwd —
+                              max-pool select-and-scatter backward
+                              (docs/kernels.md, VELES_PALLAS_BWD)
 ===========================  ===========================================
 """
 
 from veles_tpu.ops.matmul import matmul  # noqa: F401
+from veles_tpu.ops.conv_vjp import conv_act, fused_conv_vjp  # noqa: F401
+from veles_tpu.ops.pool_bwd import max_pool, max_pool_bwd  # noqa: F401
 from veles_tpu.ops.blas import gemm  # noqa: F401
 from veles_tpu.ops.reduce import reduce_rows, reduce_cols  # noqa: F401
 from veles_tpu.ops.gather import gather_minibatch, gather_labels  # noqa: F401

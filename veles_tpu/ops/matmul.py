@@ -27,15 +27,16 @@ Tile sizes come from the per-chip autotune table
 """
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from veles_tpu.ops import common as _common
 from veles_tpu.ops.common import (ceil_mult, interpret_for,
-                                   pad_to, tpu_compiler_params, unpad)
+                                   mxu_partial_dot, pad_to,
+                                   tpu_compiler_params, unpad)
 
 __all__ = ["matmul", "matmul_benchmark", "autotune_matmul",
            "MATMUL_KERNEL_VERSION"]
@@ -65,39 +66,13 @@ def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, comp_ref,
             comp_ref[:] = jnp.zeros_like(comp_ref)
 
     # f32 multiply precision maps the reference's speed/accuracy ladder
-    # onto the MXU's pass structure: level 0 ("plain", fastest) uses a
-    # hand-rolled bf16x3 decomposition (a_hi@b_hi + a_hi@b_lo +
-    # a_lo@b_hi — ~f32-class products at ~2x the 6-pass throughput;
-    # Mosaic lowers only DEFAULT/HIGHEST, so HIGH is spelled out),
-    # levels 1/2 pay for HIGHEST = 6 passes (true-f32 products) plus
-    # Kahan/Neumaier accumulation — like the reference, each level
-    # trades speed for digits (config.py:245-248: level 2 ~2x slower).
-    # bf16 inputs MUST use DEFAULT: Mosaic rejects HIGHEST for bf16
-    # operands on real TPUs ("Bad lhs type").
-    # (f32 only: other wide dtypes keep the conservative HIGHEST path;
-    # note the decomposition maps |x| >= bf16-max (~3.39e38) and inf
-    # to NaN — f32 operands that large are out of the kernel's domain)
-    if a_ref.dtype == jnp.float32 and precision_level == 0:
-        a_f32 = a_ref[:].astype(jnp.float32)
-        b_f32 = b_ref[:].astype(jnp.float32)
-        a_hi = a_f32.astype(jnp.bfloat16)
-        b_hi = b_f32.astype(jnp.bfloat16)
-        a_lo = (a_f32 - a_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-        b_lo = (b_f32 - b_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-
-        def bf16_dot(x, y):
-            return jnp.dot(x, y, preferred_element_type=jnp.float32,
-                           precision=jax.lax.Precision.DEFAULT)
-
-        partial = (bf16_dot(a_hi, b_hi) + bf16_dot(a_hi, b_lo)
-                   + bf16_dot(a_lo, b_hi))
-    else:
-        precision = (jax.lax.Precision.DEFAULT
-                     if a_ref.dtype == jnp.bfloat16
-                     else jax.lax.Precision.HIGHEST)
-        partial = jnp.dot(a_ref[:], b_ref[:],
-                          preferred_element_type=jnp.float32,
-                          precision=precision)
+    # onto the MXU's pass structure (the PRODUCT step is the shared
+    # common.mxu_partial_dot, so the conv-VJP wgrad kernel and this one
+    # cannot drift): level 0 ("plain", fastest) = bf16x3 decomposition
+    # for f32 inputs, levels 1/2 pay for HIGHEST = 6 passes (true-f32
+    # products) plus Kahan/Neumaier accumulation — like the reference,
+    # each level trades speed for digits (config.py:245-248).
+    partial = mxu_partial_dot(a_ref[:], b_ref[:], precision_level)
     if precision_level == 0:
         acc_ref[:] += partial
     elif precision_level == 1:
@@ -155,15 +130,11 @@ def matmul(a, b, precision_level=0, blocks=None, out_dtype=None):
     """
     out = _matmul_jit(a, b, precision_level, blocks, out_dtype,
                       interpret_for(a, b))
-    if _DEBUG_NONFINITE:
+    # read live from ops.common — ONE patch point for every kernel's
+    # guard (conv_vjp reads the same flag), per common.py's contract
+    if _common.DEBUG_NONFINITE:
         _debug_check_finite(a, b, out, precision_level)
     return out
-
-
-#: env-gated opt-in (read once at import; tests monkeypatch the module
-#: flag directly): the guard synchronizes on every call
-_DEBUG_NONFINITE = os.environ.get(
-    "VELES_DEBUG_NONFINITE", "") not in ("", "0")
 
 
 def _operand_stats(name, x):

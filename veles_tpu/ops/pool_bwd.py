@@ -1,0 +1,229 @@
+"""Max-pool select-and-scatter backward Pallas kernel
+(docs/kernels.md).
+
+scripts/pool_bwd_experiment.py measured XLA's select-and-scatter max
+pool gradient beating the patches/argmax formulation 6x AND being the
+only value-exact routing — so select-and-scatter is the scheduled
+primitive here, fused with the incoming err cascade: the kernel
+multiplies the routing mask by the incoming cotangent in the same tile
+pass that computes it, instead of materializing a one-hot and a
+separate multiply.
+
+Formulation (one image x one channel tile per grid step): for each tap
+(kh, kw) of the window, in row-major window order, a tap element is
+SELECTED iff it equals the window max (the forward output ``y``, which
+the unit already holds — no recompute) and no earlier tap matched
+(first-match tie-break, the same scan order XLA's SelectAndScatter
+folds ge-select in).  The selected cotangent is then scattered back to
+input coordinates through a stride-dilated shift — all on values
+resident in scoped VMEM, one pass over the window.
+
+Ceil-mode partial windows (models/pooling.py pads bottom/right) are
+covered by padding the input block with -inf: padded cells never equal
+a real window max, exactly reduce_window's -inf init semantics.
+
+Parity (tests/test_pallas_bwd.py): routing is bit-exact vs the
+``jax.vjp(lax.reduce_window)`` reference on exactly-representable
+cotangents (including ties and ceil-mode tails); random cotangents
+agree within ~1 ULP where >= 2 overlapping windows sum in a different
+order.  Windows larger than the VMEM budget (big-image VGG-style
+inputs with OVERLAPPING windows) fall back to autodiff;
+non-overlapping windows (kx == sx, ky == sy — the VGG 2x2/2 case)
+tile the W axis and stay on the kernel.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from veles_tpu.ops.common import (ceil_mult, interpret_for, pad_to,
+                                   tpu_compiler_params, unpad)
+
+__all__ = ["max_pool_bwd", "max_pool", "POOL_VMEM_BUDGET_BYTES"]
+
+#: per-grid-step VMEM budget for the pool blocks (x + y + dy + out +
+#: f32 accumulator); overlapping-window shapes that exceed it keep the
+#: autodiff backward rather than risk a Mosaic VMEM overflow
+POOL_VMEM_BUDGET_BYTES = 12 * 2 ** 20
+
+
+def _pool_bwd_kernel(x_ref, y_ref, dy_ref, out_ref, *, window, sliding,
+                     out_h, out_w, in_h, in_w):
+    """One (n, w-tile, c-tile) grid step of the routed scatter."""
+    ky, kx = window
+    sx, sy = sliding
+    xv = x_ref[0]                       # (Hp, Wp, cb), -inf padded
+    yv = y_ref[0]                       # (OH, OWb, cb)
+    dyv = dy_ref[0].astype(jnp.float32)
+    span_h = (out_h - 1) * sy + 1
+    span_w = (out_w - 1) * sx + 1
+    matched = jnp.zeros(yv.shape, jnp.bool_)
+    acc = jnp.zeros(xv.shape, jnp.float32)
+    for kh in range(ky):
+        for kw in range(kx):
+            x_tap = jax.lax.slice(
+                xv, (kh, kw, 0),
+                (kh + span_h, kw + span_w, xv.shape[2]),
+                (sy, sx, 1))
+            sel = (x_tap == yv) & ~matched
+            matched = matched | sel
+            contrib = jnp.where(sel, dyv, 0.0)
+            if sx == 1 and sy == 1:
+                dilated = contrib
+            else:
+                z = jnp.zeros((out_h, sy, out_w, sx, contrib.shape[2]),
+                              jnp.float32)
+                z = z.at[:, 0, :, 0, :].set(contrib)
+                dilated = z.reshape(out_h * sy, out_w * sx,
+                                    contrib.shape[2])
+                dilated = dilated[:span_h, :span_w, :]
+            acc = acc.at[kh:kh + span_h, kw:kw + span_w, :].add(dilated)
+    out_ref[0] = acc[:in_h, :in_w, :].astype(out_ref.dtype)
+
+
+def _plan_blocks(h, w_sp, c, oh, ow, window, sliding, itemsize):
+    """(w-tiles, ow-block) fitting POOL_VMEM_BUDGET_BYTES, or None when
+    the shape cannot tile (overlapping windows need the full W span)."""
+    ky, kx = window
+    sx, sy = sliding
+    cb = ceil_mult(c, 128)
+
+    def footprint(owb):
+        wb = (owb - 1) * sx + kx
+        elems = ((h + ky) * wb            # padded x block
+                 + 2 * oh * owb           # y + dy
+                 + h * wb)                # out
+        return elems * cb * itemsize + (h + ky) * wb * cb * 4  # f32 acc
+
+    if footprint(ow) <= POOL_VMEM_BUDGET_BYTES:
+        return 1, ow
+    if kx != sx or ky != sy:
+        return None  # overlapping windows: no halo-free W tiling
+    owb = ow
+    while owb > 1 and footprint(owb) > POOL_VMEM_BUDGET_BYTES:
+        owb = -(-owb // 2)
+    if footprint(owb) > POOL_VMEM_BUDGET_BYTES:
+        return None
+    return -(-ow // owb), owb
+
+
+@functools.partial(
+    jax.jit, static_argnames=("window", "sliding", "interpret"))
+def _max_pool_bwd_jit(x, y, dy, window, sliding, interpret):
+    from jax import lax
+    ky, kx = window
+    sx, sy = sliding
+    n, h, w_sp, c = x.shape
+    oh, ow = y.shape[1], y.shape[2]
+
+    plan = _plan_blocks(h, w_sp, c, oh, ow, window, sliding,
+                        jnp.dtype(x.dtype).itemsize)
+    if plan is None:
+        # VMEM-infeasible overlapping shape: stock autodiff routing
+        from veles_tpu.models.pooling import MaxPooling
+
+        def pool(x_):
+            return MaxPooling.apply({}, x_, window=window,
+                                    sliding=sliding, pallas_bwd=False)
+
+        _, vjp = jax.vjp(pool, x)
+        (err_input,) = vjp(dy.astype(x.dtype))
+        return err_input
+    n_wtiles, owb = plan
+
+    need_h = (oh - 1) * sy + ky
+    # W coverage: full need_w when untiled; owb*sx per tile when tiled
+    # (tiling only happens for kx == sx, where need_w == ow*sx exactly,
+    # so block offsets are exact multiples of the block width)
+    bwx = need_w = (ow - 1) * sx + kx
+    if n_wtiles > 1:
+        bwx = owb * sx
+    xw_total = n_wtiles * bwx
+    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
+    cb = ceil_mult(c, 128)
+    # -inf padding everywhere a real (ceil-mode) window can peek past
+    # the input — reduce_window's init semantics, so a padded cell can
+    # never be selected over a real window max.  Channel padding is
+    # plain zeros: a zero can only "match" a zero-padded y cell, whose
+    # cotangent is the zero pad_to wrote (contributes nothing).
+    xp = lax.pad(x, neg_inf,
+                 [(0, 0, 0), (0, need_h - h, 0),
+                  (0, xw_total - w_sp, 0), (0, 0, 0)])
+    xp = pad_to(xp, (None, None, None, cb))
+    y_p = pad_to(y, (None, None, owb, cb))
+    dy_p = pad_to(dy, (None, None, owb, cb))
+
+    out = pl.pallas_call(
+        functools.partial(
+            _pool_bwd_kernel, window=window, sliding=sliding,
+            out_h=oh, out_w=owb, in_h=h,
+            in_w=w_sp if n_wtiles == 1 else bwx),
+        grid=(n, n_wtiles),
+        in_specs=[
+            pl.BlockSpec((1, need_h, bwx, cb),
+                         lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((1, oh, owb, cb), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((1, oh, owb, cb), lambda i, j: (i, 0, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, w_sp if n_wtiles == 1 else bwx,
+                                cb),
+                               lambda i, j: (i, 0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (n, h, w_sp if n_wtiles == 1 else xw_total, cb), x.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(xp, y_p, dy_p)
+    return unpad(out, (n, h, w_sp, c))
+
+
+def max_pool_bwd(x, y, err_output, *, window, sliding):
+    """err_input for max pooling via the scheduled select-and-scatter
+    kernel: ``x`` the forward input, ``y`` the forward output (the
+    window maxima — no recompute), ``err_output`` the incoming
+    cotangent.  Returns err_input in ``x.dtype``."""
+    return _max_pool_bwd_jit(x, y, err_output.astype(x.dtype),
+                             (int(window[0]), int(window[1])),
+                             (int(sliding[0]), int(sliding[1])),
+                             interpret_for(x, err_output))
+
+
+# -- custom_vjp forward wrapper ---------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _max_pool_fn(window, sliding):
+    """Per-config custom_vjp of the max-pool forward: forward is
+    EXACTLY models/pooling.py's reduce_window composition, backward is
+    the kernel above."""
+    from veles_tpu.models.pooling import MaxPooling
+
+    def raw(x):
+        return MaxPooling.apply({}, x, window=window, sliding=sliding,
+                                pallas_bwd=False)
+
+    @jax.custom_vjp
+    def f(x):
+        return raw(x)
+
+    def fwd(x):
+        y = raw(x)
+        return y, (x, y)
+
+    def bwd(res, dy):
+        x, y = res
+        return (max_pool_bwd(x, y, dy, window=window,
+                             sliding=sliding),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def max_pool(x, *, window, sliding):
+    """Max pooling with the select-and-scatter Pallas backward attached
+    (models/pooling.py routes here when VELES_PALLAS_BWD is on)."""
+    return _max_pool_fn((int(window[0]), int(window[1])),
+                        (int(sliding[0]), int(sliding[1])))(x)
