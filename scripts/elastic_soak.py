@@ -1,0 +1,375 @@
+"""Preemption soak: SIGKILL real slave processes on a seeded schedule
+and prove the elasticity contract with receipts (ELASTIC.json).
+
+The driver trains a small master-slave run where the slave is a REAL
+subprocess that preempts itself — the chaos point ``slave.preempt``
+(``kill`` = ``os.kill(os.getpid(), SIGKILL)``: no atexit, no goodbye
+frame, the closest in-tree stand-in for a preemptible chip being
+reclaimed).  Each incarnation's kill point comes from an aK-style
+``VELES_CHAOS`` spec derived from one seed; the driver waits out a
+seeded ``slave.rejoin_after`` delay and respawns.  Receipts:
+
+- **bit-stable convergence**: the soaked master's final weights are
+  bit-identical to a fault-free run of the same seeds (momentum-free
+  layers — slave-local solver state is NOT shipped per job, so only
+  stateless jobs make a respawned process equivalent to a surviving
+  one; docs/distributed.md documents the caveat);
+- **bounded throughput loss**: soak wall time minus fault-free wall
+  time stays under the injected rejoin delays plus a per-preempt
+  respawn allowance (subprocess + jax import + workflow build);
+- **kill-during-reshard exactly-once**: an in-process run where a
+  reshard push severs the conn (``server.reshard=kill``) applies
+  exactly as many updates as fault-free, bit-identical weights — no
+  update double-applied, none lost.
+
+    python scripts/elastic_soak.py --out ELASTIC.json \
+        [--preempts 6] [--max-epochs 10] [--seed 42]
+
+The ``slow``-marked test wrapper (tests/test_elastic.py) runs a
+shortened soak through this same driver; the tier-1 smoke variant
+lives in-process in that file.
+"""
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy  # noqa: E402
+
+from veles_tpu import chaos, prng  # noqa: E402
+from veles_tpu.chaos import FaultPlan  # noqa: E402
+from veles_tpu.loader.fullbatch import FullBatchLoader  # noqa: E402
+from veles_tpu.prng import RandomGenerator  # noqa: E402
+
+#: momentum-free on purpose: gd solver state (velocity) lives on the
+#: slave and is NOT shipped per job, so only stateless jobs make a
+#: RESPAWNED slave process bit-equivalent to one that survived
+LAYERS = [
+    {"type": "all2all_tanh", "output_sample_shape": 24,
+     "learning_rate": 0.05, "gradient_moment": 0.0},
+    {"type": "softmax", "output_sample_shape": 4,
+     "learning_rate": 0.05, "gradient_moment": 0.0},
+]
+
+#: per-preempt respawn allowance for the throughput bound: process
+#: spawn + jax import + workflow build + reconnect backoff on CPU CI
+RESPAWN_ALLOWANCE_S = 30.0
+
+
+class SoakLoader(FullBatchLoader):
+    """Deterministic 4-class Gaussian blobs (the chaos-suite feed),
+    rebuilt identically by every slave incarnation from its seed."""
+
+    def load_data(self):
+        self.class_lengths[:] = [0, 64, 256]
+        self._calc_class_end_offsets()
+        self.create_originals((16,))
+        rng = numpy.random.RandomState(99)
+        centers = rng.randn(4, 16) * 2.0
+        for i in range(self.total_samples):
+            label = i % 4
+            self.original_data.mem[i] = (
+                centers[label] + rng.randn(16) * 0.3)
+            self.original_labels[i] = label
+
+
+def build(mode, seed_key, max_epochs):
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.models.nn_workflow import StandardWorkflow
+    prng.get().seed(4242)  # identical layer-init streams everywhere
+    wf = DummyWorkflow()
+    wf.workflow.workflow_mode = mode
+    sw = StandardWorkflow(
+        wf.workflow, layers=[dict(spec) for spec in LAYERS],
+        loader_factory=lambda w: SoakLoader(
+            w, minibatch_size=64,
+            prng=RandomGenerator(seed_key, seed=7)),
+        decision_config=dict(max_epochs=max_epochs),
+    )
+    sw.initialize(device=Device(backend="cpu"))
+    return sw
+
+
+def master_weights(sw):
+    out = []
+    for fwd in sw.forwards:
+        fwd.weights.map_read()
+        out.append(numpy.array(fwd.weights.mem))
+    return out
+
+
+def start_master(max_epochs):
+    from veles_tpu.server import Server
+    sw = build("master", "soak_m", max_epochs)
+    server = Server("127.0.0.1:0", sw)
+    sw.workflow.on_workflow_finished = server.on_workflow_finished
+    server.start_background()
+    assert server.wait_listening(10), server.bind_error
+    return sw, server
+
+
+def spawn_worker(port, max_epochs, chaos_spec):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("VELES_CHAOS", None)
+    if chaos_spec:
+        env["VELES_CHAOS"] = chaos_spec
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--worker", "127.0.0.1:%d" % port,
+         "--max-epochs", str(max_epochs)],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def worker_main(address, max_epochs):
+    # VELES_CHAOS was parsed at veles_tpu.chaos import: slave.preempt
+    # is armed (or not) before the first job ever runs
+    from veles_tpu.client import Client
+    sw = build("slave", "soak_s", max_epochs)
+    Client(address, sw).run()
+    return 0
+
+
+def run_fault_free(max_epochs):
+    """The reference leg: same master, ONE clean subprocess slave."""
+    sw, server = start_master(max_epochs)
+    t0 = time.perf_counter()
+    child = spawn_worker(server.port, max_epochs, None)
+    done = server._done.wait(1200)
+    wall = time.perf_counter() - t0
+    try:
+        child.wait(30)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        child.wait()
+    assert done, "fault-free reference never finished"
+    assert bool(sw.decision.complete)
+    return {
+        "wall_s": round(wall, 2),
+        "updates_applied": server.updates_applied,
+        "jobs_dispatched": server.jobs_dispatched,
+        "weights": master_weights(sw),
+        "metrics": [None if m is None else float(m)
+                    for m in sw.decision.epoch_metrics],
+    }
+
+
+def run_soak(seed, max_epochs, target_preempts, max_incarnations=60):
+    """The soak leg: slaves preempt themselves on the seeded aK
+    schedule until ``target_preempts`` SIGKILLs landed, then a clean
+    incarnation finishes the run."""
+    rng = random.Random(seed)
+    # the rejoin cadence is itself a FaultPlan schedule: one nK entry
+    # per incarnation, param = seconds to wait before the respawn
+    rejoin_plan = FaultPlan(seed=seed)
+    for k in range(1, max_incarnations + 1):
+        rejoin_plan.add("slave.rejoin_after", "delay", nth=k,
+                        param=round(rng.uniform(0.2, 1.0), 3))
+    kill_after = [rng.randint(2, 6) for _ in range(max_incarnations)]
+
+    sw, server = start_master(max_epochs)
+    events = []
+    preempts = rejoins = incarnation = 0
+    t0 = time.perf_counter()
+    delay_total = 0.0
+    child = None
+    try:
+        while not server._done.is_set():
+            assert incarnation < max_incarnations, \
+                "soak never converged (%d incarnations)" % incarnation
+            if preempts < target_preempts:
+                spec = "seed=%d;slave.preempt=kill:a%d:x1" % (
+                    seed + incarnation, kill_after[incarnation])
+            else:
+                spec = None  # clean tail incarnation finishes the run
+            child = spawn_worker(server.port, max_epochs, spec)
+            incarnation += 1
+            while child.poll() is None and \
+                    not server._done.wait(0.2):
+                pass
+            if server._done.is_set():
+                break
+            rc = child.returncode
+            if rc == -signal.SIGKILL:
+                preempts += 1
+                events.append({"event": "preempt", "incarnation":
+                               incarnation, "after_jobs":
+                               kill_after[incarnation - 1]})
+            else:
+                events.append({"event": "exit", "incarnation":
+                               incarnation, "rc": rc})
+            fault = rejoin_plan.fire("slave.rejoin_after")
+            delay = fault.param if fault is not None else 0.5
+            delay_total += delay
+            time.sleep(delay)
+            rejoins += 1
+            events.append({"event": "rejoin", "incarnation":
+                           incarnation, "delay_s": delay})
+    finally:
+        if child is not None and child.poll() is None:
+            child.terminate()
+            try:
+                child.wait(15)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait()
+    wall = time.perf_counter() - t0
+    assert server._done.wait(60), "soak master never finished"
+    assert bool(sw.decision.complete)
+    return {
+        "wall_s": round(wall, 2),
+        "preempts": preempts,
+        "rejoins": rejoins,
+        "events": events,
+        "rejoin_delay_total_s": round(delay_total, 2),
+        "updates_applied": server.updates_applied,
+        "jobs_dispatched": server.jobs_dispatched,
+        "reshards": server.reshards,
+        "membership_epoch": server.fleet.membership_epoch,
+        "stale_updates": server.stale_updates,
+        "duplicates_dropped": server.duplicates_dropped,
+        "requeued_minibatches": sw.loader.total_failed,
+        "weights": master_weights(sw),
+        "metrics": [None if m is None else float(m)
+                    for m in sw.decision.epoch_metrics],
+    }
+
+
+def run_kill_during_reshard(max_epochs):
+    """In-process exactly-once case: the slave dies mid-run, and the
+    reshard push at its REJOIN severs the conn again
+    (``server.reshard=kill``).  Same applied-update count and
+    bit-identical weights as fault-free = nothing double-applied,
+    nothing lost."""
+    from veles_tpu.client import Client
+
+    def leg(plan):
+        sw_m = build("master", "soak_krr_m", max_epochs)
+        sw_s = build("slave", "soak_krr_s", max_epochs)
+        from veles_tpu.server import Server
+        server = Server("127.0.0.1:0", sw_m)
+        sw_m.workflow.on_workflow_finished = server.on_workflow_finished
+        server.start_background()
+        assert server.wait_listening(10)
+        client = Client("127.0.0.1:%d" % server.port, sw_s)
+        if plan is not None:
+            chaos.install(plan)
+        try:
+            client.run()
+        finally:
+            chaos.uninstall()
+        assert server._done.wait(60)
+        assert bool(sw_m.decision.complete)
+        return sw_m, server, client
+
+    ref_sw, ref_server, _ = leg(None)
+    plan = (FaultPlan(seed=7)
+            .add("client.job", "die", nth=3)
+            .add("server.reshard", "kill", nth=2))
+    sw, server, client = leg(plan)
+    identical = all(
+        numpy.array_equal(a, b) for a, b in zip(
+            master_weights(ref_sw), master_weights(sw)))
+    return {
+        "reshard_kills_fired": plan.fired("server.reshard"),
+        "sessions": client.sessions_established,
+        "updates_applied_fault_free": ref_server.updates_applied,
+        "updates_applied": server.updates_applied,
+        "double_applies": max(
+            0, server.updates_applied - ref_server.updates_applied),
+        "stale_updates": server.stale_updates,
+        "bit_identical": bool(identical),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="preemption soak -> ELASTIC.json receipt")
+    parser.add_argument("--worker", metavar="HOST:PORT",
+                        help="internal: run as a soak slave process")
+    parser.add_argument("--out", default=os.path.join(
+        REPO, "ELASTIC.json"))
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--preempts", type=int, default=6,
+                        help="SIGKILL preemptions before the clean "
+                             "tail (events = preempts + rejoins)")
+    parser.add_argument("--max-epochs", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    if args.worker:
+        return worker_main(args.worker, args.max_epochs)
+
+    print("== fault-free reference (one clean subprocess slave) ==")
+    ref = run_fault_free(args.max_epochs)
+    print("   wall %.1fs, %d updates" % (ref["wall_s"],
+                                         ref["updates_applied"]))
+    print("== soak: %d seeded SIGKILL preemptions ==" % args.preempts)
+    soak = run_soak(args.seed, args.max_epochs, args.preempts)
+    print("   wall %.1fs, %d preempts, %d rejoins, %d reshards" % (
+        soak["wall_s"], soak["preempts"], soak["rejoins"],
+        soak["reshards"]))
+    print("== kill-during-reshard exactly-once case ==")
+    krr = run_kill_during_reshard(max_epochs=3)
+
+    identical = all(
+        numpy.array_equal(a, b)
+        for a, b in zip(ref.pop("weights"), soak.pop("weights")))
+    overhead = round(soak["wall_s"] - ref["wall_s"], 2)
+    bound = round(soak["rejoin_delay_total_s"] +
+                  soak["preempts"] * RESPAWN_ALLOWANCE_S, 2)
+    receipt = {
+        "schema": "elastic-soak-v1",
+        "generated_unix": int(time.time()),
+        "platform": "cpu (JAX_PLATFORMS=cpu; control-plane receipt — "
+                    "the protocol under test is device-agnostic)",
+        "seed": args.seed,
+        "config": {
+            "max_epochs": args.max_epochs,
+            "minibatch": 64,
+            "train_samples": 256,
+            "layers": "all2all_tanh(24)+softmax(4), momentum-free "
+                      "(slave-local solver state is not shipped per "
+                      "job; see docs/distributed.md)",
+        },
+        "fault_free": ref,
+        "soak": soak,
+        "events_total": soak["preempts"] + soak["rejoins"],
+        "bit_identical": bool(identical and
+                              ref["metrics"] == soak["metrics"]),
+        "throughput": {
+            "overhead_s": overhead,
+            "bound_s": bound,
+            "loss_pct": round(100.0 * overhead /
+                              max(soak["wall_s"], 1e-9), 1),
+            "within_bound": bool(overhead <= bound),
+        },
+        "kill_during_reshard": krr,
+    }
+    with open(args.out, "w") as fout:
+        json.dump(receipt, fout, indent=1, sort_keys=True)
+        fout.write("\n")
+    print("wrote %s: %d events, bit_identical=%s, overhead %.1fs "
+          "(bound %.1fs), kdr double_applies=%d" % (
+              args.out, receipt["events_total"],
+              receipt["bit_identical"], overhead, bound,
+              krr["double_applies"]))
+    ok = (receipt["bit_identical"]
+          and receipt["events_total"] >= 10
+          and receipt["throughput"]["within_bound"]
+          and krr["double_applies"] == 0
+          and krr["bit_identical"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,31 @@ point               module                     actions
 ``net.recv``        network_common.read_frame  corrupt, delay (per-
                                                frame latency, awaited)
 ``server.serve``    server.Server._serve_job   kill, stall
+``server.reshard``  server.Server._reshard     kill (sever one conn
+                                               mid-reshard-push — the
+                                               kill-during-reshard
+                                               case the exactly-once
+                                               update guarantee must
+                                               survive; fires per
+                                               slave pushed)
 ``client.job``      client.Client._job_loop    die
+``slave.preempt``   client.Client._job_loop    kill (SIGKILL SELF —
+                                               real preemption for
+                                               subprocess soaks; use
+                                               ``client.job=die`` for
+                                               in-process tests.
+                                               aK-style schedules,
+                                               e.g. ``kill:a4:x1``,
+                                               preempt after K clean
+                                               jobs)
+``slave.rejoin_after``  soak drivers           (no action verb: the
+                    (scripts/elastic_soak.py)  fired fault's *param*
+                                               is the seconds a
+                                               driver waits before
+                                               respawning the
+                                               preempted slave; an
+                                               aK/xM schedule shapes
+                                               the rejoin cadence)
 ``net.update``      client (update send)       nan (poison the update
                                                payload's float arrays;
                                                param overrides the

@@ -18,6 +18,7 @@ client.py:278-354), reconnection with an attempt budget, and
 import asyncio
 import os
 import random
+import signal
 import threading
 import time
 
@@ -108,6 +109,15 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
         self.shm_sends = 0
         #: successful handshakes over this client's lifetime
         self.sessions_established = 0
+        #: elasticity state (docs/distributed.md, "Elasticity
+        #: contract"): the membership epoch this slave was admitted
+        #: at rides the handshake ack; reshard pushes update the
+        #: fleet's current epoch, this slave's power-weighted share of
+        #: the unserved remainder, and the live fleet size
+        self.member_epoch = None
+        self.share = None
+        self.fleet_size = None
+        self.reshards_seen = 0
         self._handshaken = False
         self._session_progress = False
         self._stopping = False
@@ -220,6 +230,15 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                 "machine": machine_id(),
                 "pid": os.getpid()})
             msg, payload = await self._recv(reader)
+            # the fleet reshards on EVERY membership change: another
+            # slave joining or leaving while our handshake is still in
+            # flight can push a reshard frame ahead of our ack (the
+            # master registers us before generating our initial data).
+            # Absorb them — dying here would turn a concurrent join
+            # into a permanent loss of this slave
+            while msg.get("type") == "reshard":
+                self._apply_reshard(msg)
+                msg, payload = await self._recv(reader)
             if msg.get("type") == "reject":
                 self.reject_reason = msg.get("reason")
                 retry_after = msg.get("retry_after")
@@ -239,10 +258,19 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                 self.error("master rejected us: %s", self.reject_reason)
                 self._stopping = True
                 return
+            if msg.get("type") == "stop":
+                # a (re)join racing the master's shutdown: the
+                # handshake is answered with 'stop' instead of an ack
+                # — a clean end of the run, not a protocol violation
+                self.info("master is stopping; ending session")
+                self._stopping = True
+                return
             assert msg.get("type") == "handshake_ack"
             self.sid = msg["id"]
             self._handshaken = True
             self.sessions_established += 1
+            if "member_epoch" in msg:
+                self.member_epoch = msg["member_epoch"]
             self._trace_tids.add(threading.get_ident())
             if msg.get("trace"):
                 self.trace_id = msg["trace"]
@@ -297,6 +325,8 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                         self._paused = True
                     elif mtype == "resume":
                         self._paused = False
+                    elif mtype == "reshard":
+                        self._apply_reshard(msg)
                     elif mtype == "stop":
                         self._stopping = True
                         return
@@ -376,6 +406,12 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                 continue
             if mtype == "update_ack":
                 continue
+            if mtype == "reshard":
+                # membership changed somewhere in the fleet: learn the
+                # new split (and our admission epoch) without breaking
+                # the job cycle
+                self._apply_reshard(msg)
+                continue
             if mtype != "job":
                 continue
             if (self.death_probability > 0 and
@@ -393,6 +429,19 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
                     self.warning("fault injection: dying on job %d",
                                  self.jobs_done + 1)
                     raise ConnectionResetError("injected death (chaos)")
+                # the REAL preemption: SIGKILL this process, the
+                # closest in-tree stand-in for a preemptible chip
+                # being reclaimed (no atexit, no finally blocks, no
+                # goodbye frame).  Subprocess soaks arm this; the
+                # in-process variant above covers the same master-side
+                # requeue path without taking the test runner with it
+                fault = chaos.plan.fire("slave.preempt")
+                if fault is not None and fault.action == "kill":
+                    self.warning(
+                        "fault injection: preempting (SIGKILL self, "
+                        "pid %d) on job %d", os.getpid(),
+                        self.jobs_done + 1)
+                    os.kill(os.getpid(), signal.SIGKILL)
             job8 = str(msg.get("job_id") or "")[:8]
             _tracer.instant("proto.job_in", cat="proto", job=job8,
                             trace=str(self.trace_id or "")[:8])
@@ -430,6 +479,33 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             self._ship_trace_chunk(writer)
             if not self.async_slave:
                 self._send(writer, {"type": "job_request"})
+
+    def _apply_reshard(self, msg):
+        """A membership change repartitioned the epoch's unserved
+        remainder (docs/distributed.md, "Elasticity contract"): record
+        the fleet's new membership epoch and this slave's power-
+        weighted share, and forward both to the workflow's
+        ``apply_reshard`` hook when it defines one (the loader records
+        them as its window hint).  Advisory by design — the master
+        still serves minibatches job by job, so a stale share can
+        never corrupt the sample accounting."""
+        self.member_epoch = msg.get("epoch", self.member_epoch)
+        self.share = msg.get("share")
+        self.fleet_size = msg.get("fleet")
+        self.reshards_seen += 1
+        _registry.gauge("elastic.membership_epoch").set(
+            self.member_epoch or 0)
+        self.info("resharded: membership epoch %s, fleet of %s, our "
+                  "share %s", self.member_epoch, self.fleet_size,
+                  "?" if self.share is None else self.share)
+        hook = getattr(self.workflow, "apply_reshard", None)
+        if hook is not None:
+            try:
+                hook({"epoch": self.member_epoch, "share": self.share,
+                      "fleet": self.fleet_size,
+                      "remaining": msg.get("remaining")})
+            except Exception:
+                self.exception("apply_reshard hook failed")
 
     async def _run_job(self, data):
         result = {}

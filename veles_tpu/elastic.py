@@ -1,0 +1,195 @@
+"""Elastic-fleet bookkeeping: membership epochs, power-weighted
+partition of an epoch's unserved sample space, and the shared
+straggler-speculation threshold math.
+
+VELES's control plane was built for a fixed fleet; TensorFlow's system
+design (PAPERS.md, 1605.08695) treats dynamic worker membership and
+speculative re-execution as first-class.  This module holds the pure
+math and bookkeeping both planes share:
+
+- :class:`FleetView` — the master's view of live membership.  Every
+  join and leave bumps a **membership epoch**; the Server stamps jobs
+  and rejects updates from departed members (docs/distributed.md,
+  "Elasticity contract"), so a preempted chip's late duplicate can
+  never double-apply work that was requeued at drop time.
+- :func:`power_shares` — largest-remainder apportionment of the
+  epoch's *unserved remainder* among live slaves weighted by their
+  reported computing power.  Pushed to slaves on every reshard so the
+  fleet knows its fair split without restarting the run.
+- :func:`speculation_threshold` — the straggler bar (lifted from
+  jobfarm's backup-copy logic): once an in-flight job is older than
+  ``factor x`` the mean completed duration (power-corrected, floored),
+  an idle peer shadows it and the first result wins.
+
+All power inputs are **degenerate-safe**: a zero, negative or
+non-finite rating (a failed benchmark, a corrupt handshake) is
+neutralized to the baseline 1.0 before any division, so the threshold
+and partition math never divide by a sick fleet aggregate.
+"""
+
+import math
+
+__all__ = ["FleetView", "effective_power", "fleet_mean_power",
+           "power_shares", "speculation_threshold", "fleet_snapshot",
+           "POWER_SCALE_BOUND"]
+
+#: Bound on the power correction applied to the speculation threshold:
+#: a chip rated 100x slower than the fleet mean must still be
+#: speculated *eventually* — unbounded runway would turn one absurd
+#: rating into a job that is never shadowed.
+POWER_SCALE_BOUND = 8.0
+
+
+def effective_power(power):
+    """A slave's power rating, sanitized for use in ratios.
+
+    Zero, negative, non-finite, or non-numeric ratings (the client
+    reports 1.0 on a failed benchmark, but a corrupt handshake can
+    ship anything) collapse to the neutral 1.0 — the same weight the
+    client itself falls back to — so fleet aggregates stay positive
+    and every division downstream is safe.
+    """
+    try:
+        value = float(power)
+    except (TypeError, ValueError):
+        return 1.0
+    if not math.isfinite(value) or value <= 0.0:
+        return 1.0
+    return value
+
+
+def power_shares(total, powers):
+    """Apportion ``total`` work units among members by power.
+
+    ``powers`` maps member key -> reported power rating.  Returns
+    {key: integer share}, shares summing exactly to ``total``
+    (largest-remainder method: floors first, then the biggest
+    fractional parts pick up the leftover units; ties broken by key so
+    the split is deterministic).  Empty fleet or unknown/negative
+    total -> {} (nothing to partition).
+    """
+    if not powers or total is None or total < 0:
+        return {}
+    total = int(total)
+    eff = {key: effective_power(p) for key, p in powers.items()}
+    aggregate = sum(eff.values())  # > 0: effective_power is positive
+    exact = {key: total * p / aggregate for key, p in eff.items()}
+    shares = {key: int(exact[key]) for key in eff}
+    leftover = total - sum(shares.values())
+    for key in sorted(eff, key=lambda k: (shares[k] - exact[k],
+                                          str(k)))[:leftover]:
+        shares[key] += 1
+    return shares
+
+
+def fleet_mean_power(fleet_powers):
+    """Mean sanitized power of a fleet (> 0 by construction), or None
+    for an empty fleet.  Hoist this out of per-job speculation loops:
+    only the owner's power varies job-to-job, so the fleet pass need
+    not be repeated per candidate."""
+    fleet = [effective_power(p) for p in fleet_powers]
+    if not fleet:
+        return None
+    return sum(fleet) / len(fleet)
+
+
+def speculation_threshold(mean_duration, factor, floor,
+                          owner_power=None, fleet_powers=(),
+                          mean_power=None):
+    """Age (seconds) past which an in-flight job counts as straggling.
+
+    ``factor x mean_duration`` is the MapReduce backup-task bar the
+    jobfarm pioneered here; ``floor`` keeps millisecond-scale jobs
+    from speculating their whole tail.  When the fleet reports power
+    ratings, the bar is *power-corrected*: a job on a chip rated below
+    the fleet mean gets proportionally more runway (and a fast chip
+    less), bounded by :data:`POWER_SCALE_BOUND` so one absurd rating
+    cannot make a job unspeculatable.  All aggregates are
+    degenerate-safe (zero/negative/single-member fleets included) via
+    :func:`effective_power`.  Callers looping over candidate jobs
+    should hoist :func:`fleet_mean_power` and pass ``mean_power``
+    (``fleet_powers`` is then ignored).
+    """
+    try:
+        mean = float(mean_duration)
+    except (TypeError, ValueError):
+        mean = 0.0
+    if not math.isfinite(mean) or mean < 0.0:
+        mean = 0.0
+    if mean_power is None:
+        mean_power = fleet_mean_power(fleet_powers)
+    scale = 1.0
+    if mean_power is not None:
+        scale = mean_power / effective_power(owner_power)
+        scale = min(max(scale, 1.0 / POWER_SCALE_BOUND),
+                    POWER_SCALE_BOUND)
+    return max(float(factor) * mean * scale, float(floor))
+
+
+class FleetView(object):
+    """The master's live-membership ledger.
+
+    Every :meth:`join` and :meth:`leave` bumps ``membership_epoch`` —
+    the monotonically increasing counter the Server stamps on jobs and
+    reshard pushes.  An update arriving from a slave that left at
+    epoch E is *stale* with respect to every epoch > E: its work was
+    requeued when it left, so the Server drops the duplicate instead
+    of applying it (the exactly-once half of the elasticity contract).
+    """
+
+    def __init__(self):
+        self.membership_epoch = 0
+        self.members = {}  # sid -> reported power rating
+
+    def __len__(self):
+        return len(self.members)
+
+    def join(self, sid, power):
+        """Admit ``sid``; returns the new membership epoch."""
+        self.members[sid] = power
+        self.membership_epoch += 1
+        return self.membership_epoch
+
+    def leave(self, sid):
+        """Retire ``sid``; returns the (possibly bumped) epoch.  An
+        unknown sid does not bump — a double drop is not a membership
+        change."""
+        if sid in self.members:
+            del self.members[sid]
+            self.membership_epoch += 1
+        return self.membership_epoch
+
+    def shares(self, remaining):
+        """Power-weighted split of ``remaining`` work units across the
+        live fleet ({} when the remainder is unknown)."""
+        return power_shares(remaining, self.members)
+
+    def powers(self):
+        """The live fleet's raw power ratings (threshold inputs)."""
+        return list(self.members.values())
+
+
+#: Fleet keys surfaced to dashboards: registry name -> short name
+#: (the elastic mirror of observe.metrics._HEALTH_KEYS).
+_FLEET_KEYS = (
+    ("elastic.membership_epoch", "membership_epoch"),
+    ("elastic.fleet_live", "live"),
+    ("elastic.speculative_inflight", "speculative_inflight"),
+    ("elastic.reshards", "reshards"),
+    ("elastic.speculative_jobs", "speculative_jobs"),
+    ("elastic.duplicates_dropped", "duplicates_dropped"),
+    ("elastic.stale_updates", "stale_updates"),
+    ("elastic.drops_deferred", "drops_deferred"),
+    ("server.blacklist_size", "blacklisted"),
+    ("server.quarantined", "quarantined"),
+)
+
+
+def fleet_snapshot(reg=None):
+    """The elastic-fleet counters as a flat dict for the web-status
+    fleet column and post-mortems: membership epoch, live/blacklisted/
+    quarantined counts, speculation and exactly-once accounting.  Only
+    metrics a server actually published appear ({} on slaves and
+    standalone runs)."""
+    from veles_tpu.observe.metrics import snapshot_keys
+    return snapshot_keys(_FLEET_KEYS, reg)

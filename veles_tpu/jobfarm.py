@@ -51,6 +51,7 @@ import threading
 import time
 from collections import deque
 
+from veles_tpu import elastic
 from veles_tpu.logger import Logger
 
 __all__ = ["JobFarm", "FarmJobError"]
@@ -92,6 +93,12 @@ class _FarmMaster(object):
     #: validate-during-apply (docs/distributed.md)
     update_validation = "prewalk"
 
+    #: this adapter runs its OWN job-stamp/backup-copy bookkeeping
+    #: (dedup by result slot, epoch stamps) — the Server must not
+    #: layer its lifted speculation pass on top, or every tail job
+    #: could triplicate (docs/distributed.md, "Elasticity contract")
+    owns_speculation = True
+
     def __init__(self, checksum, speculation_factor=2.0,
                  min_speculation_s=5.0, context=None):
         self.checksum = checksum
@@ -102,6 +109,7 @@ class _FarmMaster(object):
         self._specs = []
         self._pending = deque()
         self._outstanding = {}      # job index -> {slave id: t0}
+        self._powers = {}           # slave id -> reported power rating
         self._durations = deque(maxlen=200)
         self.epoch = 0              # batch counter; stamps every job
         self.results = []
@@ -134,6 +142,7 @@ class _FarmMaster(object):
 
     def generate_data_for_slave(self, slave):
         with self._lock:
+            self._powers[slave.id] = getattr(slave, "power", 1.0)
             if self._pending:
                 i, spec = self._pending.popleft()
                 # perf_counter: these stamps feed job durations and
@@ -147,21 +156,35 @@ class _FarmMaster(object):
             # than speculation_factor x the mean completed duration
             # (with an absolute floor: millisecond-scale jobs would
             # otherwise speculate the whole batch tail) — immediate
-            # re-issue would duplicate every tail job
+            # re-issue would duplicate every tail job.  The threshold
+            # math is shared with the Server's lifted speculation pass
+            # (elastic.speculation_threshold): power-corrected, and
+            # degenerate-safe against zero/negative/corrupt ratings
             if not self._durations:
                 return False
-            threshold = max(
-                self.speculation_factor *
-                sum(self._durations) / len(self._durations),
-                self.min_speculation_s)
+            mean = sum(self._durations) / len(self._durations)
+            mean_power = elastic.fleet_mean_power(
+                self._powers.values())
             now = time.perf_counter()
             for i, copies in self._outstanding.items():
-                if (slave.id not in copies
-                        and self.results[i] is _UNSET
-                        and now - min(copies.values()) > threshold):
+                if slave.id in copies or self.results[i] is not _UNSET:
+                    continue
+                owner = min(copies, key=copies.get)
+                threshold = elastic.speculation_threshold(
+                    mean, self.speculation_factor,
+                    self.min_speculation_s,
+                    owner_power=self._powers.get(owner),
+                    mean_power=mean_power)
+                if now - copies[owner] > threshold:
                     copies[slave.id] = now
                     return (self.epoch, i, self._specs[i])
             return False            # park until an update frees work
+
+    def unserved_remainder(self):
+        """Reshard input (Server._reshard): jobs of the current batch
+        not yet resolved — pending plus in-flight."""
+        with self._lock:
+            return sum(1 for r in self.results if r is _UNSET)
 
     def apply_update_validated(self, update, slave):
         """Inline-validation form for farms that opt in
@@ -200,6 +223,9 @@ class _FarmMaster(object):
 
     def drop_slave(self, slave):
         with self._lock:
+            # a departed member's rating must not keep skewing the
+            # fleet-mean power the speculation threshold divides by
+            self._powers.pop(slave.id, None)
             for i in list(self._outstanding):
                 copies = self._outstanding[i]
                 copies.pop(slave.id, None)

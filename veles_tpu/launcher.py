@@ -252,6 +252,20 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                                  launcher=self)
         self.initialized = True
 
+    def on_fleet_change(self, info):
+        """Server reshard hook (docs/distributed.md, "Elasticity
+        contract"): every membership change lands in the structured
+        event stream, so the dashboard's event browser shows
+        joins/leaves/reshards next to the health events they often
+        explain (a loss spike right after half the fleet left is not
+        divergence)."""
+        self.event("fleet.reshard", "instant", **{
+            k: v for k, v in info.items() if v is not None})
+        self.info("fleet change: %s -> membership epoch %s, %s live, "
+                  "unserved remainder %s", info.get("reason"),
+                  info.get("epoch"), info.get("live"),
+                  info.get("remaining"))
+
     def _start_status_reporter(self):
         """Periodic status posts to the web-status service while the
         session runs — slaves stay silent, like the reference

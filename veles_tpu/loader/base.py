@@ -113,6 +113,10 @@ class Loader(Unit):
         self.failed_minibatches = []
         self._total_failed = 0
         self.has_data_for_slave = True
+        #: advisory elastic-fleet window hint from the last reshard
+        #: push (apply_reshard); None until a master ever pushed one
+        self.fleet_share = None
+        self.fleet_epoch = None
         self._normalization_type = kwargs.get("normalization_type", "none")
         self._normalization_parameters = kwargs.get(
             "normalization_parameters", {})
@@ -487,6 +491,31 @@ class Loader(Unit):
             self.info("Jobs failed: %d, pending: %d",
                       len(self.failed_minibatches),
                       self.pending_minibatches_count)
+
+    def unserved_remainder(self):
+        """Elastic resharding input (docs/distributed.md): samples of
+        the current epoch not yet APPLIED — the class-window total
+        minus this epoch's applied progress.  Reserved-but-unapplied
+        minibatches count as unserved: a reshard after a drop must
+        repartition exactly the work the requeue put back."""
+        total = self.effective_total_samples
+        if not total:
+            return None
+        return total - self.samples_served % total
+
+    def apply_reshard(self, info):
+        """Slave-side window hint from a master reshard push: this
+        loader's power-weighted share of the epoch's unserved
+        remainder and the membership epoch it was computed at.
+        Advisory next to the authoritative per-job
+        ``apply_data_from_master`` window — the hint lets dashboards
+        (and future prefetch sizing) see the fair split without
+        touching the sample accounting."""
+        self.fleet_share = info.get("share")
+        self.fleet_epoch = info.get("epoch")
+        self.debug("reshard hint: share %s of %s at membership "
+                   "epoch %s", self.fleet_share, info.get("remaining"),
+                   self.fleet_epoch)
 
     # -- serving ------------------------------------------------------------
 

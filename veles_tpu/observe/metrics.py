@@ -19,7 +19,8 @@ import math
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "registry", "percentiles", "health_snapshot"]
+           "registry", "percentiles", "health_snapshot",
+           "snapshot_keys"]
 
 
 def percentiles(samples, ps=(50, 95, 99)):
@@ -196,6 +197,14 @@ _HEALTH_KEYS = (
     ("health.rollbacks", "rollbacks"),
     ("server.blacklist_size", "blacklist_size"),
     ("server.quarantined", "quarantined"),
+    # elastic-fleet state (veles_tpu/elastic.py): membership epoch and
+    # live fleet size ride heartbeats so a post-mortem can line up
+    # divergence/skip events against membership changes; the full
+    # fleet block (speculation + exactly-once accounting) is
+    # elastic.fleet_snapshot() on the dashboard
+    ("elastic.membership_epoch", "membership_epoch"),
+    ("elastic.fleet_live", "fleet_live"),
+    ("elastic.speculative_inflight", "speculative_inflight"),
     # XLA introspection (observe/xla_introspect.py): live achieved-MFU
     # and compile accounting ride the same health surface
     ("xla.mfu_pct", "mfu_pct"),
@@ -208,16 +217,24 @@ _HEALTH_KEYS = (
 )
 
 
+def snapshot_keys(keys, reg=None):
+    """Flatten (registry name -> short name) pairs into a plain dict
+    of published values.  Metrics never registered (peek keeps readers
+    from creating empties) or still None are omitted — the shared
+    backbone of health_snapshot and elastic.fleet_snapshot."""
+    reg = reg if reg is not None else registry
+    out = {}
+    for name, short in keys:
+        metric = reg.peek(name)
+        if metric is not None and metric.value is not None:
+            out[short] = metric.value
+    return out
+
+
 def health_snapshot(reg=None):
     """The PR-3 numerics-health counters as a flat dict for the
     web-status posts and the heartbeat line: skip counts published by
     the decision unit at its class-end sync, rollback budget remaining
     by the snapshotter, blacklist/quarantine sizes by the server.
     Only counters that were actually published appear."""
-    reg = reg if reg is not None else registry
-    out = {}
-    for name, short in _HEALTH_KEYS:
-        metric = reg.peek(name)
-        if metric is not None and metric.value is not None:
-            out[short] = metric.value
-    return out
+    return snapshot_keys(_HEALTH_KEYS, reg)

@@ -26,16 +26,35 @@ Preserved capabilities (SURVEY.md section 2.6/5):
 - respawn hook with exponential backoff (the reference respawned over
   SSH; on TPU clusters process lifecycle belongs to the scheduler, so
   the hook takes a user callable).
+
+Elastic-fleet semantics (docs/distributed.md, "Elasticity contract"):
+
+- MEMBERSHIP EPOCHS: every join/leave/quarantine bumps
+  ``fleet.membership_epoch`` (veles_tpu/elastic.py) and repartitions
+  the epoch's unserved remainder over the live fleet (power-weighted),
+  pushing ``reshard`` frames so slaves learn the new split without a
+  restart; an ``elastic.resharded`` instant records each change.
+- EXACTLY-ONCE updates: a dropped slave's work is requeued at drop
+  time, so its late in-flight update is rejected (``stale``) instead
+  of applied — never both.  The requeue itself is DEFERRED while one
+  of the slave's updates is mid-apply on the executor, closing the
+  drop-vs-apply race (the same job must not requeue AND apply).
+- SPECULATIVE BACKUP DISPATCH: jobfarm's job-stamp/backup-copy logic,
+  lifted here — an idle requester at the sync point shadows the
+  oldest straggling in-flight job (power-aware threshold,
+  ``elastic.speculation_threshold``); the first result wins and the
+  loser's duplicate is dropped before validation ever runs.
 """
 
 import asyncio
+import math
 import threading
 import time
 from collections import deque
 
 import numpy
 
-from veles_tpu import chaos, health
+from veles_tpu import chaos, elastic, health
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
@@ -74,12 +93,43 @@ class _SlaveConn(object):
         self.parked = False
         self.shm_out = None         # master -> slave payload channel
         self.shm_in = None          # slave -> master payload channel
+        #: membership epoch this slave was admitted at (handshake)
+        self.member_epoch = 0
+        #: sample share from the last reshard push (None = unknown)
+        self.share = None
+        #: set by _drop: frames still in flight from this conn are
+        #: STALE — its work was requeued, applying them would double
+        self.dropped = False
+        #: a generate_data_for_slave for this conn is in the executor:
+        #: a reservation may exist that jobs_out does not show yet, so
+        #: _speculate must not shadow this owner's in-flight job (the
+        #: TOCTOU half of the single-reservation invariant)
+        self.generating = False
 
     def close_shm(self):
         for chan in (self.shm_out, self.shm_in):
             if chan is not None:
                 chan.close()
         self.shm_out = self.shm_in = None
+
+
+class _InflightJob(object):
+    """One dispatched-but-unapplied job: the stamp the speculation and
+    exactly-once paths key on (the jobfarm's job-stamp logic, lifted).
+
+    ``owner`` is the slave the workflow RESERVED the work for
+    (``generate_data_for_slave``) — every copy's result applies under
+    the owner's reservation so loader bookkeeping stays consistent;
+    ``copies`` maps slave id -> dispatch stamp for the owner plus any
+    speculative backups (first result wins, the rest are duplicates)."""
+
+    __slots__ = ("job_id", "data", "owner", "copies")
+
+    def __init__(self, job_id, data, owner, t0):
+        self.job_id = job_id
+        self.data = data
+        self.owner = owner
+        self.copies = {owner.id: t0}
 
 
 class Server(Logger, metaclass=CommandLineArgumentsRegistry):
@@ -108,6 +158,16 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             "--blacklist-ttl", type=float, default=None,
             help="seconds a dropped/quarantined slave stays "
                  "blacklisted before it may rejoin")
+        parser.add_argument(
+            "--speculation-factor", type=float, default=None,
+            help="straggler bar: an in-flight job older than this "
+                 "factor x the mean job duration is shadowed on an "
+                 "idle slave (first result wins)")
+        parser.add_argument(
+            "--min-speculation-s", type=float, default=None,
+            help="absolute floor (seconds) under the speculation "
+                 "threshold, so millisecond-scale jobs don't "
+                 "speculate their whole tail")
         return parser
 
     @classmethod
@@ -121,11 +181,16 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             cfg["shm"] = False
         if getattr(args, "blacklist_ttl", None) is not None:
             cfg["blacklist_ttl"] = args.blacklist_ttl
+        if getattr(args, "speculation_factor", None) is not None:
+            cfg["speculation_factor"] = args.speculation_factor
+        if getattr(args, "min_speculation_s", None) is not None:
+            cfg["min_speculation_s"] = args.min_speculation_s
         root.common.network.update(cfg)
 
     def __init__(self, address, workflow, launcher=None, codec=None,
                  job_timeout=None, respawn_hook=None, secret=None,
-                 use_shm=None, shm_size=None, blacklist_ttl=None):
+                 use_shm=None, shm_size=None, blacklist_ttl=None,
+                 speculation_factor=None, min_speculation_s=None):
         super(Server, self).__init__()
         net = root.common.network
         self.host, self.port = parse_address(address)
@@ -163,6 +228,48 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         self.slaves = {}
         self._waiting = deque()     # parked requesters (sync points)
         self._all_job_times = deque(maxlen=500)
+        #: live-membership ledger: every join/leave bumps the
+        #: membership epoch and triggers a reshard push
+        self.fleet = elastic.FleetView()
+        #: dispatched-but-unapplied jobs (job_id -> _InflightJob); the
+        #: stamp speculation and the exactly-once duplicate drop key on.
+        #: Workflows that run their OWN backup-copy bookkeeping (the
+        #: jobfarm adapter dedups by result slot) set
+        #: ``owns_speculation = True`` and opt out of both.
+        self._inflight = {}
+        self._workflow_speculates = bool(
+            getattr(workflow, "owns_speculation", False))
+        self.speculation_factor = speculation_factor \
+            if speculation_factor is not None \
+            else net.get("speculation_factor", 2.0)
+        self.min_speculation_s = min_speculation_s \
+            if min_speculation_s is not None \
+            else net.get("min_speculation_s", 5.0)
+        #: speculation_factor=inf is the off-switch (the threshold is
+        #: infinite, nothing ever straggles past it); with it off the
+        #: job stamps skip caching payloads — the stamp stays (the
+        #: exactly-once duplicate/stale fences key on it) but the
+        #: master no longer retains every in-flight job's payload
+        self._speculation_on = math.isfinite(self.speculation_factor)
+        #: updates currently mid-apply on the executor, keyed by the
+        #: slave id the apply RETIRES A RESERVATION OF (the owner for
+        #: speculated jobs, the sender otherwise).  _drop defers the
+        #: requeue while that slave has an apply in flight — the
+        #: drop-vs-apply race: the same job must not requeue AND
+        #: apply.  Keying on the apply target (not the sender's conn)
+        #: also covers dropping a straggling OWNER while its backup's
+        #: winning update is mid-apply.
+        self._applying = {}
+        #: drops parked by _drop while an apply for that slave id is
+        #: in flight: slave id -> (conn, reason); the apply path
+        #: finishes them when the executor returns
+        self._deferred_drops = {}
+        # elastic-fleet accounting (mirrored into elastic.* metrics)
+        self.reshards = 0
+        self.speculated = 0
+        self.duplicates_dropped = 0
+        self.stale_updates = 0
+        self.drops_deferred = 0
         self._loop = None
         self._server = None
         self._finishing = False
@@ -314,6 +421,21 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             self._send(writer, {"type": "error",
                                 "reason": "handshake required"})
             return None
+        if conn.dropped:
+            # this membership is OVER: the slave's work was requeued at
+            # drop time, so frames still buffered on the old session
+            # must not act.  A late update is rejected as STALE (the
+            # exactly-once half of the elasticity contract) and any
+            # other traffic severs the conn — the slave reconnects and
+            # rejoins at a fresh membership epoch.
+            if mtype == "update":
+                self._reject_stale(conn, msg)
+            else:
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
+            return conn
         if mtype == "job_request":
             await self._serve_job(conn)
         elif mtype == "update":
@@ -420,12 +542,19 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                 self.exception("shm setup failed; staying on socket")
                 conn.close_shm()
         self.slaves[sid] = conn
+        # membership epoch: the join bumps it, the ack teaches the
+        # slave which epoch it was admitted at, and the reshard below
+        # republishes the unserved split over the grown fleet
+        conn.member_epoch = self.fleet.join(sid, slave.power)
+        ack["member_epoch"] = conn.member_epoch
         initial = await self._in_thread(
             self.workflow.generate_initial_data_for_slave, slave)
         self._send(writer, ack, payload=initial)
         if self._paused:
             self._send(writer, {"type": "pause"})
-        self.info("slave %s connected (mid %s)", sid[:8], mid)
+        self.info("slave %s connected (mid %s; membership epoch %d)",
+                  sid[:8], mid, conn.member_epoch)
+        self._reshard("join")
         return conn
 
     async def _serve_job(self, conn):
@@ -438,33 +567,77 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             conn.parked = True
             self._waiting.append(conn)
             return
-        data = await self._in_thread(
-            self.workflow.generate_data_for_slave, conn.slave)
-        if data is False:
-            # sync point: park until an update unlocks new work
+        if not self._workflow_speculates and (
+                self._applying.get(conn.slave.id) or any(
+                    len(job.copies) > 1
+                    and job.owner.id == conn.slave.id
+                    for job in self._inflight.values())):
+            # an async (pipelining) owner asking for MORE work while
+            # one of its jobs is speculated — or while a result is
+            # mid-apply under its id (the backup's winning copy, once
+            # it lands, applies under the OWNER's reservation): serving
+            # it would open a second reservation under this owner and
+            # the in-flight apply would retire the WRONG one (the
+            # loader pops reservations LIFO per slave).  Park until
+            # the speculated job resolves; both the apply and drop
+            # paths release parked requesters.
             conn.parked = True
             self._waiting.append(conn)
             self._send(conn.writer, {"type": "wait"})
             return
-        if chaos.plan is not None:
-            fault = chaos.plan.fire("server.serve")
-            if fault is not None:
-                if fault.action == "kill":
-                    # mid-batch conn death: the minibatch is already
-                    # reserved to this slave, so the drop path MUST
-                    # requeue it (watchdog/drop_slave contract)
-                    self.warning("fault injection: killing conn of "
-                                 "slave %s mid-batch",
-                                 conn.slave.id[:8])
-                    conn.writer.close()
+        # fence the guard until the dispatch is stamped: the
+        # reservation generate_data_for_slave creates is invisible to
+        # jobs_out until conn.jobs_out is updated below (the executor
+        # hop, and the chaos stall point, both yield the loop), and a
+        # peer speculating this owner's job in that window would cross
+        # the two reservations
+        conn.generating = True
+        try:
+            data = await self._in_thread(
+                self.workflow.generate_data_for_slave, conn.slave)
+            if data is False:
+                # nothing fresh: maybe shadow a straggler's in-flight
+                # job (the jobfarm's backup-copy move, lifted here —
+                # first result wins, the loser is dropped before
+                # validation)
+                conn.generating = False
+                if self._speculate(conn):
                     return
-                if fault.action == "stall":
-                    await asyncio.sleep(fault.param or 0.5)
-        job_id = new_id()
-        # perf_counter, not time.time: these stamps feed the adaptive
-        # timeout and the job-latency stats, and a wall-clock NTP step
-        # would fake a straggler (or hide one)
-        conn.jobs_out[job_id] = time.perf_counter()
+                # sync point: park until an update unlocks new work
+                conn.parked = True
+                self._waiting.append(conn)
+                self._send(conn.writer, {"type": "wait"})
+                return
+            if chaos.plan is not None:
+                fault = chaos.plan.fire("server.serve")
+                if fault is not None:
+                    if fault.action == "kill":
+                        # mid-batch conn death: the minibatch is
+                        # already reserved to this slave, so the drop
+                        # path MUST requeue it (watchdog/drop_slave
+                        # contract)
+                        self.warning("fault injection: killing conn "
+                                     "of slave %s mid-batch",
+                                     conn.slave.id[:8])
+                        conn.writer.close()
+                        return
+                    if fault.action == "stall":
+                        await asyncio.sleep(fault.param or 0.5)
+            job_id = new_id()
+            # perf_counter, not time.time: these stamps feed the
+            # adaptive timeout and the job-latency stats, and a
+            # wall-clock NTP step would fake a straggler (or hide one)
+            t0 = time.perf_counter()
+            conn.jobs_out[job_id] = t0
+            if not self._workflow_speculates:
+                # the job stamp: speculation re-serves this exact
+                # payload and the exactly-once drop rejects the losing
+                # duplicate
+                self._inflight[job_id] = _InflightJob(
+                    job_id, data if self._speculation_on else None,
+                    conn.slave, t0)
+        finally:
+            conn.generating = False
         self.jobs_dispatched += 1
         _registry.counter("server.jobs_dispatched").inc()
         _tracer.instant("proto.job_out", cat="proto",
@@ -472,6 +645,94 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                         trace=self.trace_id[:8])
         self._send(conn.writer, {"type": "job", "job_id": job_id},
                    payload=data, conn=conn)
+
+    def _speculate(self, conn):
+        """Try to shadow the oldest straggling in-flight job on the
+        idle requester ``conn``.  Returns True when a backup copy was
+        dispatched.  The bar is the power-corrected MapReduce backup
+        threshold (``elastic.speculation_threshold``); with no
+        completed durations there is no credible mean and nothing
+        speculates (immediate re-issue would duplicate every tail
+        job).  A job is only eligible while its owner has no OTHER
+        job outstanding: the loader contract pops reservations LIFO
+        per slave, so shadowing one of several pipelined jobs could
+        retire the wrong reservation."""
+        if self._workflow_speculates or not self._speculation_on \
+                or not self._all_job_times:
+            return False
+        mean = sum(self._all_job_times) / len(self._all_job_times)
+        mean_power = elastic.fleet_mean_power(self.fleet.powers())
+        now = time.perf_counter()
+        best, best_age = None, 0.0
+        for job in self._inflight.values():
+            if conn.slave.id in job.copies:
+                continue  # never a second copy on the same slave
+            owner_conn = self.slaves.get(job.owner.id)
+            if owner_conn is None:
+                # departed owner: its stamp is about to be deleted and
+                # its work requeued by the (possibly deferred) drop —
+                # a backup copy would be guaranteed duplicate work
+                continue
+            if owner_conn.generating or len(owner_conn.jobs_out) > 1:
+                continue
+            age = now - min(job.copies.values())
+            threshold = elastic.speculation_threshold(
+                mean, self.speculation_factor, self.min_speculation_s,
+                owner_power=job.owner.power, mean_power=mean_power)
+            if age > threshold and age > best_age:
+                best, best_age = job, age
+        if best is None:
+            return False
+        best.copies[conn.slave.id] = now
+        conn.jobs_out[best.job_id] = now
+        self.speculated += 1
+        # a backup copy is a dispatch like any other: count it and
+        # emit the proto.job_out instant so the merged cluster trace
+        # can pair the winner's proto.update_in with a dispatch event
+        self.jobs_dispatched += 1
+        _registry.counter("server.jobs_dispatched").inc()
+        _tracer.instant("proto.job_out", cat="proto",
+                        slave=conn.slave.id[:8], job=best.job_id[:8],
+                        trace=self.trace_id[:8])
+        _registry.counter("elastic.speculative_jobs").inc()
+        _registry.gauge("elastic.speculative_inflight").set(
+            self._speculative_inflight())
+        _tracer.instant("elastic.speculate", cat="elastic",
+                        job=best.job_id[:8], owner=best.owner.id[:8],
+                        backup=conn.slave.id[:8],
+                        age_s=round(best_age, 3))
+        self.info("speculating job %s of straggler %s on idle slave "
+                  "%s (%.2fs in flight)", best.job_id[:8],
+                  best.owner.id[:8], conn.slave.id[:8], best_age)
+        self._send(conn.writer, {"type": "job", "job_id": best.job_id},
+                   payload=best.data, conn=conn)
+        return True
+
+    def _speculative_inflight(self):
+        return sum(1 for job in self._inflight.values()
+                   if len(job.copies) > 1)
+
+    def _reject_stale(self, conn, msg):
+        """Reject an update from a DEPARTED member: its work was
+        requeued at drop time (membership epoch bumped past its
+        admission), so applying the late duplicate would double."""
+        job_id = str(msg.get("job_id") or "")[:8]
+        self.stale_updates += 1
+        _registry.counter("elastic.stale_updates").inc()
+        _tracer.instant("elastic.stale_update", cat="elastic",
+                        slave=conn.slave.id[:8], job=job_id,
+                        member_epoch=conn.member_epoch,
+                        fleet_epoch=self.fleet.membership_epoch)
+        self.warning(
+            "rejecting stale update (job %s) from departed slave %s: "
+            "admitted at membership epoch %d, fleet is at %d — its "
+            "work was requeued at drop time", job_id,
+            conn.slave.id[:8], conn.member_epoch,
+            self.fleet.membership_epoch)
+        try:
+            self._send(conn.writer, {"type": "update_ack", "result": 0})
+        except Exception:
+            pass
 
     async def _apply_update(self, conn, msg, payload):
         update = unpack_payload(payload, msg.get("codec", "none"))
@@ -481,6 +742,38 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             elapsed = time.perf_counter() - started
             conn.job_times.append(elapsed)
             self._all_job_times.append(elapsed)
+        # first result wins: pop the job stamp — a second copy of the
+        # same job (speculation loser, or a backup finishing after its
+        # owner was requeued) finds it gone and is dropped BEFORE
+        # validation or apply ever run
+        inflight = self._inflight.pop(job_id, None) \
+            if job_id is not None else None
+        if not self._workflow_speculates and job_id is not None \
+                and inflight is None:
+            self.duplicates_dropped += 1
+            _registry.counter("elastic.duplicates_dropped").inc()
+            _registry.gauge("elastic.speculative_inflight").set(
+                self._speculative_inflight())
+            _tracer.instant("elastic.duplicate_drop", cat="elastic",
+                            slave=conn.slave.id[:8],
+                            job=str(job_id)[:8])
+            self.info("dropping duplicate update for job %s from "
+                      "slave %s (another copy won)", str(job_id)[:8],
+                      conn.slave.id[:8])
+            self._send(conn.writer, {"type": "update_ack", "result": 0})
+            if not self._paused:
+                await self._release_parked()
+            return
+        # every copy's result applies under the OWNER's reservation:
+        # the loader keyed the minibatch to the slave it generated the
+        # job for, and a speculative winner must retire that exact
+        # reservation, not open a phantom one of its own
+        apply_slave = inflight.owner if inflight is not None \
+            else conn.slave
+        if inflight is not None and len(inflight.copies) > 1:
+            # a speculated job just resolved (this copy won)
+            _registry.gauge("elastic.speculative_inflight").set(
+                self._speculative_inflight())
         # numerics quarantine (docs/health.md): a NaN payload merged
         # into global state poisons every other slave's next job.
         # Validation + apply run in ONE executor hop; workflows whose
@@ -500,20 +793,61 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                     self.workflow, "update_validation",
                     "prewalk") == "inline":
                 try:
-                    return inline(update, conn.slave)
+                    return inline(update, apply_slave)
                 except health.PoisonedUpdate:
                     return Server._POISONED
             if not health.all_finite(update):
                 return Server._POISONED
             return self.workflow.apply_data_from_slave(
-                update, conn.slave)
+                update, apply_slave)
 
+        apply_sid = apply_slave.id
+        self._applying[apply_sid] = self._applying.get(apply_sid, 0) + 1
         try:
             result = await self._in_thread(check_and_apply)
         except Exception:
             self.exception("update application failed")
             self._send(conn.writer, {"type": "update_ack", "result": 0})
             result = Server._FAILED
+        finally:
+            left = self._applying.get(apply_sid, 1) - 1
+            if left:
+                self._applying[apply_sid] = left
+            else:
+                self._applying.pop(apply_sid, None)
+                deferred = self._deferred_drops.pop(apply_sid, None)
+                if deferred is not None:
+                    # the drop that raced this apply: now that the
+                    # update is fully applied (or failed), requeue
+                    # what is STILL outstanding — never the job that
+                    # just applied
+                    self._finish_drop(*deferred)
+        if result is Server._POISONED and inflight is not None \
+                and conn.slave.id != inflight.owner.id \
+                and len(inflight.copies) > 1 \
+                and inflight.owner.id in self.slaves:
+            # a poisoned SPECULATIVE backup must not lose the job: the
+            # owner's copy is still running, so reinstate the stamp
+            # (minus the poisoned sender) and let the owner's result
+            # apply normally.  NOT when the owner itself was dropped
+            # while this apply was in flight — its reservation was
+            # already requeued by the (deferred) drop, so reinstating
+            # would leave a phantom job racing the requeued minibatch
+            inflight.copies.pop(conn.slave.id, None)
+            self._inflight[inflight.job_id] = inflight
+        if result is Server._FAILED and inflight is not None \
+                and len(inflight.copies) > 1 \
+                and inflight.owner.id in self.slaves:
+            # a transient master-side apply failure must not orphan a
+            # SPECULATED job: the other copy is still running, so
+            # reinstate the stamp (minus the failed sender — owner or
+            # backup) and let the surviving copy's result apply under
+            # the owner's reservation instead of dropping as a
+            # duplicate — exactly-once in the applied-zero-times
+            # direction.  Same departed-owner exclusion as above.
+            inflight.copies.pop(conn.slave.id, None)
+            if inflight.copies:
+                self._inflight[inflight.job_id] = inflight
         if result is Server._POISONED:
             self.quarantined += 1
             _registry.counter("server.quarantined").inc()
@@ -552,7 +886,15 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             await self._release_parked()
 
     async def _release_parked(self):
-        while self._waiting and not self._paused:
+        # one attempt per parked conn per pass: _serve_job may RE-park
+        # the conn it was handed (speculation not yet eligible, or the
+        # owner guard), and an unbounded `while self._waiting` would
+        # pop the re-appended conn forever — a livelock that starves
+        # the event loop of every other conn's frames (including the
+        # very update whose apply would release the guard)
+        for _ in range(len(self._waiting)):
+            if self._paused:
+                break
             parked = self._waiting.popleft()
             if parked.slave.id in self.slaves and parked.parked:
                 parked.parked = False
@@ -610,12 +952,44 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
     def _drop(self, conn, reason):
         if self.slaves.pop(conn.slave.id, None) is None:
             return
+        conn.dropped = True
+        self.fleet.leave(conn.slave.id)
         conn.close_shm()
         self.info("dropping slave %s (%s)", conn.slave.id[:8], reason)
+        if self._applying.get(conn.slave.id):
+            # drop-vs-apply race: an update that retires one of THIS
+            # slave's reservations is mid-apply on the executor — its
+            # own update, or a speculative backup's winning result
+            # applying under this owner's reservation.  Requeueing now
+            # would hand the applying job to another slave while its
+            # update lands — the job both requeued AND applied.  Park
+            # the requeue; the apply path finishes the drop the moment
+            # the executor returns (stale rejection above already
+            # fences any FURTHER frames from this conn).
+            self.drops_deferred += 1
+            _registry.counter("elastic.drops_deferred").inc()
+            self.debug("deferring requeue for slave %s: an update is "
+                       "mid-apply", conn.slave.id[:8])
+            self._deferred_drops[conn.slave.id] = (conn, reason)
+            return
+        self._finish_drop(conn, reason)
+
+    def _finish_drop(self, conn, reason):
+        # retire this conn's job stamps: jobs it OWNED are requeued by
+        # drop_slave below, so a backup copy's late result must drop
+        # as a duplicate; jobs it merely backed keep the owner's copy
+        for job_id, job in list(self._inflight.items()):
+            job.copies.pop(conn.slave.id, None)
+            if job.owner.id == conn.slave.id:
+                del self._inflight[job_id]
+        _registry.gauge("elastic.speculative_inflight").set(
+            self._speculative_inflight())
         try:
             self.workflow.drop_slave(conn.slave)
         except Exception:
             self.exception("drop_slave failed")
+        if not self._finishing:
+            self._reshard("leave:" + reason)
         # the requeue may have freed work for parked requesters; with
         # passive clients nobody else would wake them until the next
         # update (which, with every other slave parked, never comes)
@@ -626,6 +1000,93 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             delay = self._respawn_delay(conn.slave.mid)
             self._loop.call_later(
                 delay, lambda: self.respawn_hook(conn.slave))
+
+    # -- dynamic resharding -------------------------------------------------
+
+    def _unserved_remainder(self):
+        """How many work units of the current epoch are not yet
+        APPLIED — the quantity a reshard repartitions.  Workflows may
+        expose ``unserved_remainder()`` (the Workflow/Loader contract
+        and the jobfarm adapter do); None = unknown, nothing to
+        partition."""
+        probe = getattr(self.workflow, "unserved_remainder", None)
+        if probe is None:
+            return None
+        try:
+            remaining = probe()
+        except Exception:
+            self.exception("unserved_remainder probe failed")
+            return None
+        return None if remaining is None else int(remaining)
+
+    def _reshard(self, reason):
+        """Membership changed: repartition the epoch's unserved
+        remainder over the live fleet (power-weighted, exact largest-
+        remainder split) and push each slave its new share, stamped
+        with the membership epoch, so the fleet learns the split
+        without restarting the run.
+
+        The push is scheduled, not inline: the remainder probe is
+        workflow code (the jobfarm adapter takes its master lock, user
+        workflows run arbitrary counting), and this module's contract
+        keeps workflow code off the event loop — a slow probe on every
+        membership change would stall every connection.  Epoch and
+        shares are read when the task runs, so back-to-back membership
+        changes push the (identical) final split — idempotent for the
+        absorbing client."""
+        asyncio.ensure_future(self._do_reshard(reason))
+
+    async def _do_reshard(self, reason):
+        if self._finishing:
+            return
+        remaining = await self._in_thread(self._unserved_remainder)
+        shares = self.fleet.shares(remaining)
+        epoch = self.fleet.membership_epoch
+        self.reshards += 1
+        _registry.counter("elastic.reshards").inc()
+        _registry.gauge("elastic.membership_epoch").set(epoch)
+        _registry.gauge("elastic.fleet_live").set(len(self.fleet))
+        _tracer.instant(
+            "elastic.resharded", cat="elastic", reason=reason,
+            epoch=epoch, fleet=len(self.fleet),
+            remaining=-1 if remaining is None else remaining)
+        self.info("resharded (%s): membership epoch %d, %d live, "
+                  "remainder %s -> %s", reason, epoch, len(self.fleet),
+                  remaining, {sid[:8]: n for sid, n in shares.items()}
+                  or "n/a")
+        for sid, member in list(self.slaves.items()):
+            if chaos.plan is not None:
+                fault = chaos.plan.fire("server.reshard")
+                if fault is not None and fault.action == "kill":
+                    # a slave vanishing DURING the reshard push: the
+                    # kill-during-reshard case the exactly-once
+                    # guarantee must survive (its work requeues, its
+                    # late update is stale)
+                    self.warning("fault injection: killing conn of "
+                                 "slave %s mid-reshard", sid[:8])
+                    try:
+                        member.writer.close()
+                    except Exception:
+                        pass
+                    continue
+            member.share = shares.get(sid)
+            msg = {"type": "reshard", "epoch": epoch,
+                   "fleet": len(self.fleet)}
+            if member.share is not None:
+                msg["share"] = member.share
+            if remaining is not None:
+                msg["remaining"] = remaining
+            try:
+                self._send(member.writer, msg)
+            except Exception:
+                pass
+        hook = getattr(self.launcher, "on_fleet_change", None)
+        if hook is not None:
+            try:
+                hook({"reason": reason, "epoch": epoch,
+                      "live": len(self.fleet), "remaining": remaining})
+            except Exception:
+                self.exception("on_fleet_change hook failed")
 
     def _broadcast(self, msg):
         for conn in list(self.slaves.values()):

@@ -393,21 +393,22 @@ class WebStatusServer(Logger):
                 value = s.get(k)
                 if value is None:
                     return ""
-                if k in ("metrics", "health", "serve"):
+                if k in ("metrics", "health", "serve", "fleet"):
                     return json.dumps(value)
                 return str(value)
             cells = "".join(
                 "<td>%s</td>" % html.escape(cell(k))
                 for k in ("workflow", "mode", "epoch", "metrics",
-                          "health", "serve", "slaves", "updated"))
+                          "health", "serve", "fleet", "slaves",
+                          "updated"))
             rows.append(
                 "<tr><td><a href='/session/%s'>%s</a></td>%s<td>%s</td>"
                 "</tr>" % (quote(sid, safe=""),
                            html.escape(sid), cells, spark))
         return ("<table><tr><th>id</th><th>workflow</th><th>mode</th>"
                 "<th>epoch</th><th>metrics</th><th>health</th>"
-                "<th>serve</th><th>slaves</th><th>updated</th>"
-                "<th>trend</th></tr>"
+                "<th>serve</th><th>fleet</th><th>slaves</th>"
+                "<th>updated</th><th>trend</th></tr>"
                 "%s</table>"
                 % "\n".join(rows))
 
@@ -439,6 +440,7 @@ class StatusReporter(object):
         self.workflow = workflow
 
     def snapshot(self):
+        from veles_tpu.elastic import fleet_snapshot
         from veles_tpu.observe.metrics import health_snapshot
         from veles_tpu.observe.metrics import registry as _registry
         from veles_tpu.serve.batcher import serve_snapshot
@@ -471,6 +473,11 @@ class StatusReporter(object):
             # violations, latency percentiles — populated only on
             # processes that run the serve subsystem
             "serve": serve_snapshot() or None,
+            # elastic-fleet state (docs/distributed.md, "Elasticity
+            # contract"): membership epoch, live/blacklisted/
+            # quarantined counts, speculative jobs in flight — only on
+            # masters (the server publishes the elastic.* gauges)
+            "fleet": fleet_snapshot() or None,
         }
 
     def _post_json(self, path, payload):

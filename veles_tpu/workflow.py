@@ -380,6 +380,30 @@ class Workflow(Unit):
         for unit in self._distributed_units():
             unit.drop_slave(slave)
 
+    def unserved_remainder(self):
+        """Elastic resharding input (Server._reshard): how much of the
+        current epoch's sample space is not yet applied.  Delegates to
+        the first unit exposing the probe (the loader owns the
+        class-window accounting); None = unknown."""
+        for unit in self._distributed_units():
+            probe = getattr(unit, "unserved_remainder", None)
+            if probe is not None:
+                return probe()
+        return None
+
+    def apply_reshard(self, info):
+        """Slave-side reshard hook (docs/distributed.md, "Elasticity
+        contract"): the master repartitioned the epoch's unserved
+        remainder after a membership change.  Record the fleet view
+        and forward to every unit that wants the hint (the loader
+        keeps it next to its window bookkeeping).  Advisory: job
+        payloads remain the authoritative work assignment."""
+        self.fleet_info_ = dict(info)
+        for unit in self._distributed_units():
+            hook = getattr(unit, "apply_reshard", None)
+            if hook is not None:
+                hook(info)
+
     def _distributed_units(self):
         return [u for u in self.units_in_dependency_order if u is not self]
 
