@@ -1095,6 +1095,61 @@ def bench_serve_ab(small):
                       clients=8 if small else 32)
     finally:
         batcher.stop()
+
+    # transport A/B (docs/serving.md): the SAME engine behind the two
+    # wire fronts — tornado+json text vs binary tensor frames (with
+    # the same-host shm payload bypass).  The delta is pure transport;
+    # it feeds the BENCH_serve.json regeneration story.
+    import http.client as _http_client
+
+    from veles_tpu.serve import BinaryTransportClient, ServeService
+
+    svc = ServeService(engine, max_delay_s=0.002, transport_port=0)
+    svc.start_background()
+    local = _threading.local()
+    created, created_lock = [], _threading.Lock()
+
+    def json_one(sample):
+        conn = getattr(local, "conn", None)
+        if conn is None:
+            conn = local.conn = _http_client.HTTPConnection(
+                "127.0.0.1", svc.port, timeout=30)
+            with created_lock:
+                created.append(conn)
+        conn.request(
+            "POST", "/infer",
+            body=json.dumps({"input": sample.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+
+    def binary_one(sample):
+        cli = getattr(local, "cli", None)
+        if cli is None:
+            cli = local.cli = BinaryTransportClient(
+                port=svc.transport_port)
+            with created_lock:
+                created.append(cli)
+        cli.infer(sample)
+
+    try:
+        wire_clients = 4 if small else 8
+        json_row = leg(json_one, clients=wire_clients)
+        binary_row = leg(binary_one, clients=wire_clients)
+    finally:
+        for peer in created:
+            peer.close()
+        svc.stop()
+    transport_ab = {
+        "clients": wire_clients,
+        "json": json_row,
+        "binary": binary_row,
+        "binary_vs_json_rps_x": round(
+            binary_row["requests_per_sec"]
+            / max(json_row["requests_per_sec"], 1e-9), 2),
+        "json_minus_binary_p50_ms": round(
+            json_row["p50"] - binary_row["p50"], 3),
+    }
     return {
         "compile_receipt": receipt,
         "sequential": sequential,       # p50/p95/p99 in ms
@@ -1102,6 +1157,7 @@ def bench_serve_ab(small):
         "throughput_x": round(
             batched["requests_per_sec"]
             / max(sequential["requests_per_sec"], 1e-9), 2),
+        "transport_ab": transport_ab,
     }
 
 

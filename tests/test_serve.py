@@ -350,6 +350,29 @@ def test_service_answers_503_on_shed(engine):
         chaos.uninstall()
 
 
+def test_format_result_vectorized_contract():
+    """format_result keeps the REST contract after the per-batch
+    vectorization: one tolist over the whole (viewed, never re-copied)
+    block, scalar result for single-row payloads, mapped labels when a
+    mapping exists and plain ints (vectorized box) when not."""
+    from veles_tpu.serve import format_result
+
+    probs = numpy.array([[0.1, 0.9], [0.8, 0.2]], numpy.float32)
+    out = format_result(probs, {0: "a", 1: "b"})
+    assert out["result"] == ["b", "a"]
+    assert out["probabilities"] == probs.tolist()
+    unmapped = format_result(probs)
+    assert unmapped["result"] == [1, 0]
+    assert all(isinstance(label, int) for label in unmapped["result"])
+    single = format_result(probs[0])
+    assert single["result"] == 1
+    assert single["probabilities"] == [probs[0].tolist()]
+    one_row = format_result(probs[:1], {0: "a", 1: "b"})
+    assert one_row["result"] == "b"
+    # list payloads (the RESTful compat front) still work
+    assert format_result(probs.tolist())["result"] == [1, 0]
+
+
 def test_restful_api_delegates_to_engine():
     """The compatibility unit serves the old contract through the AOT
     engine: programmatic infer() without a started server uses the

@@ -1,0 +1,316 @@
+"""Serve binary-transport tests (docs/serving.md wire format):
+tensor-codec safety (no pickle, hostile headers refused), framed
+round-trips over in-process socketpair duplex streams (no real port
+binds — the `transport` marker contract), the same-host ShmChannel
+payload bypass with stale-channel fallback, HMAC rejection, the
+overload protocol over the wire, and the batcher's zero-staging block
+fast path the transport feeds."""
+
+import socket
+
+import numpy
+import pytest
+
+from veles_tpu import chaos
+from veles_tpu.backends import Device
+from veles_tpu.network_common import ProtocolError
+from veles_tpu.observe.metrics import registry
+from veles_tpu.serve import (
+    AOTEngine, BinaryTransportClient, BinaryTransportServer,
+    ContinuousBatcher, ServeOverload, decode_tensor, encode_tensor)
+from tests.test_serve import _mlp_spec
+
+pytestmark = [pytest.mark.serve, pytest.mark.transport]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    plans, params = _mlp_spec(seed=3)
+    eng = AOTEngine(plans, params, (16,), ladder=(8, 32),
+                    device=Device(backend="cpu"))
+    eng.compile()
+    return eng
+
+
+@pytest.fixture
+def served(engine):
+    """Started batcher + transport server + socketpair client factory
+    (tier-1 never binds a TCP port: ``port=None`` + serve_socket)."""
+    batcher = ContinuousBatcher(engine, max_delay_s=0.002).start()
+    server = BinaryTransportServer(batcher, port=None)
+    server.start_background()
+    clients = []
+
+    def connect(**kwargs):
+        ours, theirs = socket.socketpair()
+        server.serve_socket(ours)
+        cli = BinaryTransportClient(sock=theirs, **kwargs)
+        clients.append(cli)
+        return cli
+
+    yield engine, batcher, server, connect
+    for cli in clients:
+        cli.close()
+    server.stop()
+    batcher.stop()
+
+
+# -- tensor codec ------------------------------------------------------------
+
+
+def test_tensor_codec_roundtrip_bit_exact():
+    rng = numpy.random.RandomState(0)
+    arrays = (
+        rng.rand(4, 7).astype(numpy.float32),
+        rng.rand(2, 3, 4),                      # float64
+        rng.randint(-5, 90, (3, 2)).astype(numpy.int64),
+        (rng.rand(8) > 0.5),                    # bool
+        numpy.arange(6, dtype=numpy.uint8).reshape(2, 3),
+    )
+    for arr in arrays:
+        for codec in ("none", "gzip"):
+            meta, raw = encode_tensor(arr, codec)
+            out = decode_tensor(meta, raw)
+            assert out.dtype == arr.dtype
+            assert out.shape == arr.shape
+            assert (out == arr).all()
+    # the "none" decode is ZERO-COPY: a view over the received bytes
+    meta, raw = encode_tensor(rng.rand(4, 4).astype(numpy.float32))
+    out = decode_tensor(meta, raw)
+    assert not out.flags["OWNDATA"]
+
+
+def test_tensor_codec_refuses_hostile_frames():
+    """The serve port never unpickles: object dtypes are refused on
+    both ends, and malformed headers (negative/oversized shapes,
+    length mismatches, unknown codecs) raise ProtocolError before any
+    attacker-sized allocation."""
+    with pytest.raises(ValueError):
+        encode_tensor(numpy.array([object()], dtype=object))
+    _, raw = encode_tensor(numpy.zeros(4, numpy.float32))
+    hostile = (
+        {"dtype": "|O", "shape": [1], "codec": "none"},
+        {"dtype": "V8", "shape": [1], "codec": "none"},
+        {"dtype": "nope", "shape": [4], "codec": "none"},
+        {"dtype": "<f4", "shape": [-1], "codec": "none"},
+        {"dtype": "<f4", "shape": [1 << 40], "codec": "none"},
+        {"dtype": "<f4", "shape": [3], "codec": "none"},  # len mismatch
+        {"dtype": "<f4", "shape": [4], "codec": "evil"},
+        {"shape": [4], "codec": "none"},                  # no dtype
+    )
+    for meta in hostile:
+        with pytest.raises(ProtocolError):
+            decode_tensor(meta, raw)
+
+
+# -- framed round-trips ------------------------------------------------------
+
+
+def test_binary_roundtrip_inline(served):
+    """A batch and a single sample over the socket (shm off) come back
+    bit-identical to the in-process engine; byte counters show the
+    payloads actually rode the socket."""
+    engine, _, _, connect = served
+    cli = connect(shm=False)
+    assert cli.server_digest == engine.digest
+    assert cli.sample_shape == (16,)
+    rng = numpy.random.RandomState(1)
+    x = rng.rand(5, 16).astype(numpy.float32)
+    ref = engine.infer(x)
+    out = cli.infer(x)
+    assert out.dtype == ref.dtype
+    assert (out == ref).all()
+    one = cli.infer(x[0])
+    assert one.shape == (1, 4)
+    assert (one[0] == ref[0]).all()
+    assert cli.socket_tx_bytes == x.nbytes + x[0].nbytes
+    assert cli.socket_rx_bytes == ref.nbytes + ref[0:1].nbytes
+    assert cli.shm_tx_bytes == 0 and cli.shm_rx_bytes == 0
+    assert cli.ping()
+
+
+def test_binary_overflow_batch_chunks_through_ladder(served):
+    """A block wider than the top rung (70 rows on the 8/32 ladder)
+    chunks server-side and still matches the sequential reference."""
+    engine, _, _, connect = served
+    cli = connect(shm=False)
+    rng = numpy.random.RandomState(9)
+    x = rng.rand(70, 16).astype(numpy.float32)
+    ref = engine.infer(x)
+    out = cli.infer(x)
+    assert out.shape == ref.shape
+    assert (out == ref).all()
+
+
+def test_shm_bypass_and_stale_fallback(served):
+    """Same-host payload bytes ride shared memory — the socket-byte
+    counters prove the bypass — and a stale/closed segment falls back
+    to inline payloads instead of failing the request."""
+    engine, _, _, connect = served
+    sock_rx_before = registry.counter(
+        "serve.transport.socket_rx_bytes").value
+    shm_rx_before = registry.counter(
+        "serve.transport.shm_rx_bytes").value
+    cli = connect(shm=True)
+    assert cli.shm_active
+    rng = numpy.random.RandomState(2)
+    x = rng.rand(6, 16).astype(numpy.float32)
+    ref = engine.infer(x)
+    out = cli.infer(x)
+    assert (out == ref).all()
+    # payload bytes took the shm road; zero payload bytes on the socket
+    assert cli.shm_tx_bytes == x.nbytes
+    assert cli.socket_tx_bytes == 0
+    assert cli.shm_rx_bytes > 0
+    assert cli.socket_rx_bytes == 0
+    # the server-side read-path counters agree
+    assert registry.counter(
+        "serve.transport.shm_rx_bytes").value - shm_rx_before == x.nbytes
+    assert registry.counter(
+        "serve.transport.socket_rx_bytes").value == sock_rx_before
+    # kill the client->server segment under the client: the next infer
+    # falls back to the socket, serves correctly, and drops the channel
+    cli._chan_out.close()
+    out2 = cli.infer(x)
+    assert (out2 == ref).all()
+    assert cli._chan_out is None
+    assert cli.socket_tx_bytes == x.nbytes
+
+
+def test_oversized_shm_segment_refused_downgrades_to_inline(served):
+    """The server attaches only client-created segments bounded by the
+    frame ceiling; a client offering an oversized one is downgraded to
+    inline payloads at HANDSHAKE time (shm_ok=False acked back) and
+    still serves correctly — the server never commits to a road it
+    refused."""
+    engine, _, _, connect = served
+    cli = connect(shm=True, shm_slot_mb=80.0)  # > MAX_FRAME_BYTES slot
+    assert not cli.shm_active
+    x = numpy.random.RandomState(7).rand(4, 16).astype(numpy.float32)
+    out = cli.infer(x)
+    assert (out == engine.infer(x)).all()
+    assert cli.socket_tx_bytes == x.nbytes  # inline road
+    assert cli.shm_tx_bytes == 0
+
+
+def test_hostile_length_prefix_drops_connection(served):
+    """A length prefix past the serve port's 64 MiB frame ceiling (but
+    under the control plane's 1 GiB one) kills the connection at the
+    prefix — the reader must never park buffering bytes that will
+    never arrive — and the server keeps serving its other clients."""
+    import struct
+
+    _, _, server, connect = served
+    healthy = connect(shm=False)
+    ours, theirs = socket.socketpair()
+    server.serve_socket(ours)
+    theirs.settimeout(5.0)
+    theirs.sendall(struct.pack("!IIB", 1 << 29, 1 << 29, 32))
+    assert theirs.recv(64) == b""  # dropped, no reply, no parking
+    theirs.close()
+    out = healthy.infer(numpy.zeros(16, numpy.float32))
+    assert out.shape == (1, 4)
+
+
+def test_hmac_rejects_wrong_secret(engine):
+    batcher = ContinuousBatcher(engine, max_delay_s=0.001).start()
+    server = BinaryTransportServer(batcher, port=None, secret=b"sesame")
+    server.start_background()
+    try:
+        ours, theirs = socket.socketpair()
+        server.serve_socket(ours)
+        cli = BinaryTransportClient(sock=theirs, secret=b"sesame",
+                                    shm=False)
+        out = cli.infer(numpy.zeros(16, numpy.float32))
+        assert out.shape == (1, 4)
+        cli.close()
+        # wrong secret: the server rejects the hello BEFORE parsing it
+        # and drops the connection — the client never gets a reply
+        ours2, theirs2 = socket.socketpair()
+        server.serve_socket(ours2)
+        with pytest.raises((ProtocolError, ConnectionError, OSError)):
+            BinaryTransportClient(sock=theirs2, secret=b"wrong",
+                                  shm=False, timeout=5.0)
+        theirs2.close()
+    finally:
+        server.stop()
+        batcher.stop()
+
+
+@pytest.mark.chaos
+def test_transport_overload_is_transient(served):
+    """A shed request crosses the wire as the transient error frame
+    and resurfaces client-side as ServeOverload with retry_after —
+    the 503 protocol, minus the HTTP."""
+    _, _, _, connect = served
+    cli = connect(shm=False)
+    chaos.install(chaos.FaultPlan(seed=1).add("serve.drop", "drop",
+                                              nth=1))
+    try:
+        with pytest.raises(ServeOverload) as info:
+            cli.infer(numpy.zeros(16, numpy.float32))
+        assert info.value.retry_after > 0
+        # only the first dispatch was armed; the connection survives
+        out = cli.infer(numpy.zeros(16, numpy.float32))
+        assert out.shape == (1, 4)
+    finally:
+        chaos.uninstall()
+
+
+# -- the zero-copy block path the transport feeds ----------------------------
+
+
+def test_submit_block_skips_staging(engine):
+    """A rung-exact contiguous block dispatches without ever touching
+    the ping-pong staging buffers (Device.put gets the caller's buffer
+    — the XLA:CPU-hazard-safe copy); a non-aligned block falls back to
+    a vectorized staging fill.  Both bit-match the sequential path."""
+    batcher = ContinuousBatcher(engine, max_delay_s=0.0).start()
+    try:
+        rng = numpy.random.RandomState(4)
+        x = numpy.ascontiguousarray(
+            rng.rand(8, 16).astype(numpy.float32))
+        ref = engine.infer(x)
+        req = batcher.submit_block(x)
+        assert req.done.wait(10)
+        assert req.error is None
+        assert (req.result == ref).all()
+        assert 8 not in batcher._stage, \
+            "rung-exact block went through staging"
+        req2 = batcher.submit_block(numpy.ascontiguousarray(x[:5]))
+        assert req2.done.wait(10) and req2.error is None
+        assert (req2.result == ref[:5]).all()
+        assert 8 in batcher._stage  # padded tail staged normally
+        with pytest.raises(ValueError):
+            batcher.submit_block(rng.rand(33, 16).astype(numpy.float32))
+        with pytest.raises(ValueError):
+            batcher.submit_block(rng.rand(4, 7).astype(numpy.float32))
+    finally:
+        batcher.stop()
+
+
+def test_blocks_cobatch_with_rows_bit_exact(engine):
+    """Blocks and single rows inside one collect window share a rung
+    and every result matches the sequential reference."""
+    rng = numpy.random.RandomState(5)
+    x = rng.rand(7, 16).astype(numpy.float32)
+    ref = engine.infer(x)
+    hist = registry.histogram("serve.batch_size")
+    hist.reset()
+    batcher = ContinuousBatcher(engine, max_delay_s=0.5).start()
+    try:
+        reqs = [batcher.submit_block(numpy.ascontiguousarray(x[:3])),
+                batcher.submit(x[3]),
+                batcher.submit(x[4]),
+                batcher.submit_block(numpy.ascontiguousarray(x[5:7]))]
+        for req in reqs:
+            assert req.done.wait(10)
+            assert req.error is None, req.error
+        assert (reqs[0].result == ref[:3]).all()
+        assert (reqs[1].result == ref[3]).all()
+        assert (reqs[2].result == ref[4]).all()
+        assert (reqs[3].result == ref[5:7]).all()
+        # proven on a co-batched dispatch, not four singleton batches
+        assert max(hist.window_values()) >= 7
+    finally:
+        batcher.stop()

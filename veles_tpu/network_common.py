@@ -49,6 +49,7 @@ except ImportError:
     _snappy = None
 
 __all__ = ["pack_payload", "unpack_payload", "read_frame", "write_frame",
+           "pack_frame", "read_frame_sync", "get_codec",
            "parse_address", "new_id", "default_secret", "ProtocolError",
            "encode_payload", "decode_payload", "available_codecs",
            "ShmChannel", "machine_id"]
@@ -87,6 +88,19 @@ def available_codecs():
     return tuple(_COMPRESS)
 
 
+def get_codec(name):
+    """``(compress, decompress)`` pair for a codec name.
+
+    Public so payload layers that are NOT pickle — the serve binary
+    transport's tensor codec (veles_tpu/serve/transport.py) — can ride
+    the same compression table without touching pack/unpack_payload's
+    pickling."""
+    try:
+        return _COMPRESS[name]
+    except KeyError:
+        raise ValueError("unknown codec %r" % name)
+
+
 def pack_payload(obj, codec="none"):
     try:
         compress = _COMPRESS[codec][0]
@@ -122,13 +136,44 @@ def _fire_net_fault(point, peer):
     return fault
 
 
-def write_frame(writer, msg, payload=b"", secret=None, peer=None):
-    """Serialize one frame onto an asyncio StreamWriter."""
+def pack_frame(msg, payload=b"", secret=None):
+    """Serialize one frame to bytes: ``!IIB`` prefix + JSON header +
+    raw payload + optional HMAC-SHA256 over header||payload.  The one
+    encoder behind both the asyncio writer (:func:`write_frame`) and
+    synchronous socket senders (the serve binary transport)."""
     header = json.dumps(msg).encode()
     mac = (hmac.new(secret, header + payload, hashlib.sha256).digest()
            if secret else b"")
-    frame = _FRAME.pack(len(header), len(payload), len(mac)) + \
+    return _FRAME.pack(len(header), len(payload), len(mac)) + \
         header + payload + mac
+
+
+def _check_lengths(hlen, plen, mlen, max_len=None):
+    ceiling = _MAX_LEN if max_len is None else int(max_len)
+    if hlen > ceiling or plen > ceiling or mlen > _MAC_LEN:
+        raise ProtocolError("oversized frame (%d/%d/%d)" %
+                            (hlen, plen, mlen))
+
+
+def _finish_frame(header, payload, mac, secret):
+    """Shared tail of the async and sync frame readers: MAC
+    verification BEFORE the header is even parsed, then the JSON
+    decode with protocol-violation (not crash) semantics."""
+    if secret is not None:
+        want = hmac.new(secret, header + payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(want, mac):
+            raise ProtocolError("frame authentication failed")
+    try:
+        return json.loads(header.decode()), payload
+    except (UnicodeDecodeError, ValueError) as exc:
+        # a mangled header is a protocol violation, not a crash: the
+        # caller's ProtocolError handling (drop + reconnect) applies
+        raise ProtocolError("malformed frame header (%s)" % exc)
+
+
+def write_frame(writer, msg, payload=b"", secret=None, peer=None):
+    """Serialize one frame onto an asyncio StreamWriter."""
+    frame = pack_frame(msg, payload, secret)
     if chaos.plan is not None:
         fault = _fire_net_fault("net.send", peer)
         if fault is not None:
@@ -160,19 +205,21 @@ def _apply_send_fault(fault, frame, writer):
     return frame
 
 
-async def read_frame(reader, secret=None, peer=None):
+async def read_frame(reader, secret=None, peer=None, max_len=None):
     """Read one frame -> (msg dict, payload bytes).
 
     When ``secret`` is set the MAC is verified before the header is
     even parsed; a missing or wrong MAC raises ProtocolError.  With a
     shared secret this also rejects chaos-corrupted frames BEFORE any
     unpickling; without one, only header corruption is caught here.
+    ``max_len`` tightens the default 1 GiB length ceiling — a hostile
+    length prefix must fail HERE, not park the connection buffering
+    bytes that never come (the serve transport bounds frames to what a
+    tensor can legitimately need).
     """
     prefix = await reader.readexactly(_FRAME.size)
     hlen, plen, mlen = _FRAME.unpack(prefix)
-    if hlen > _MAX_LEN or plen > _MAX_LEN or mlen > _MAC_LEN:
-        raise ProtocolError("oversized frame (%d/%d/%d)" %
-                            (hlen, plen, mlen))
+    _check_lengths(hlen, plen, mlen, max_len)
     header = await reader.readexactly(hlen)
     payload = await reader.readexactly(plen) if plen else b""
     mac = await reader.readexactly(mlen) if mlen else b""
@@ -186,16 +233,25 @@ async def read_frame(reader, secret=None, peer=None):
                     payload = _flip_byte(payload)
                 else:
                     header = _flip_byte(header)
-    if secret is not None:
-        want = hmac.new(secret, header + payload, hashlib.sha256).digest()
-        if not hmac.compare_digest(want, mac):
-            raise ProtocolError("frame authentication failed")
-    try:
-        return json.loads(header.decode()), payload
-    except (UnicodeDecodeError, ValueError) as exc:
-        # a mangled header is a protocol violation, not a crash: the
-        # caller's ProtocolError handling (drop + reconnect) applies
-        raise ProtocolError("malformed frame header (%s)" % exc)
+    return _finish_frame(header, payload, mac, secret)
+
+
+def read_frame_sync(recv_exactly, secret=None, max_len=None):
+    """Synchronous :func:`read_frame` twin for blocking-socket clients
+    (the serve binary transport's closed-loop client keeps one thread
+    per connection, where an event loop would be pure overhead).
+
+    ``recv_exactly(n)`` must return exactly ``n`` bytes or raise.  Same
+    length bounds (``max_len`` tightening included), MAC-before-parse
+    order and ProtocolError semantics as the asyncio reader; no chaos
+    hooks — client-side fault injection rides the server's async half.
+    """
+    hlen, plen, mlen = _FRAME.unpack(recv_exactly(_FRAME.size))
+    _check_lengths(hlen, plen, mlen, max_len)
+    header = recv_exactly(hlen)
+    payload = recv_exactly(plen) if plen else b""
+    mac = recv_exactly(mlen) if mlen else b""
+    return _finish_frame(header, payload, mac, secret)
 
 
 def parse_address(address, default_host="127.0.0.1"):

@@ -205,6 +205,13 @@ _HEALTH_KEYS = (
     ("elastic.membership_epoch", "membership_epoch"),
     ("elastic.fleet_live", "fleet_live"),
     ("elastic.speculative_inflight", "speculative_inflight"),
+    # multi-replica serving (veles_tpu/serve/router.py): replica count,
+    # aggregate queue depth and hot-reload count ride heartbeats so a
+    # post-mortem can line up latency cliffs against reloads/cascades;
+    # the full per-replica block is serve_snapshot() on the dashboard
+    ("serve.replicas", "serve_replicas"),
+    ("serve.queue_depth", "serve_queue_depth"),
+    ("serve.reloads", "serve_reloads"),
     # XLA introspection (observe/xla_introspect.py): live achieved-MFU
     # and compile accounting ride the same health surface
     ("xla.mfu_pct", "mfu_pct"),

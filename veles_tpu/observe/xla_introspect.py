@@ -37,7 +37,8 @@ from veles_tpu.observe.metrics import registry as _registry
 __all__ = ["CompileWatcher", "watcher", "ensure_installed", "watch",
            "poll_recompiles", "device_memory_gauges", "set_step_flops",
            "set_fwd_flops", "peak_flops", "mfu_snapshot",
-           "bwd_snapshot", "compile_snapshot", "PEAK_BF16_TFLOPS"]
+           "bwd_snapshot", "compile_snapshot", "compile_delta",
+           "PEAK_BF16_TFLOPS"]
 
 #: bf16 MXU peak TFLOP/s by device-kind substring (public spec sheets);
 #: bench.py shares this table for its offline MFU context.
@@ -196,6 +197,39 @@ def compile_snapshot(reg=None):
         metric = reg.peek(name)
         out[key] = cast(metric.value) if metric is not None else cast(0)
     return out
+
+
+class compile_delta(object):
+    """Context manager measuring backend-compile activity inside the
+    block: ``with compile_delta() as d: ...`` then ``d.receipt`` is
+    ``{"backend_compiles", "cache_hits", "new_compiles"}``.
+
+    The decomposition mirrors the serve engine's warm-restart receipt:
+    jax's monitoring event fires even on a persistent-cache hit, so
+    ``new_compiles = requests - hits`` is what XLA actually built.
+    Shared by ``AOTEngine.compile``, the serve hot-reload receipt (a
+    same-digest reload must report 0) and the tests that assert it.
+    """
+
+    def __init__(self, reg=None):
+        self._reg = reg
+        self.receipt = None
+
+    def __enter__(self):
+        ensure_installed()
+        self._before = compile_snapshot(self._reg)
+        return self
+
+    def __exit__(self, *exc_info):
+        after = compile_snapshot(self._reg)
+        requests = after["count"] - self._before["count"]
+        hits = after["cache_hits"] - self._before["cache_hits"]
+        self.receipt = {
+            "backend_compiles": requests,
+            "cache_hits": hits,
+            "new_compiles": max(0, requests - hits),
+        }
+        return False
 
 
 # -- device memory -----------------------------------------------------------

@@ -14,9 +14,19 @@ inference runtime — rebuilt TPU-idiomatically in three layers:
   a bounded queue-delay, ping-pong host staging (the PR 1 machinery),
   load shedding (``ServeOverload`` -> HTTP 503 + retry_after) and
   p50/p99 latency SLO tripwires;
+- :mod:`veles_tpu.serve.router` — :class:`ReplicaPool`: one
+  engine+batcher replica per visible device behind a least-loaded
+  router with overload cascade, shared persistent compile cache (warm
+  fleet start = one compile set), and snapshot hot-reload (same digest
+  = zero-recompile buffer swap; new digest = background AOT warm-up +
+  atomic cutover, queue never dropped);
+- :mod:`veles_tpu.serve.transport` — the binary frame listener beside
+  the JSON front: ``network_common``'s ``!IIB`` framing + HMAC with a
+  fixed dtype/shape/raw-bytes tensor codec (the serve port never
+  unpickles) and a same-host :class:`ShmChannel` payload bypass;
 - :mod:`veles_tpu.serve.service` — :class:`ServeService`: the tornado
-  front (``/infer``, ``/healthz``, ``/metrics.json``), async handlers
-  so concurrent clients actually co-batch.
+  front (``/infer``, ``/healthz``, ``/metrics.json``, ``/reload``),
+  async handlers so concurrent clients actually co-batch.
 
 ``python -m veles_tpu.serve --snapshot model.pickle`` serves a trained
 snapshot; ``scripts/serve_load.py`` is the closed-loop load generator
@@ -27,9 +37,18 @@ from veles_tpu.serve.batcher import (  # noqa: F401
     ContinuousBatcher, ServeOverload, serve_snapshot)
 from veles_tpu.serve.engine import (  # noqa: F401
     AOTEngine, DEFAULT_LADDER, enable_persistent_cache, model_digest)
+from veles_tpu.serve.router import (  # noqa: F401
+    Replica, ReplicaPool, local_devices)
 from veles_tpu.serve.service import (  # noqa: F401
     ServeService, format_result)
+from veles_tpu.serve.transport import (  # noqa: F401
+    BinaryTransportClient, BinaryTransportServer, decode_tensor,
+    encode_tensor)
 
-__all__ = ["AOTEngine", "ContinuousBatcher", "ServeOverload",
-           "ServeService", "DEFAULT_LADDER", "enable_persistent_cache",
-           "format_result", "model_digest", "serve_snapshot"]
+__all__ = ["AOTEngine", "BinaryTransportClient",
+           "BinaryTransportServer", "ContinuousBatcher",
+           "Replica", "ReplicaPool", "ServeOverload",
+           "ServeService", "DEFAULT_LADDER", "decode_tensor",
+           "enable_persistent_cache", "encode_tensor",
+           "format_result", "local_devices", "model_digest",
+           "serve_snapshot"]

@@ -1,20 +1,31 @@
 """``python -m veles_tpu.serve`` — stand up the inference service.
 
 Serves a trained workflow snapshot (the crash-consistent pickles
-``snapshotter.py`` writes) behind the AOT engine + continuous batcher,
-with the persistent compilation cache ON by default so a restart of
-this process performs zero new backend compiles:
+``snapshotter.py`` writes) behind one AOT engine + continuous batcher
+REPLICA per visible device (``--replicas`` overrides), with the
+persistent compilation cache ON by default so a restart of this
+process performs zero new backend compiles — and, because all replicas
+share the digest-keyed cache, a warm fleet start costs one compile
+set, not N:
 
     python -m veles_tpu.serve --snapshot mnist_current.pickle \\
-        --port 8080 --ladder 1,8,32,128 --max-delay-ms 2 \\
+        --port 8080 --transport-port 8081 \\
+        --ladder 1,8,32,128 --max-delay-ms 2 \\
         --slo-p50-ms 20 --slo-p99-ms 100
 
-``--demo`` trains a tiny blobs MLP in-process instead (a smoke target
-for the load generator and the docs walkthrough).
+``--transport-port`` opens the binary frame listener (raw tensor
+bytes, no JSON, no pickle — docs/serving.md wire format) beside the
+JSON front.  ``SIGHUP`` or ``POST /reload {"snapshot": path}``
+hot-swaps the served weights without dropping the queue (same digest =
+zero recompiles).  ``--demo`` trains a tiny blobs MLP in-process
+instead (a smoke target for the load generator and the docs
+walkthrough).
 """
 
 import argparse
+import signal
 import sys
+import threading
 import time
 
 
@@ -30,6 +41,12 @@ def build_parser():
                         help="train a tiny demo MLP and serve it")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--path", default="/infer")
+    parser.add_argument("--replicas", type=int, default=None,
+                        help="engine replicas (default: one per "
+                        "visible device)")
+    parser.add_argument("--transport-port", type=int, default=None,
+                        help="also listen for the binary frame "
+                        "transport on this port (0 = ephemeral)")
     parser.add_argument("--ladder", default="1,8,32,128",
                         help="comma-separated batch-shape ladder")
     parser.add_argument("--max-delay-ms", type=float, default=2.0,
@@ -98,24 +115,44 @@ def main(argv=None):
         from veles_tpu.workflow import restore_workflow
         sw = restore_workflow(args.snapshot)
 
-    from veles_tpu.serve import AOTEngine, ServeService
+    from veles_tpu.serve import ReplicaPool, ServeService
     ladder = tuple(int(b) for b in args.ladder.split(","))
     cache_kwargs = {}
     if args.cache_root != "none":
         cache_kwargs["persistent_cache"] = True
         if args.cache_root:
             cache_kwargs["cache_root"] = args.cache_root
-    engine = AOTEngine.from_workflow(sw, ladder=ladder, **cache_kwargs)
-    receipt = engine.compile()
+    pool = ReplicaPool.from_workflow(
+        sw, replicas=args.replicas, ladder=ladder,
+        max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
+        slo_p50_ms=args.slo_p50_ms, slo_p99_ms=args.slo_p99_ms,
+        **cache_kwargs)
+    receipt = pool.compile()
     loader = getattr(sw, "loader", None)
     service = ServeService(
-        engine, port=args.port, path=args.path,
+        pool, port=args.port, path=args.path,
         labels_mapping=getattr(loader, "reversed_labels_mapping", None),
-        max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
-        slo_p50_ms=args.slo_p50_ms, slo_p99_ms=args.slo_p99_ms)
+        transport_port=args.transport_port)
     service.start_background()
-    print("serving on http://127.0.0.1:%d%s  (compile receipt: %s)"
-          % (service.port, args.path, receipt))
+    print("serving on http://127.0.0.1:%d%s with %d replica(s)%s  "
+          "(compile receipt: %s)"
+          % (service.port, args.path, len(pool.replicas),
+             "; binary transport :%d" % service.transport_port
+             if service.transport_port is not None else "",
+             {k: v for k, v in receipt.items() if k != "per_replica"}))
+    if args.snapshot:
+        # SIGHUP = hot-reload the snapshot path in place (the classic
+        # daemon contract); runs on a thread so the handler returns
+        def _reload(signum, frame):
+            def run():
+                try:
+                    print("SIGHUP: reloading %s -> %s" % (
+                        args.snapshot,
+                        service.reload_snapshot(args.snapshot)))
+                except Exception as exc:
+                    print("SIGHUP reload failed: %s" % exc)
+            threading.Thread(target=run, name="serve-reload").start()
+        signal.signal(signal.SIGHUP, _reload)
     try:
         if args.duration is not None:
             time.sleep(args.duration)

@@ -65,9 +65,9 @@ class ServeOverload(Exception):
 
 class _Request(object):
     __slots__ = ("sample", "enqueued", "done", "result", "error",
-                 "cancelled")
+                 "cancelled", "block")
 
-    def __init__(self, sample):
+    def __init__(self, sample, block=False):
         self.sample = sample
         self.enqueued = time.perf_counter()
         self.done = threading.Event()
@@ -77,6 +77,15 @@ class _Request(object):
         #: payload that shed partway through submission); the worker
         #: drops it at dispatch instead of computing for nobody
         self.cancelled = False
+        #: True when ``sample`` is a whole contiguous batch submitted
+        #: via :meth:`ContinuousBatcher.submit_block` — the worker can
+        #: hand its buffer to ``Device.put`` verbatim when it fills a
+        #: rung exactly (the binary transport's zero-copy hot path)
+        self.block = block
+
+    @property
+    def rows(self):
+        return self.sample.shape[0] if self.block else 1
 
 
 def _oom_shaped(exc):
@@ -95,7 +104,7 @@ class ContinuousBatcher(Logger):
 
     def __init__(self, engine, max_delay_s=0.002, max_queue=256,
                  slo_p50_ms=None, slo_p99_ms=None, slo_check_every=4,
-                 **kwargs):
+                 replica=None, **kwargs):
         super(ContinuousBatcher, self).__init__(**kwargs)
         self.engine = engine
         self.max_delay_s = float(max_delay_s)
@@ -103,15 +112,25 @@ class ContinuousBatcher(Logger):
         self.slo_p50_ms = slo_p50_ms
         self.slo_p99_ms = slo_p99_ms
         self.slo_check_every = max(1, int(slo_check_every))
+        #: replica index inside a ReplicaPool; scopes the GAUGES (each
+        #: replica's queue depth / rung cap is its own signal) while
+        #: counters and histograms stay process-shared so fleet totals
+        #: and latency percentiles aggregate by construction
+        self.replica = replica
         self._q = queue.Queue()
         self._thread = None
         self._stop_ = False
         self._rung_cap = engine.max_batch
         self._stage = {}      # rung -> (Array, [slot])
+        self._carry = None    # popped request that overflowed a batch
+        self._pending_engine = None
         self._batches_since_check = 0
         self._slo_breached = False
         # metrics resolved once (docs/observability.md serve set)
-        self._m_depth = _registry.gauge("serve.queue_depth")
+        scope = "serve" if replica is None else \
+            "serve.replica.%d" % replica
+        self._m_depth = _registry.gauge(scope + ".queue_depth")
+        self._g_rung_cap = _registry.gauge(scope + ".rung_cap")
         self._m_batch = _registry.histogram("serve.batch_size")
         self._m_latency = _registry.histogram("serve.latency_s")
         self._m_requests = _registry.counter("serve.requests")
@@ -145,15 +164,59 @@ class ContinuousBatcher(Logger):
         thread, self._thread = self._thread, None
         if thread is not None:
             thread.join(timeout=10)
+        carry, self._carry = self._carry, None
         while True:
-            try:
-                req = self._q.get_nowait()
-            except queue.Empty:
-                break
-            req.error = ServeOverload("server shutting down",
-                                      retry_after=1.0)
-            req.done.set()
+            if carry is not None:
+                req, carry = carry, None
+            else:
+                try:
+                    req = self._q.get_nowait()
+                except queue.Empty:
+                    break
+            if not req.done.is_set():
+                req.error = ServeOverload("server shutting down",
+                                          retry_after=1.0)
+                req.done.set()
         self._m_depth.set(0)
+
+    # -- hot reload ---------------------------------------------------------
+
+    def swap_engine(self, engine):
+        """Queue an atomic engine cutover (snapshot hot-reload with a
+        NEW digest): the worker applies it BETWEEN batches, so no batch
+        is ever torn across engines and no queued request is dropped —
+        requests keep queueing during the background compile and are
+        served by whichever engine owns the batch they land in.
+
+        Same-digest reloads never come here: ``AOTEngine.swap_params``
+        swaps device buffers in place with zero recompiles."""
+        if engine.compile_receipt is None:
+            raise RuntimeError(
+                "swap_engine needs a COMPILED engine (warm the ladder "
+                "before cutover — compiling on the serving path is the "
+                "failure mode the AOT design exists to avoid)")
+        if self._thread is None:
+            self._apply_engine(engine)  # stopped: no batch to tear
+        else:
+            self._pending_engine = engine
+
+    def _apply_engine(self, engine):
+        """Worker-side half of :meth:`swap_engine` (between batches)."""
+        self._pending_engine = None
+        old = self.engine
+        self.engine = engine
+        # staging buffers are shaped by the OLD engine's sample shape/
+        # dtype; drop them (rebuilt lazily) and lift any OOM cap — the
+        # new model's memory behavior is its own
+        self._stage.clear()
+        self._rung_cap = engine.max_batch
+        self._g_rung_cap.set(engine.max_batch)
+        if _tracer.active:
+            _tracer.instant(
+                "serve.reload.cutover", cat="serve",
+                replica=self.replica if self.replica is not None else 0,
+                old_digest=old.digest, new_digest=engine.digest)
+        self.info("engine cutover: %s -> %s", old.digest, engine.digest)
 
     # -- submit side --------------------------------------------------------
 
@@ -167,10 +230,10 @@ class ContinuousBatcher(Logger):
         return min(5.0, max(0.05, per_batch * (
             1 + depth / float(self.engine.max_batch))))
 
-    def submit(self, sample):
-        """Enqueue one sample; returns the pending request.  Raises
-        :class:`ServeOverload` when shedding (full queue or chaos
-        ``serve.drop``)."""
+    def _admit(self):
+        """Shared admission control: running check, chaos shed, queue
+        bound.  Raises :class:`ServeOverload` when the request must be
+        shed."""
         if self._thread is None or self._stop_:
             raise ServeOverload("batcher not running", retry_after=1.0)
         if chaos.plan is not None:
@@ -189,11 +252,8 @@ class ContinuousBatcher(Logger):
             raise ServeOverload(
                 "queue full (%d pending)" % self._q.qsize(),
                 retry_after=retry)
-        sample = numpy.ascontiguousarray(sample, self.engine.dtype)
-        if sample.shape != self.engine.sample_shape:
-            raise ValueError("expected sample shape %s, got %s" %
-                             (self.engine.sample_shape, sample.shape))
-        req = _Request(sample)
+
+    def _enqueue(self, req):
         self._q.put(req)
         if self._stop_:
             # lost the race with a concurrent stop(): its drain may
@@ -205,6 +265,48 @@ class ContinuousBatcher(Logger):
             raise req.error
         self._m_depth.set(self._q.qsize())
         return req
+
+    def submit(self, sample):
+        """Enqueue one sample; returns the pending request.  Raises
+        :class:`ServeOverload` when shedding (full queue or chaos
+        ``serve.drop``)."""
+        self._admit()
+        sample = numpy.ascontiguousarray(sample, self.engine.dtype)
+        if sample.shape != self.engine.sample_shape:
+            raise ValueError("expected sample shape %s, got %s" %
+                             (self.engine.sample_shape, sample.shape))
+        return self._enqueue(_Request(sample))
+
+    def submit_block(self, block):
+        """Enqueue a whole batch as ONE request whose rows stay in
+        their caller-provided buffer.
+
+        For an already-contiguous same-dtype block — exactly what the
+        binary transport decodes with ``numpy.frombuffer`` — the rows
+        are NEVER copied into the ping-pong staging `memory.Array`:
+        when the block fills a rung by itself the worker hands the
+        buffer straight to ``Device.put`` (which on XLA:CPU makes the
+        one XLA-owned copy the zero-copy ``device_put`` hazard demands
+        — never raw ``jax.device_put``; see ``CPUDevice.put``), and
+        when it co-batches, the fill is one vectorized slice-assign
+        instead of a Python loop.  Non-conforming input falls back to
+        one normalizing copy here, so callers need no special casing.
+        """
+        self._admit()
+        block = numpy.asarray(block)
+        if block.dtype != self.engine.dtype or \
+                not block.flags["C_CONTIGUOUS"]:
+            block = numpy.ascontiguousarray(block, self.engine.dtype)
+        if block.ndim != len(self.engine.sample_shape) + 1 or \
+                block.shape[1:] != self.engine.sample_shape:
+            raise ValueError("expected a (n,) + %s block, got %s" %
+                             (self.engine.sample_shape, block.shape))
+        if not 1 <= block.shape[0] <= self.engine.max_batch:
+            raise ValueError(
+                "block of %d rows overflows the ladder (max %d); "
+                "chunk at the caller" %
+                (block.shape[0], self.engine.max_batch))
+        return self._enqueue(_Request(block, block=True))
 
     def infer(self, sample, timeout=30.0):
         """Blocking submit: returns the output row (numpy) or raises
@@ -221,10 +323,15 @@ class ContinuousBatcher(Logger):
 
     def _loop(self):
         while not self._stop_:
-            try:
-                first = self._q.get(timeout=0.2)
-            except queue.Empty:
-                continue
+            pending = self._pending_engine
+            if pending is not None:
+                self._apply_engine(pending)
+            first, self._carry = self._carry, None
+            if first is None:
+                try:
+                    first = self._q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
             batch = self._collect(first)
             self._m_depth.set(self._q.qsize())
             try:
@@ -240,19 +347,28 @@ class ContinuousBatcher(Logger):
     def _collect(self, first):
         """Grow a batch around the oldest pending request: drain
         whatever is already queued instantly, then wait out the
-        remaining queue-delay budget for stragglers."""
+        remaining queue-delay budget for stragglers.  Accounting is in
+        ROWS (a block request carries several); a popped request that
+        would overflow the rung limit becomes the head of the next
+        batch via the carry slot."""
         batch = [first]
+        rows = first.rows
         limit = min(self._rung_cap, self.engine.max_batch)
         deadline = first.enqueued + self.max_delay_s
-        while len(batch) < limit and not self._stop_:
+        while rows < limit and not self._stop_:
             remaining = deadline - time.perf_counter()
             try:
                 if remaining <= 0:
-                    batch.append(self._q.get_nowait())
+                    req = self._q.get_nowait()
                 else:
-                    batch.append(self._q.get(timeout=remaining))
+                    req = self._q.get(timeout=remaining)
             except queue.Empty:
                 break
+            if rows + req.rows > limit:
+                self._carry = req
+                break
+            batch.append(req)
+            rows += req.rows
         return batch
 
     def _staging(self, rung):
@@ -274,23 +390,36 @@ class ContinuousBatcher(Logger):
         batch = [req for req in batch if not req.cancelled]
         if not batch:
             return
-        n = len(batch)
+        n = sum(req.rows for req in batch)
         rung = self.engine.rung_for(n, cap=self._rung_cap)
-        if n > rung:  # capped ladder (post-OOM degrade): chunk
-            for i in range(0, n, rung):
-                self._run_batch(batch[i:i + rung])
+        if n > rung:  # capped ladder (post-OOM degrade): chunk by rows
+            self._run_chunked(batch, rung)
             return
         start = time.perf_counter()
-        arr, slot = self._staging(rung)
-        arr.stage_begin(slot)
-        self._stage[rung][1] = slot ^ 1
-        mem = arr.mem
-        for i, req in enumerate(batch):
-            mem[i] = req.sample
-        if n < rung:
-            mem[n:] = 0  # deterministic padding (bit-equality contract)
-            self._m_padded.inc(rung - n)
-        x_dev = arr.stage_put(self.engine.device)
+        if len(batch) == 1 and batch[0].block and \
+                batch[0].rows == rung:
+            # zero-copy hot path: a contiguous block filling the rung
+            # exactly skips the staging fill — Device.put gets the
+            # caller's buffer (and on XLA:CPU makes the one hazard-safe
+            # XLA-owned copy; see CPUDevice.put / submit_block)
+            x_dev = self.engine.device.put(batch[0].sample)
+        else:
+            arr, slot = self._staging(rung)
+            arr.stage_begin(slot)
+            self._stage[rung][1] = slot ^ 1
+            mem = arr.mem
+            off = 0
+            for req in batch:
+                if req.block:
+                    mem[off:off + req.rows] = req.sample
+                else:
+                    mem[off] = req.sample
+                off += req.rows
+            if n < rung:
+                # deterministic padding (bit-equality contract)
+                mem[n:] = 0
+                self._m_padded.inc(rung - n)
+            x_dev = arr.stage_put(self.engine.device)
         try:
             if chaos.plan is not None:
                 fault = chaos.plan.fire("serve.oom")
@@ -309,17 +438,67 @@ class ContinuousBatcher(Logger):
         self._m_batches.inc()
         self._m_requests.inc(n)
         self._m_batch.observe(n)
-        for i, req in enumerate(batch):
-            req.result = host[i].copy()
+        off = 0
+        for req in batch:
+            # hand out VIEWS of the one per-batch host block: the
+            # per-request row copy (and its per-element boxing further
+            # down the JSON front) is paid zero times — `host` is a
+            # fresh buffer each batch, so nothing ever overwrites a
+            # view a waiter still holds
+            if req.block:
+                req.result = host[off:off + req.rows]
+            else:
+                req.result = host[off]
+            off += req.rows
             self._m_latency.observe(done - req.enqueued)
             req.done.set()
         if _tracer.active:
+            args = {"n": n, "rung": rung}
+            if self.replica is not None:
+                args["replica"] = self.replica
             _tracer.complete("serve.batch", start, done - start,
-                             cat="serve", args={"n": n, "rung": rung})
+                             cat="serve", args=args)
         self._batches_since_check += 1
         if self._batches_since_check >= self.slo_check_every:
             self._batches_since_check = 0
             self._check_slo()
+
+    def _run_chunked(self, batch, rung):
+        """Replay a too-large batch within a capped rung: requests are
+        regrouped by rows; a block wider than the cap itself is sliced
+        into view sub-requests (still contiguous — the zero-copy
+        dispatch applies to full slices) and its result reassembled."""
+        chunk, rows = [], 0
+        for req in batch:
+            if req.rows > rung:
+                if chunk:
+                    self._run_batch(chunk)
+                    chunk, rows = [], 0
+                self._run_block_sliced(req, rung)
+                continue
+            if rows + req.rows > rung:
+                self._run_batch(chunk)
+                chunk, rows = [], 0
+            chunk.append(req)
+            rows += req.rows
+        if chunk:
+            self._run_batch(chunk)
+
+    def _run_block_sliced(self, req, cap):
+        children = []
+        for i in range(0, req.rows, cap):
+            child = _Request(req.sample[i:i + cap], block=True)
+            child.enqueued = req.enqueued
+            children.append(child)
+        for child in children:
+            self._run_batch([child])
+        errors = [c.error for c in children if c.error is not None]
+        if errors:
+            req.error = errors[0]
+        else:
+            req.result = numpy.concatenate(
+                [c.result for c in children])
+        req.done.set()
 
     def _degrade_or_fail(self, batch, rung, exc):
         self._m_errors.inc()
@@ -329,7 +508,7 @@ class ContinuousBatcher(Logger):
             # health block (serve.rung_cap gauge)
             smaller = [r for r in self.engine.ladder if r < rung]
             self._rung_cap = smaller[-1]
-            _registry.gauge("serve.rung_cap").set(self._rung_cap)
+            self._g_rung_cap.set(self._rung_cap)
             self.warning(
                 "engine OOM at rung %d (%s); capping ladder at %d and "
                 "replaying", rung, exc, self._rung_cap)
@@ -382,7 +561,13 @@ def serve_snapshot(reg=None):
     """The serving health block as a flat plain-data dict: queue depth,
     SLO violations, shed/error counts, latency percentiles (ms) and
     mean batch size.  Empty dict when nothing ever served — dashboards
-    show the block only on serving processes."""
+    show the block only on serving processes.
+
+    On a multi-replica server (``serve.replicas`` gauge set by the
+    ReplicaPool) the block also carries the replica count and the
+    per-replica queue depths, and ``queue_depth`` becomes their sum —
+    counters and histograms are process-shared, so the totals and
+    percentiles already aggregate across replicas by construction."""
     reg = reg if reg is not None else _registry
     out = {}
     for name, short in (("serve.queue_depth", "queue_depth"),
@@ -390,10 +575,22 @@ def serve_snapshot(reg=None):
                         ("serve.requests", "requests"),
                         ("serve.shed", "shed"),
                         ("serve.errors", "errors"),
+                        ("serve.reloads", "reloads"),
                         ("serve.rung_cap", "rung_cap")):
         metric = reg.peek(name)
         if metric is not None and metric.value is not None:
             out[short] = metric.value
+    replicas = reg.peek("serve.replicas")
+    if replicas is not None and replicas.value:
+        out["replicas"] = replicas.value
+        depths = []
+        for i in range(int(replicas.value)):
+            gauge = reg.peek("serve.replica.%d.queue_depth" % i)
+            depths.append(
+                gauge.value if gauge is not None and
+                gauge.value is not None else 0)
+        out["replica_queue_depths"] = depths
+        out["queue_depth"] = sum(depths)
     hist = reg.peek("serve.latency_s")
     if hist is not None and hist.count:
         snap = hist.snapshot()

@@ -154,6 +154,7 @@ class AOTEngine(Logger):
         self.dtype = numpy.dtype(dtype)
         self.donate = donate
         self.digest = model_digest(plans, self.params, self.sample_shape)
+        self.cache_root = cache_root
         self.cache_dir = None
         if persistent_cache or cache_root is not None:
             self.cache_dir = enable_persistent_cache(
@@ -218,35 +219,28 @@ class AOTEngine(Logger):
         from veles_tpu.compiler import build_forward
         from veles_tpu.observe import xla_introspect
 
-        xla_introspect.ensure_installed()
-        before = xla_introspect.compile_snapshot()
         start = time.perf_counter()
-        put = self.device.put
-        self._params_dev = [
-            {key: (None if leaf is None else put(numpy.asarray(leaf)))
-             for key, leaf in entry.items()}
-            for entry in self.params]
-        forward = build_forward(self.plans)
-        donate = self._donate_argnums()
-        for rung in self.ladder:
-            x_aval = jax.ShapeDtypeStruct(
-                (rung,) + self.sample_shape, self.dtype)
-            with _tracer.span("serve.compile", cat="serve", rung=rung):
-                jitted = jax.jit(forward, donate_argnums=donate)
-                self._compiled[rung] = jitted.lower(
-                    self._params_dev, x_aval).compile()
+        with xla_introspect.compile_delta() as delta:
+            self._params_dev = self._put_params(self.params)
+            forward = build_forward(self.plans)
+            donate = self._donate_argnums()
+            for rung in self.ladder:
+                x_aval = jax.ShapeDtypeStruct(
+                    (rung,) + self.sample_shape, self.dtype)
+                with _tracer.span("serve.compile", cat="serve",
+                                  rung=rung):
+                    jitted = jax.jit(forward, donate_argnums=donate)
+                    self._compiled[rung] = jitted.lower(
+                        self._params_dev, x_aval).compile()
         elapsed = time.perf_counter() - start
-        after = xla_introspect.compile_snapshot()
-        requests = after["count"] - before["count"]
-        hits = after["cache_hits"] - before["cache_hits"]
-        self.compile_receipt = {
-            "rungs": list(self.ladder),
-            "backend_compiles": requests,
-            "cache_hits": hits,
-            "new_compiles": max(0, requests - hits),
-            "seconds": round(elapsed, 4),
-            "cache_dir": self.cache_dir,
-        }
+        requests = delta.receipt["backend_compiles"]
+        hits = delta.receipt["cache_hits"]
+        self.compile_receipt = dict(
+            delta.receipt,
+            rungs=list(self.ladder),
+            seconds=round(elapsed, 4),
+            cache_dir=self.cache_dir,
+        )
         _registry.gauge("serve.aot_rungs").set(len(self.ladder))
         _registry.gauge("serve.compile_s").set(round(elapsed, 4))
         self.info(
@@ -256,6 +250,42 @@ class AOTEngine(Logger):
             self.compile_receipt["new_compiles"],
             " cache=%s" % self.cache_dir if self.cache_dir else "")
         return self.compile_receipt
+
+    def _put_params(self, params):
+        put = self.device.put
+        return [
+            {key: (None if leaf is None else put(numpy.asarray(leaf)))
+             for key, leaf in entry.items()}
+            for entry in params]
+
+    def swap_params(self, params):
+        """Hot-swap the weights under the SAME architecture: new device
+        buffers, zero recompiles.
+
+        The compiled executables are parameterized by the params
+        argument (``run`` passes ``self._params_dev`` per dispatch, and
+        donation covers only the batch input), so replacing the device
+        buffer list is the entire snapshot-reload mechanism for a
+        same-digest model: the list is built complete, then swapped in
+        with ONE attribute assignment — an in-flight ``run`` holds a
+        reference to whichever list it started with, so batches are
+        never torn between old and new weights.  A digest mismatch
+        (shape/topology change) is rejected here; that case needs a new
+        engine + ladder warm-up (the router's reload path).
+        """
+        params = [dict(entry) for entry in params]
+        digest = model_digest(self.plans, params, self.sample_shape)
+        if digest != self.digest:
+            raise ValueError(
+                "swap_params digest mismatch (%s != %s): architecture "
+                "or shapes changed — build a new engine" %
+                (digest, self.digest))
+        if self._params_dev is None:
+            raise RuntimeError("AOTEngine.compile() not called")
+        params_dev = self._put_params(params)
+        self.params = params
+        self._params_dev = params_dev
+        return digest
 
     # -- dispatch -----------------------------------------------------------
 

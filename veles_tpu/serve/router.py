@@ -1,0 +1,348 @@
+"""Replica pool + request router: one AOT engine per chip.
+
+The single-process serve stack (PR 7) has exactly one engine and one
+batcher — fine for one chip, a hard ceiling for "millions of users".
+The TensorFlow paper's serving recipe (PAPERS.md) is to replicate the
+compiled function across devices behind one request stream; the TPU
+in-datacenter paper adds the constraint: per-chip throughput under a
+latency budget is the number that matters.  So the scale-out unit here
+is a **replica** — an :class:`AOTEngine` compiled against one visible
+device plus its own :class:`ContinuousBatcher` worker — and the
+:class:`ReplicaPool` is the sharded front:
+
+- **placement**: one replica per ``jax.local_devices()`` entry by
+  default (``replicas=`` overrides; the CPU harness cycles devices),
+  every engine keyed to the SAME model digest so the persistent
+  compile cache makes a warm fleet restart compile NOTHING — the cold
+  fleet start is the only one that pays, and pays per device because
+  jax's cache key includes the device assignment;
+- **routing**: each request goes to the least-loaded replica (queue
+  depth at submit); an overloaded replica cascades the request to its
+  siblings before the pool sheds with a 503-shaped
+  :class:`ServeOverload` whose ``retry_after`` is the fleet's best
+  offer;
+- **observability**: per-replica ``serve.replica.N.*`` gauges next to
+  the process-shared serve counters/histograms (which therefore
+  aggregate across replicas by construction), ``serve.replicas`` and
+  the aggregate ``serve.queue_depth`` for heartbeats/web-status, and
+  per-replica ``serve.batch`` spans (the batcher worker threads give
+  each replica its own track in merged traces);
+- **snapshot hot-reload** (:meth:`ReplicaPool.reload`): a same-digest
+  snapshot swaps device weight buffers in place — zero recompiles,
+  receipted via ``xla_introspect.compile_delta`` — while a changed
+  digest AOT-warms a full new ladder per replica in the background and
+  cuts over atomically between batches; either way the queue is never
+  dropped.
+"""
+
+import threading
+import time
+
+import numpy
+
+from veles_tpu.logger import Logger
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.observe.trace import tracer as _tracer
+from veles_tpu.serve.batcher import ContinuousBatcher, ServeOverload
+from veles_tpu.serve.engine import (
+    AOTEngine, DEFAULT_LADDER, model_digest)
+
+__all__ = ["Replica", "ReplicaPool", "local_devices",
+           "reload_replicas"]
+
+
+def local_devices(count=None):
+    """Device handles for a replica fleet: one :class:`backends.Device`
+    per visible jax device, cycled when ``count`` asks for more
+    replicas than devices (the CPU harness measures router/transport
+    scaling with several replicas on one host)."""
+    import jax
+
+    from veles_tpu.backends import Device
+    jax_devices = jax.local_devices()
+    backend = "cpu" if jax_devices[0].platform == "cpu" else "tpu"
+    n = int(count) if count else len(jax_devices)
+    if n < 1:
+        raise ValueError("need at least one replica")
+    return [Device(backend=backend,
+                   device_index=i % len(jax_devices))
+            for i in range(n)]
+
+
+class Replica(object):
+    """One engine+batcher pair bound to one device."""
+
+    __slots__ = ("index", "device", "engine", "batcher")
+
+    def __init__(self, index, device, engine, batcher):
+        self.index = index
+        self.device = device
+        self.engine = engine
+        self.batcher = batcher
+
+
+def reload_replicas(replicas, params, plans=None, sample_shape=None,
+                    ladder=None, engine_kwargs=None):
+    """The ONE hot-reload state machine, shared by :class:`ReplicaPool`
+    and the single-engine :class:`ServeService` (a list of one
+    Replica-shaped entry).  Callers hold their own reload lock.
+
+    Same digest: each entry's weights swap in place via
+    ``AOTEngine.swap_params`` — zero new backend compiles, receipted
+    via ``compile_delta``.  New digest (or ladder change): a full new
+    engine per entry is AOT-warmed HERE, off the dispatch path, then
+    each batcher cuts over between batches.  Returns the receipt."""
+    from veles_tpu.observe import xla_introspect
+    current = replicas[0].engine
+    new_plans = list(plans) if plans is not None else current.plans
+    new_shape = tuple(sample_shape) if sample_shape is not None \
+        else current.sample_shape
+    params = [dict(entry) for entry in params]
+    new_digest = model_digest(new_plans, params, new_shape)
+    same = (new_digest == current.digest and
+            (ladder is None or
+             tuple(sorted({int(b) for b in ladder})) == current.ladder))
+    mode = "params" if same else "engine"
+    start = time.perf_counter()
+    with _tracer.span("serve.reload", cat="serve", mode=mode,
+                      digest=new_digest):
+        with xla_introspect.compile_delta() as delta:
+            if same:
+                for rep in replicas:
+                    rep.engine.swap_params(params)
+            else:
+                kwargs = dict(engine_kwargs or {})
+                if ladder is not None:
+                    kwargs["ladder"] = ladder
+                fresh = []
+                for rep in replicas:
+                    engine = AOTEngine(new_plans, params, new_shape,
+                                       device=rep.device, **kwargs)
+                    engine.compile()
+                    fresh.append(engine)
+                # warm-up done: atomic cutover, oldest first
+                for rep, engine in zip(replicas, fresh):
+                    rep.batcher.swap_engine(engine)
+                    rep.engine = engine
+    receipt = dict(
+        delta.receipt, mode=mode, digest=new_digest,
+        previous_digest=current.digest, replicas=len(replicas),
+        seconds=round(time.perf_counter() - start, 4))
+    _registry.counter("serve.reloads").inc()
+    return receipt
+
+
+class ReplicaPool(Logger):
+    """N per-device serving replicas behind one least-loaded router.
+
+    Duck-types the :class:`ContinuousBatcher` submit surface
+    (``submit``/``submit_block``/``infer``/``start``/``stop``), so
+    :class:`ServeService` and the binary transport drive a pool and a
+    single batcher identically."""
+
+    def __init__(self, plans, params, sample_shape, replicas=None,
+                 ladder=DEFAULT_LADDER, devices=None, cache_root=None,
+                 persistent_cache=False, dtype=numpy.float32,
+                 **batcher_kwargs):
+        super(ReplicaPool, self).__init__()
+        if devices is None:
+            devices = local_devices(replicas)
+        elif replicas:
+            devices = [devices[i % len(devices)]
+                       for i in range(int(replicas))]
+        self._engine_kwargs = dict(
+            ladder=ladder, cache_root=cache_root,
+            persistent_cache=persistent_cache, dtype=dtype)
+        self._batcher_kwargs = dict(batcher_kwargs)
+        self.replicas = []
+        for i, device in enumerate(devices):
+            engine = AOTEngine(plans, params, sample_shape,
+                               device=device, **self._engine_kwargs)
+            batcher = ContinuousBatcher(engine, replica=i,
+                                        **self._batcher_kwargs)
+            self.replicas.append(Replica(i, device, engine, batcher))
+        self.compile_receipt = None
+        self._reload_lock = threading.Lock()
+        self._m_replicas = _registry.gauge("serve.replicas")
+        self._m_replicas.set(len(self.replicas))
+        self._m_depth = _registry.gauge("serve.queue_depth")
+        self._m_cascades = _registry.counter("serve.router.cascades")
+
+    # -- workflow plumbing --------------------------------------------------
+
+    @staticmethod
+    def _workflow_spec(sw, sample_shape=None):
+        from veles_tpu.compiler import extract_state, workflow_plan
+        plans = workflow_plan(sw)
+        state = extract_state(sw)
+        params = [{"weights": s["weights"], "bias": s["bias"]}
+                  for s in state]
+        if sample_shape is None:
+            loader = getattr(sw, "loader", None)
+            if loader is not None and loader.minibatch_data:
+                sample_shape = tuple(loader.minibatch_data.shape[1:])
+            else:
+                raise ValueError("workflow has no loader shape; pass "
+                                 "sample_shape=")
+        return plans, params, tuple(sample_shape)
+
+    @classmethod
+    def from_workflow(cls, sw, **kwargs):
+        """Build a pool from a trained StandardWorkflow, exactly like
+        ``AOTEngine.from_workflow`` but fanned out per device."""
+        plans, params, sample_shape = cls._workflow_spec(
+            sw, kwargs.pop("sample_shape", None))
+        return cls(plans, params, sample_shape, **kwargs)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def engine(self):
+        """Replica 0's engine: the pool's metadata anchor (digest,
+        ladder, sample shape, dtype) — LIVE across hot reloads."""
+        return self.replicas[0].engine
+
+    @property
+    def digest(self):
+        return self.engine.digest
+
+    def compile(self):
+        """Compile every replica's ladder; returns the aggregate
+        receipt.  All replicas share the ONE digest-keyed persistent
+        cache directory; jax's cache key includes the device
+        assignment, so a cold fleet start writes one entry set per
+        device — and a warm fleet RESTART deserializes every one of
+        them: ``new_compiles == 0`` across all N replicas, asserted by
+        tests/test_serve_router.py."""
+        start = time.perf_counter()
+        per = [rep.engine.compile() for rep in self.replicas]
+        self.compile_receipt = {
+            "replicas": len(per),
+            "rungs": per[0]["rungs"],
+            "backend_compiles": sum(
+                r["backend_compiles"] for r in per),
+            "cache_hits": sum(r["cache_hits"] for r in per),
+            "new_compiles": sum(r["new_compiles"] for r in per),
+            "seconds": round(time.perf_counter() - start, 4),
+            "cache_dir": per[0]["cache_dir"],
+            "per_replica": per,
+        }
+        return self.compile_receipt
+
+    @property
+    def running(self):
+        return any(rep.batcher.running for rep in self.replicas)
+
+    def start(self):
+        for rep in self.replicas:
+            rep.batcher.start()
+        return self
+
+    def stop(self):
+        for rep in self.replicas:
+            rep.batcher.stop()
+        self._m_depth.set(0)
+
+    # -- routing ------------------------------------------------------------
+
+    def _update_depth(self):
+        self._m_depth.set(sum(rep.batcher._q.qsize()
+                              for rep in self.replicas))
+
+    def _submit(self, fn):
+        """Least-queue-depth pick with overload cascade: try replicas
+        in depth order; only when EVERY replica sheds does the pool
+        itself shed, with the smallest retry_after any replica offered
+        (the fleet's best promise, not its worst)."""
+        ranked = sorted(self.replicas,
+                        key=lambda rep: rep.batcher._q.qsize())
+        sheds = []
+        for nth, rep in enumerate(ranked):
+            try:
+                req = fn(rep.batcher)
+            except ServeOverload as exc:
+                sheds.append(exc)
+                continue
+            if nth:
+                self._m_cascades.inc()
+            self._update_depth()
+            return req
+        self._update_depth()
+        raise ServeOverload(
+            "all %d replicas shedding (%s)" %
+            (len(ranked), sheds[-1]),
+            retry_after=min(exc.retry_after for exc in sheds))
+
+    def submit(self, sample):
+        return self._submit(lambda batcher: batcher.submit(sample))
+
+    def submit_block(self, block):
+        return self._submit(
+            lambda batcher: batcher.submit_block(block))
+
+    def infer(self, sample, timeout=30.0):
+        """Blocking submit through the router (single sample)."""
+        return self._wait(self.submit(sample), timeout)
+
+    def infer_block(self, block, timeout=30.0):
+        """Blocking whole-batch submit (the binary transport's path):
+        one request, zero row copies, result is the 2-D block."""
+        return self._wait(self.submit_block(block), timeout)
+
+    @staticmethod
+    def _wait(req, timeout):
+        if not req.done.wait(timeout):
+            raise TimeoutError("inference timed out after %.1fs"
+                               % timeout)
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- snapshot hot-reload ------------------------------------------------
+
+    def reload(self, params, plans=None, sample_shape=None,
+               ladder=None):
+        """Swap the served model under load; returns the reload receipt.
+
+        Same digest (retrained weights, identical architecture): each
+        replica's device buffers are rebuilt and swapped in atomically
+        — ZERO new backend compiles, receipted via ``compile_delta``
+        (the acceptance assertion of docs/serving.md).  New digest (or
+        a ladder change): a full new engine per replica is AOT-warmed
+        here — off the dispatch path, requests keep batching on the old
+        engines — then cut over between batches.  Either way no queued
+        request is dropped or failed by the reload itself."""
+        with self._reload_lock:
+            receipt = reload_replicas(
+                self.replicas, params, plans=plans,
+                sample_shape=sample_shape, ladder=ladder,
+                engine_kwargs=self._engine_kwargs)
+            self.info(
+                "hot reload (%s): %s -> %s in %.2fs, %d new compiles",
+                receipt["mode"], receipt["previous_digest"],
+                receipt["digest"], receipt["seconds"],
+                receipt["new_compiles"])
+            return receipt
+
+    def reload_workflow(self, sw):
+        """Reload from a (re)trained workflow / restored snapshot."""
+        try:
+            plans, params, shape = self._workflow_spec(sw)
+        except ValueError:
+            plans, params, shape = self._workflow_spec(
+                sw, self.engine.sample_shape)
+        return self.reload(params, plans=plans, sample_shape=shape)
+
+    # -- observability ------------------------------------------------------
+
+    def snapshot(self):
+        """Plain-data pool state for /healthz and the dashboard."""
+        return {
+            "replicas": len(self.replicas),
+            "digest": self.digest,
+            "queue_depths": [rep.batcher._q.qsize()
+                             for rep in self.replicas],
+            "devices": [str(getattr(rep.device, "backend_name", "?"))
+                        + ":%d" % getattr(rep.device, "device_index", 0)
+                        for rep in self.replicas],
+        }

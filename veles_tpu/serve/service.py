@@ -40,45 +40,92 @@ __all__ = ["ServeService", "format_result"]
 def format_result(probs, labels_mapping=None):
     """Shape a probability block into the REST response contract:
     argmax label(s) mapped through the loader's reverse mapping, plus
-    the raw probabilities."""
-    probs = numpy.asarray(probs)
+    the raw probabilities.
+
+    Vectorized once per payload: ``probs`` arrives as (a view of) the
+    batcher's per-batch host buffer — never re-copied here — and the
+    float boxing the JSON front must pay happens in exactly ONE
+    C-level ``tolist`` over the whole block, not per element through
+    ``numpy.asarray`` round-trips per request (the pre-PR-10 shape of
+    this function)."""
+    if not isinstance(probs, numpy.ndarray):
+        probs = numpy.asarray(probs)
     single = probs.ndim == 1
-    block = probs[None] if single else probs
+    block = probs[None] if single else probs  # [None] is a view
     labels = block.argmax(axis=1)
-    mapping = labels_mapping or {}
-    named = [mapping.get(int(l), int(l)) for l in labels]
+    if labels_mapping:
+        named = [labels_mapping.get(int(label), int(label))
+                 for label in labels]
+    else:
+        named = labels.tolist()  # one vectorized box, no dict probes
     return {"result": named[0] if single or len(named) == 1 else named,
             "probabilities": block.tolist()}
 
 
 class ServeService(Logger):
-    """Tornado service over an :class:`AOTEngine` + batcher.
+    """Tornado service over an :class:`AOTEngine` + batcher, or a
+    whole :class:`ReplicaPool`.
 
-    ``batcher`` may be shared (the RESTful unit passes its own); when
-    None one is built from ``batcher_kwargs`` and owned (started and
-    stopped with the service)."""
+    ``engine`` may be a single AOT engine (``batcher`` optionally
+    shared — the RESTful unit passes its own; when None one is built
+    from ``batcher_kwargs`` and owned) or a :class:`ReplicaPool`, in
+    which case every request rides the pool's least-loaded router and
+    ``/healthz`` carries the per-replica state.  ``transport_port``
+    additionally opens the binary frame listener
+    (:mod:`veles_tpu.serve.transport`) beside the JSON front — same
+    batcher/pool, so JSON and binary clients co-batch."""
 
     def __init__(self, engine, batcher=None, port=0, path="/infer",
                  labels_mapping=None, executor_workers=64,
+                 transport_port=None, transport_secret=None,
                  **batcher_kwargs):
         super(ServeService, self).__init__()
-        self.engine = engine
-        self._owns_batcher = batcher is None
-        self.batcher = batcher if batcher is not None else \
-            ContinuousBatcher(engine, **batcher_kwargs)
+        from veles_tpu.serve.router import ReplicaPool
+        if isinstance(engine, ReplicaPool):
+            self.router = engine
+            self._engine = None
+            self._owns_batcher = True
+            self.batcher = engine  # same submit contract
+        else:
+            self.router = None
+            self._engine = engine
+            self._owns_batcher = batcher is None
+            self.batcher = batcher if batcher is not None else \
+                ContinuousBatcher(engine, **batcher_kwargs)
         self.path = path
         self.labels_mapping = labels_mapping or {}
         self.samples_served = 0
+        self.last_reload = None
         self._served_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
         self._executor = None
         self._executor_workers = int(executor_workers)
         self._server = None
         self._port = port
+        self._transport = None
+        self._transport_port = transport_port
+        self._transport_secret = transport_secret
+
+    @property
+    def engine(self):
+        """The (replica 0) engine — LIVE across hot reloads."""
+        return self.router.engine if self.router is not None \
+            else self._engine
+
+    @property
+    def compile_receipt(self):
+        source = self.router if self.router is not None else self.engine
+        return source.compile_receipt
 
     @property
     def port(self):
         return self._server.port if self._server is not None \
             else self._port
+
+    @property
+    def transport_port(self):
+        return self._transport.port if self._transport is not None \
+            else self._transport_port
 
     # -- request handling (executor thread) ---------------------------------
 
@@ -110,7 +157,67 @@ class ServeService(Logger):
             probs.append(req.result)
         with self._served_lock:
             self.samples_served += len(probs)
-        return format_result(numpy.stack(probs), self.labels_mapping)
+        # the results are views of per-batch host buffers (no
+        # per-request copies anywhere behind us); a single-row payload
+        # needs no stack at all — [None] is a view
+        block = probs[0][None] if len(probs) == 1 \
+            else numpy.stack(probs)
+        return format_result(block, self.labels_mapping)
+
+    # -- snapshot hot-reload ------------------------------------------------
+
+    def reload_snapshot(self, path):
+        """Swap the served model for a trained-workflow snapshot (the
+        crash-consistent pickles ``snapshotter.py`` writes) WITHOUT
+        dropping the queue; returns the reload receipt.  Triggered by
+        ``POST /reload {"snapshot": path}`` or SIGHUP (serve CLI)."""
+        from veles_tpu.workflow import restore_workflow
+        return self.reload_workflow(restore_workflow(path))
+
+    def reload_workflow(self, sw):
+        if self.router is not None:
+            receipt = self.router.reload_workflow(sw)
+        else:
+            from veles_tpu.serve.router import ReplicaPool
+            try:
+                plans, params, shape = ReplicaPool._workflow_spec(sw)
+            except ValueError:
+                plans, params, shape = ReplicaPool._workflow_spec(
+                    sw, self.engine.sample_shape)
+            receipt = self.reload(params, plans=plans,
+                                  sample_shape=shape)
+        self.last_reload = receipt
+        return receipt
+
+    def reload(self, params, plans=None, sample_shape=None):
+        """Snapshot hot-reload through the ONE shared state machine
+        (:func:`veles_tpu.serve.router.reload_replicas`): a same-digest
+        snapshot swaps weight buffers in place (zero recompiles), a
+        changed digest AOT-warms a new engine off the dispatch path
+        and cuts the batcher over between batches.  The single-engine
+        service is simply a fleet of one entry — same receipt, same
+        lock discipline, and the replacement engine inherits the
+        current one's ladder/dtype/cache_root so a later warm restart
+        still hits the configured cache."""
+        if self.router is not None:
+            receipt = self.router.reload(
+                params, plans=plans, sample_shape=sample_shape)
+            self.last_reload = receipt
+            return receipt
+        from veles_tpu.serve.router import Replica, reload_replicas
+        with self._reload_lock:
+            current = self.engine
+            entry = Replica(0, current.device, current, self.batcher)
+            receipt = reload_replicas(
+                [entry], params, plans=plans,
+                sample_shape=sample_shape,
+                engine_kwargs=dict(
+                    ladder=current.ladder, dtype=current.dtype,
+                    cache_root=current.cache_root,
+                    persistent_cache=current.cache_dir is not None))
+            self._engine = entry.engine
+        self.last_reload = receipt
+        return receipt
 
     # -- HTTP ---------------------------------------------------------------
 
@@ -151,13 +258,20 @@ class ServeService(Logger):
 
         class HealthHandler(RequestTimer, tornado.web.RequestHandler):
             def get(self):
-                self.write({
+                health = {
                     "status": "ok",
                     "model_digest": svc.engine.digest,
                     "ladder": list(svc.engine.ladder),
-                    "compile": svc.engine.compile_receipt,
+                    "compile": svc.compile_receipt,
                     "serve": serve_snapshot(),
-                })
+                }
+                if svc.router is not None:
+                    health["replicas"] = svc.router.snapshot()
+                if svc.transport_port is not None:
+                    health["transport_port"] = svc.transport_port
+                if svc.last_reload is not None:
+                    health["last_reload"] = svc.last_reload
+                self.write(health)
 
         class MetricsHandler(RequestTimer, tornado.web.RequestHandler):
             def get(self):
@@ -165,10 +279,37 @@ class ServeService(Logger):
                 self.write(json.dumps(_registry.snapshot(),
                                       default=repr))
 
+        class ReloadHandler(RequestTimer, tornado.web.RequestHandler):
+            async def post(self):
+                import asyncio
+                try:
+                    body = json.loads(self.request.body or b"{}")
+                    snapshot = body["snapshot"]
+                except Exception as exc:
+                    self.set_status(400)
+                    self.write({"error": "bad request (need "
+                                "{\"snapshot\": path}): %s" % exc})
+                    return
+                loop = asyncio.get_event_loop()
+                try:
+                    # blocking restore+reload off the IO loop: requests
+                    # keep serving while the new weights warm up
+                    receipt = await loop.run_in_executor(
+                        svc._executor, svc.reload_snapshot, snapshot)
+                except FileNotFoundError as exc:
+                    self.set_status(404)
+                    self.write({"error": str(exc)})
+                except Exception as exc:
+                    self.set_status(500)
+                    self.write({"error": str(exc)})
+                else:
+                    self.write(receipt)
+
         return tornado.web.Application([
             (self.path, InferHandler),
             (r"/healthz", HealthHandler),
             (r"/metrics.json", MetricsHandler),
+            (r"/reload", ReloadHandler),
         ])
 
     def start_background(self):
@@ -181,17 +322,28 @@ class ServeService(Logger):
             thread_name_prefix="serve-http")
         if self._owns_batcher:
             self.batcher.start()
+        if self._transport_port is not None:
+            from veles_tpu.serve.transport import BinaryTransportServer
+            self._transport = BinaryTransportServer(
+                self.batcher, port=self._transport_port,
+                secret=self._transport_secret)
+            self._transport.start_background()
         self._server = BackgroundHTTPServer(self._make_app(),
                                             port=self._port)
         thread = self._server.start()
         self.info("serve endpoint on http://127.0.0.1:%d%s "
-                  "(healthz, metrics.json)", self.port, self.path)
+                  "(healthz, metrics.json%s)", self.port, self.path,
+                  "; binary transport :%d" % self.transport_port
+                  if self._transport is not None else "")
         return thread
 
     def stop(self):
-        # order matters: close the listener (no new work), fail the
+        # order matters: close the listeners (no new work), fail the
         # batcher's pending requests (unblocks executor tasks), THEN
         # join the executor so no worker thread outlives the service
+        if self._transport is not None:
+            self._transport.stop()
+            self._transport = None
         if self._server is not None:
             self._server.stop()
             self._server = None

@@ -471,7 +471,11 @@ class StatusReporter(object):
             "health": health_snapshot(),
             # serving health (docs/serving.md): queue depth, SLO
             # violations, latency percentiles — populated only on
-            # processes that run the serve subsystem
+            # processes that run the serve subsystem.  Multi-replica
+            # servers (serve/router.py) add the replica count, the
+            # per-replica queue depths and the hot-reload count; the
+            # counters/percentiles are process-shared across replicas,
+            # so this one block is already the fleet aggregate
             "serve": serve_snapshot() or None,
             # elastic-fleet state (docs/distributed.md, "Elasticity
             # contract"): membership epoch, live/blacklisted/
