@@ -73,6 +73,42 @@ def layer_shapes(plans, state, input_shape, batch):
     return rows
 
 
+def schedule_provenance(plan, params, ish, osh, dtype):
+    """Tuned-vs-static provenance of the layer's backward kernel
+    schedule (docs/kernels.md "Autotuning"): "tuned" when the schedule
+    cache holds an entry the kernel's consult would serve for this
+    exact (padded shape, dtype, precision, device) — so a future
+    MFU.json regression is attributable to the schedule that actually
+    ran.  "autodiff" marks shapes the Pallas backward falls back on
+    (many-tap convs, overlapping-pool VMEM overflows have their own
+    plan); None = the layer has no Pallas-scheduled kernel (dense
+    layers run XLA's own dot inside the fused step)."""
+    from veles_tpu.tune.cache import provenance
+    from veles_tpu.tune.spec import conv_vjp_spec, pool_bwd_spec
+
+    name = plan.forward_cls.__name__
+    if "Conv" in name:
+        w = (params or {}).get("weights")
+        if w is None or len(getattr(w, "shape", ())) != 4:
+            return None
+        ky, kx = int(w.shape[0]), int(w.shape[1])
+        from veles_tpu.ops.conv_vjp import MAX_FUSED_TAPS
+        if ky * kx > MAX_FUSED_TAPS:
+            return "autodiff"
+        # precision_level 0 = what the fused step's gd units pass
+        spec = conv_vjp_spec(ish, ky, kx, osh[-1], osh[1:3], dtype, 0,
+                             plan.static.get("padding", (0, 0, 0, 0)),
+                             plan.static.get("sliding", (1, 1)))
+    elif ("Max" in name and "Abs" not in name
+          and "window" in plan.static):
+        spec = pool_bwd_spec(ish, osh[1:3], plan.static["window"],
+                             plan.static["sliding"], dtype)
+    else:
+        return None
+    return provenance(spec["op"], spec["shape"], spec["dtype"],
+                      spec["precision_level"], spec["extra"])
+
+
 def analytic_layer(name, in_shape, out_shape, param_bytes):
     """Forward FLOPs + training-step HBM traffic for one layer.
 
@@ -214,12 +250,20 @@ def main():
 
     peak_flops = PEAK_BF16_TFLOPS * 1e12
     bw = HBM_GBPS * 1e9
+    # a populated schedule cache means tuned tiles may be serving some
+    # layers' backward kernels: annotate each row with the schedule's
+    # provenance so a future MFU regression is attributable to the
+    # schedule that actually ran (docs/kernels.md "Autotuning")
+    from veles_tpu.tune.cache import cache_for
+    schedule_cache = cache_for()
+    annotate = len(schedule_cache) > 0
     layers = []
-    for name, ish, osh, pbytes in rows:
+    for (name, ish, osh, pbytes), plan, params in zip(
+            rows, plans, state):
         fl, tr = analytic_layer(name, ish, osh, pbytes)
         t_mxu = fl / peak_flops
         t_hbm = tr / bw
-        layers.append({
+        row = {
             "layer": name, "in": list(ish), "out": list(osh),
             "train_gflops": round(fl / 1e9, 2),
             "hbm_mbytes": round(tr / 1e6, 1),
@@ -227,7 +271,13 @@ def main():
             "t_hbm_us": round(t_hbm * 1e6, 1),
             "bound": ("mxu" if t_mxu > t_hbm else "hbm"),
             "roofline_us": round(max(t_mxu, t_hbm) * 1e6, 1),
-        })
+        }
+        if annotate:
+            prov = schedule_provenance(plan, params, ish, osh,
+                                       args.dtype)
+            if prov is not None:
+                row["schedule"] = prov
+        layers.append(row)
     total_roofline = sum(l["roofline_us"] for l in layers) / 1e6
 
     report = {
@@ -238,6 +288,8 @@ def main():
         "layers": layers,
         "roofline_total_ms": round(total_roofline * 1e3, 2),
     }
+    if annotate:
+        report["config"]["schedule_cache"] = schedule_cache.path
 
     if not args.skip_measure:
         sys.path.insert(0, os.path.dirname(os.path.dirname(

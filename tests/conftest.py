@@ -94,6 +94,18 @@ def _flight_dumps_to_tmp(tmp_path, monkeypatch):
                         str(tmp_path / "veles_flight"))
 
 
+@pytest.fixture(autouse=True)
+def _schedule_cache_to_tmp(tmp_path, monkeypatch):
+    """The kernels consult the tuned schedule cache on every
+    ``blocks=None`` call (ops/matmul.py, conv_vjp.py, pool_bwd.py) —
+    a developer's real cache under ~/.cache would silently change the
+    tiles (and thus the f32 accumulation grouping) every numeric
+    parity test runs with.  Tests always see a private empty cache;
+    the ones that WANT entries plant them here."""
+    monkeypatch.setenv("VELES_SCHEDULE_CACHE",
+                       str(tmp_path / "schedule_cache"))
+
+
 @pytest.fixture
 def cpu_device():
     from veles_tpu.backends import Device

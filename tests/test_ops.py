@@ -194,49 +194,53 @@ class TestXorshift:
         numpy.testing.assert_array_equal(u, u2)  # deterministic per seed
 
 
-def test_autotune_matmul_round_robin_picks_and_persists(tmp_path):
+def _matmul_256_digest():
+    """The schedule-cache key autotune_matmul uses for size=256 on the
+    test chip kind — built through the SAME spec builder the consult
+    path uses, so the test can't drift from the implementation."""
+    from veles_tpu.tune.cache import schedule_key
+    from veles_tpu.tune.spec import matmul_spec
+    spec = matmul_spec(256, 256, 256, "float32", 0)
+    return schedule_key(spec["op"], spec["shape"], spec["dtype"],
+                        spec["precision_level"], "test-chip-kind",
+                        spec["extra"])
+
+
+def test_autotune_matmul_round_robin_picks_and_persists():
     """The autotuner measures candidates round-robin (congestion drift
     hits every tile equally), picks a majority-positive-median winner,
-    and persists it under the versioned key — or falls back to the
-    defaults WITHOUT persisting when timing jitter swamps every tile."""
+    and persists it in the digest-keyed ScheduleCache — or falls back
+    to the defaults WITHOUT persisting when timing jitter swamps every
+    tile.  (The conftest autouse fixture gives this test a private
+    empty cache.)"""
     from veles_tpu.backends import DeviceInfo
-    from veles_tpu.config import root
-    from veles_tpu.ops.matmul import (_DEFAULT_BLOCKS,
-                                       MATMUL_KERNEL_VERSION,
-                                       autotune_matmul)
+    from veles_tpu.ops.matmul import _DEFAULT_BLOCKS, autotune_matmul
+    from veles_tpu.tune.cache import cache_for
 
-    saved = root.common.dirs.cache
-    root.common.dirs.cache = str(tmp_path)
-    try:
-        info = DeviceInfo("test-chip-kind")
-        key = "matmul:v%d:float32:pl0:s256" % MATMUL_KERNEL_VERSION
-        blocks = autotune_matmul(info, size=256)
-        assert len(blocks) == 3 and all(b > 0 for b in blocks)
-        if info.get(key) is not None:  # a tile was ranked
-            assert info.get(key) == list(blocks)
-        else:  # all-jitter fallback: defaults, deliberately unpersisted
-            assert blocks == _DEFAULT_BLOCKS
-    finally:
-        root.common.dirs.cache = saved
+    info = DeviceInfo("test-chip-kind")
+    blocks = autotune_matmul(info, size=256)
+    assert len(blocks) == 3 and all(b > 0 for b in blocks)
+    digest, _ = _matmul_256_digest()
+    entry = cache_for().get(digest)
+    if entry is not None:  # a tile was ranked
+        assert tuple(entry["schedule"]["blocks"]) == tuple(blocks)
+        assert entry["source"] == "sweep"
+    else:  # all-jitter fallback: defaults, deliberately unpersisted
+        assert blocks == _DEFAULT_BLOCKS
 
 
-def test_autotune_matmul_cache_hit_skips_measurement(tmp_path):
+def test_autotune_matmul_cache_hit_skips_measurement():
     """A persisted entry is served verbatim — no timing runs."""
     from veles_tpu.backends import DeviceInfo
-    from veles_tpu.config import root
-    from veles_tpu.ops.matmul import (MATMUL_KERNEL_VERSION,
-                                       autotune_matmul)
+    from veles_tpu.ops.matmul import autotune_matmul
+    from veles_tpu.tune.cache import cache_for
 
-    saved = root.common.dirs.cache
-    root.common.dirs.cache = str(tmp_path)
-    try:
-        info = DeviceInfo("test-chip-kind")
-        key = "matmul:v%d:float32:pl0:s256" % MATMUL_KERNEL_VERSION
-        sentinel = [128, 128, 128]  # not a real candidate: proves the
-        info.put(key, sentinel)     # value came from the cache
-        assert autotune_matmul(info, size=256) == tuple(sentinel)
-    finally:
-        root.common.dirs.cache = saved
+    info = DeviceInfo("test-chip-kind")
+    digest, payload = _matmul_256_digest()
+    sentinel = [128, 128, 128]  # not a real candidate: proves the
+    cache_for().put(digest, payload,  # value came from the cache
+                    {"blocks": sentinel}, source="test")
+    assert autotune_matmul(info, size=256) == tuple(sentinel)
 
 
 def test_estimate_computing_power_positive():

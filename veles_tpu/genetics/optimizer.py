@@ -32,7 +32,8 @@ class GeneticsOptimizer(Logger):
 
     def __init__(self, spec, fitness_fn, generations=5, population=12,
                  workers=0, farm_slaves=0, farm_address="127.0.0.1:0",
-                 rng=None, **population_kwargs):
+                 rng=None, batch_fitness_fn=None, memoize_fitness=True,
+                 **population_kwargs):
         super(GeneticsOptimizer, self).__init__()
         self.spec = spec
         self.fitness_fn = fitness_fn
@@ -40,6 +41,22 @@ class GeneticsOptimizer(Logger):
         self.workers = workers
         self.farm_slaves = farm_slaves
         self.farm_address = farm_address
+        #: optional whole-generation evaluator ``fn(specs) -> [fitness]``
+        #: for fitness functions that must see a generation's candidates
+        #: TOGETHER (the schedule autotuner's interleaved round-robin
+        #: timing: one sample of every candidate per pass, so a
+        #: congestion window cannot crown the wrong candidate).  Ignored
+        #: on the farm/process-pool paths, which are per-candidate by
+        #: construction.
+        self.batch_fitness_fn = batch_fitness_fn
+        #: evolve() produces duplicates of already-scored genomes
+        #: (elitism copies keep their fitness, but crossover routinely
+        #: recreates a parent when both picks agree on a segment) — the
+        #: values-keyed memo serves those for free, so a duplicate
+        #: genome never pays a second evaluation (for the autotuner:
+        #: never a second kernel compile)
+        self.memoize_fitness = memoize_fitness
+        self._fitness_memo = {}
         self.tunes = extract_tunes(spec)
         if not self.tunes:
             raise ValueError("spec contains no Tune markers")
@@ -65,9 +82,29 @@ class GeneticsOptimizer(Logger):
         from veles_tpu.jobfarm import farm_enabled
         return farm_enabled(self.farm_slaves, self.farm_address)
 
+    @staticmethod
+    def _genome_key(chromosome):
+        return tuple(float(v) for v in chromosome.values)
+
     def _evaluate_all(self):
         pending = self.population.unevaluated()
-        specs = [self.candidate_spec(c) for c in pending]
+        if self.memoize_fitness:
+            # serve memo hits, then collapse the remainder onto one
+            # representative per DISTINCT genome (within-batch
+            # duplicates are also free)
+            groups = {}
+            for chromo in pending:
+                key = self._genome_key(chromo)
+                memoized = self._fitness_memo.get(key)
+                if memoized is not None:
+                    chromo.fitness = memoized
+                else:
+                    groups.setdefault(key, []).append(chromo)
+            reps = [chromos[0] for chromos in groups.values()]
+        else:
+            groups = None
+            reps = pending
+        specs = [self.candidate_spec(c) for c in reps]
         if self.farm_enabled and specs:
             # ONE farm for the whole optimization: remote workers stay
             # connected between generations (a fresh server per batch
@@ -79,14 +116,23 @@ class GeneticsOptimizer(Logger):
                     address=self.farm_address,
                     local_slaves=self.farm_slaves)
             fits = self._farm.submit(specs)
-        elif self.workers and len(pending) > 1:
+        elif self.workers and len(reps) > 1:
             with concurrent.futures.ProcessPoolExecutor(
                     max_workers=self.workers) as pool:
                 fits = list(pool.map(self.fitness_fn, specs))
+        elif self.batch_fitness_fn is not None:
+            fits = list(self.batch_fitness_fn(specs)) if specs else []
         else:
             fits = [self.fitness_fn(spec) for spec in specs]
-        for chromo, fitness in zip(pending, fits):
-            chromo.fitness = float(fitness)
+        for chromo, fitness in zip(reps, fits):
+            fitness = float(fitness)
+            if groups is None:
+                chromo.fitness = fitness
+                continue
+            key = self._genome_key(chromo)
+            self._fitness_memo[key] = fitness
+            for duplicate in groups[key]:
+                duplicate.fitness = fitness
 
     def run(self):
         """Returns (best_spec, best_fitness)."""

@@ -221,6 +221,13 @@ _HEALTH_KEYS = (
     ("bwd.step_ms", "bwd_step_ms"),
     ("compile.count", "compiles"),
     ("compile.recompiles", "recompiles"),
+    # schedule autotuner (veles_tpu/tune/): cache traffic + candidate
+    # evaluations ride heartbeats so a tuning run (or a cold cache on
+    # a fresh pod) is visible in the same post-mortem surface; the
+    # per-generation detail is the tune.generation trace spans
+    ("tune.cache_hits", "tune_cache_hits"),
+    ("tune.cache_misses", "tune_cache_misses"),
+    ("tune.evals", "tune_evals"),
 )
 
 

@@ -59,7 +59,14 @@ from veles_tpu.ops.common import (ceil_mult, interpret_for,
                                    tpu_compiler_params, unpad)
 
 __all__ = ["fused_conv_vjp", "conv_act", "activation_grad",
-           "ACTIVATIONS", "MAX_FUSED_TAPS"]
+           "ACTIVATIONS", "MAX_FUSED_TAPS",
+           "CONV_VJP_KERNEL_VERSION"]
+
+#: bump when the wgrad kernel's algorithm changes: tuned schedules in
+#: the cache are only valid for the algorithm they were measured on
+#: (the version rides the schedule-cache digest, so old entries become
+#: misses, never silently-served stale tiles)
+CONV_VJP_KERNEL_VERSION = 1
 
 #: kernels with more taps than this keep the autodiff VJP: the per-tap
 #: slice stack would multiply activation traffic past any MXU cover
@@ -332,6 +339,10 @@ def fused_conv_vjp(x, w, y, err_output, *, activation="linear",
             x, w, y, err_output, activation=activation, padding=padding,
             sliding=sliding, include_bias=include_bias,
             need_err_input=need_err_input)
+    if blocks is None:
+        blocks = _tuned_blocks(x, ky, kx, oh, ow, err_output,
+                               precision_level, activation, padding,
+                               sliding)
     grad_w, grad_b, err = _fused_wgrad_jit(
         x, y, err_output, activation, ky, kx, (oh, ow),
         tuple(padding), tuple(sliding), precision_level, blocks,
@@ -347,6 +358,30 @@ def fused_conv_vjp(x, w, y, err_output, *, activation="linear",
         _debug_check(x, w, err_output, grad_w, grad_b, err_input,
                      precision_level)
     return err_input, grad_w, grad_b
+
+
+def _tuned_blocks(x, ky, kx, oh, ow, err_output, precision_level,
+                  activation, padding, sliding):
+    """Schedule-cache consult for a ``blocks=None`` call: the tuned
+    (bi, bj, bk) wgrad tiles for this (taps, padded P/Cin/Cout, dtype,
+    precision, device) or None (-> ``_DEFAULT_BLOCKS``).  Padding/
+    sliding/activation ride the recorded raw context only — the wgrad
+    contraction's grid depends on the padded shape alone.  Tracer-safe
+    (shapes/dtypes only), so the consult fires at trace time inside
+    the fused step — which is how ``tune/walk.py`` records it."""
+    from veles_tpu.tune.cache import schedule_for
+    from veles_tpu.tune.spec import conv_vjp_spec, valid_schedule
+    spec = conv_vjp_spec(
+        x.shape, ky, kx, err_output.shape[-1], (oh, ow),
+        jnp.dtype(x.dtype).name, precision_level, padding, sliding,
+        activation)
+    schedule = schedule_for(spec["op"], spec["shape"], spec["dtype"],
+                            spec["precision_level"], spec["extra"],
+                            raw=spec["raw"])
+    if schedule is None:
+        return None
+    normalized = valid_schedule("conv_vjp", schedule)
+    return tuple(normalized["blocks"]) if normalized else None
 
 
 def _autodiff_conv_vjp(x, w, y, err_output, *, activation, padding,

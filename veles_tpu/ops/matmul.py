@@ -120,6 +120,12 @@ def matmul(a, b, precision_level=0, blocks=None, out_dtype=None):
     on a TPU-default host must interpret), which is invisible once
     everything is a tracer inside one jit.
 
+    ``blocks=None`` consults the tuned schedule cache (docs/kernels.md
+    "Autotuning": digest-keyed per padded shape/dtype/precision/device)
+    before falling back to the static ``_DEFAULT_BLOCKS`` — tiles
+    change the SCHEDULE, never the math, and a corrupt cache entry
+    degrades to the static table with a warning.
+
     Debug guard (docs/health.md): set ``VELES_DEBUG_NONFINITE=1`` and
     every eager call validates its output, raising FloatingPointError
     with per-operand stats when inf/NaN appears — the level-0 bf16x3
@@ -128,6 +134,8 @@ def matmul(a, b, precision_level=0, blocks=None, out_dtype=None):
     The check forces a device sync per call, so it is opt-in and for
     debugging only.
     """
+    if blocks is None:
+        blocks = _tuned_blocks(a, b, precision_level)
     out = _matmul_jit(a, b, precision_level, blocks, out_dtype,
                       interpret_for(a, b))
     # read live from ops.common — ONE patch point for every kernel's
@@ -135,6 +143,33 @@ def matmul(a, b, precision_level=0, blocks=None, out_dtype=None):
     if _common.DEBUG_NONFINITE:
         _debug_check_finite(a, b, out, precision_level)
     return out
+
+
+def _tuned_blocks(a, b, precision_level):
+    """Schedule-cache consult for a ``blocks=None`` call: the tuned
+    (bm, bn, bk) for this (padded shape, dtype, precision, device) or
+    None (-> ``_DEFAULT_BLOCKS``).  Works on tracers too — only shapes
+    and dtypes are read — so the consult happens at TRACE time inside
+    an outer jit (e.g. the fused train step's lowering, which is how
+    ``tune/walk.py`` records the shapes a step actually uses)."""
+    if (getattr(a, "ndim", None) != 2 or getattr(b, "ndim", None) != 2
+            or a.shape[1] != b.shape[0]):
+        return None
+    m, k = a.shape
+    n = b.shape[1]
+    if not (m and k and n):
+        return None
+    from veles_tpu.tune.cache import schedule_for
+    from veles_tpu.tune.spec import matmul_spec, valid_schedule
+    spec = matmul_spec(m, k, n, jnp.dtype(a.dtype).name,
+                       precision_level)
+    schedule = schedule_for(spec["op"], spec["shape"], spec["dtype"],
+                            spec["precision_level"], spec["extra"],
+                            raw=spec["raw"])
+    if schedule is None:
+        return None
+    normalized = valid_schedule("matmul", schedule)
+    return tuple(normalized["blocks"]) if normalized else None
 
 
 def _operand_stats(name, x):
@@ -214,8 +249,11 @@ def _matmul_jit(a, b, precision_level, blocks, out_dtype, interpret):
 def _chain_slope(mm, a, repeats):
     """One (chain(repeats+1) - chain(1)) / repeats slope sample over
     dependent ``acc = mm(acc)`` chains ended by a scalar fetch — the
-    single shared definition of the matmul timing methodology (the
-    benchmark facade and the autotuner must never drift apart)."""
+    benchmark facade's sampling.  The autotuner runs the SAME chains
+    through ``tune/measure.py`` (``slope_sample`` over the matmul
+    family's dependent-chain runner), so the two cannot drift on
+    methodology; this local helper only serves ``matmul_benchmark``'s
+    one-shot power-rating path."""
     import time
 
     def chain(n):
@@ -269,104 +307,71 @@ def matmul_benchmark(size=3001, dtype=jnp.float32, precision_level=0,
 def autotune_matmul(device_info, size=2048, dtype=jnp.float32,
                     precision_level=0):
     """Pick the best block config for this chip and persist it
-    (analog of reference backends.py:672-731 _find_optimal_bs_vo)."""
-    # the key carries the tuning size (tile optima don't transfer
-    # between shapes) and the kernel version (optima measured on an
-    # old algorithm must never serve a new one)
-    key = "matmul:v%d:%s:pl%d:s%d" % (
-        MATMUL_KERNEL_VERSION, jnp.dtype(dtype).name,
-        precision_level, size)
-    cached = device_info.get(key)
-    if cached is not None:
-        return tuple(cached)
-    # deep-K tiles matter most on the MXU: K is the "arbitrary" grid
-    # axis, so a bigger bk means fewer accumulator round-trips.  Tiles
-    # whose VMEM footprint exceeds the chip fail to compile and are
-    # skipped (measured on v5e: bf16 best = (512, 512, 1024), ~1.7x
-    # over (256, 256, 256)).
-    candidates = [(256, 256, 256), (512, 512, 512), (512, 512, 1024),
-                  (512, 512, 2048), (256, 256, 1024), (512, 1024, 512),
-                  (1024, 512, 512), (256, 512, 1024)]
-    if jnp.dtype(dtype) == jnp.float32 and precision_level in (0, 1):
-        # taller-M / wider-N tiles for the f32 paths (level 0's three
-        # bf16 dots per K-step and level 1's six-pass HIGHEST products
-        # + Kahan both shift the VMEM/compute balance away from the
-        # square default): a (768, 512, 512) tile measured ~1.25x over
-        # (512, 512, 512) at 3001^2 on v5e for level 0, round-robin-
-        # validated against congestion.  bf16/level 2 skip them — each
-        # extra tile costs a fresh compile + 5 timing samples on a
-        # cold cache.
-        candidates += [(768, 512, 512), (640, 512, 512),
-                       (512, 640, 512), (512, 640, 640)]
-    # at small sizes several tiles clamp to the same effective blocks
-    # inside the kernel — benchmark each distinct clamped shape once
-    seen, distinct = set(), []
-    for bm, bn, bk in candidates:
-        clamped = (min(bm, ceil_mult(size, 8)),
-                   min(bn, ceil_mult(size, 128)),
-                   min(bk, ceil_mult(size, 128)))
-        if clamped not in seen:
-            seen.add(clamped)
-            distinct.append((bm, bn, bk))
-    # ROUND-ROBIN measurement: whole-chip congestion drifts minute to
-    # minute (measured ~1.4x swings with tight within-run spreads), so
-    # timing each tile's samples back to back lets a congestion window
-    # crown the wrong tile.  Interleaving one sample of every tile per
-    # round spreads the drift across all candidates equally; the
-    # median over rounds then ranks honestly.  Operands are built once
-    # — a per-sample host->device upload would dominate the chains on
-    # a tunneled chip.
-    import numpy as _numpy
-    a = jnp.asarray(
-        (_numpy.random.RandomState(13).rand(size, size) - 0.5) * 0.01,
-        dtype=dtype)
+    (analog of reference backends.py:672-731 _find_optimal_bs_vo).
 
-    def make_mm(blocks):
-        def mm(x):
-            return matmul(x, a, precision_level=precision_level,
-                          blocks=blocks)
-        return mm
+    Rewired onto the shared tune machinery (ONE measurement
+    discipline, ONE persistence path, docs/kernels.md "Autotuning"):
+    the curated candidate list lives in
+    ``tune.spec.matmul_seed_candidates`` — where it also seeds the
+    GA's population — and the sweep runs through
+    ``tune.autotune.sweep_candidates``: round-robin interleaved
+    chain-slope samples (whole-chip congestion drifts minute to
+    minute, ~1.4x swings measured; timing each tile's samples back to
+    back lets a congestion window crown the wrong tile), ranked under
+    the positive-majority-median rule (a floor-clamped nonsense slope
+    once crowned the wrong tile and published an impossible rate).
+    VMEM-overflow tiles fail at the warm-up compile and are skipped.
+    The winner persists in the digest-keyed ScheduleCache — the SAME
+    entry ``matmul()`` consults for ``blocks=None`` calls of this
+    padded shape — keyed by padded shape (tile optima don't transfer
+    between shapes) and kernel version (optima measured on an old
+    algorithm must never serve a new one).  When every tile's timing
+    is jitter-swamped: fall back to ``_DEFAULT_BLOCKS`` and do NOT
+    persist."""
+    from veles_tpu.tune.autotune import sweep_candidates
+    from veles_tpu.tune.cache import cache_for, schedule_key
+    from veles_tpu.tune.spec import (matmul_seed_candidates,
+                                     matmul_spec, valid_schedule)
 
+    dtype_name = jnp.dtype(dtype).name
+    spec = matmul_spec(size, size, size, dtype_name, precision_level)
+    kind = device_info.device_kind
+    digest, payload = schedule_key(
+        spec["op"], spec["shape"], spec["dtype"],
+        spec["precision_level"], kind, spec["extra"])
+    cache = cache_for()
+    entry = cache.get(digest)
+    if entry is not None:
+        normalized = valid_schedule("matmul", entry["schedule"])
+        if normalized is not None:
+            return tuple(normalized["blocks"])
+    # the shipped per-chip table (devices/device_infos.json, the old
+    # persistence path) still holds measured winners for the headline
+    # sizes — migrate a hit into the schedule cache instead of paying
+    # a fresh sweep on every fresh host
+    legacy = device_info.get("matmul:v%d:%s:pl%d:s%d" % (
+        MATMUL_KERNEL_VERSION, dtype_name, precision_level, size))
+    if legacy is not None:
+        normalized = valid_schedule(
+            "matmul", {"blocks": [int(b) for b in legacy]})
+        if normalized is not None:
+            cache.put(digest, payload, normalized,
+                      source="device_info")
+            return tuple(normalized["blocks"])
+    candidates = [{"blocks": list(c)} for c in
+                  matmul_seed_candidates(dtype_name, precision_level)]
     # repeats=24: short chains (~8) can INVERT tile rankings on a
     # tunneled chip — a config measured 192 TF over 20-step chains
     # sustained only 86 TF over 100-step ones while the true winner
     # sustained 135
-    repeats, rounds = 24, 5
-    mms = {}
-    for blocks in distinct:
-        try:
-            mm = make_mm(blocks)
-            float(mm(a)[0, 0].astype(jnp.float32))  # compile + warm;
-            mms[blocks] = mm   # VMEM-overflow tiles fail here
-        except Exception:
-            continue
-    samples = {blocks: [] for blocks in mms}
-    for _ in range(rounds):
-        for blocks, mm in mms.items():
-            try:
-                samples[blocks].append(_chain_slope(mm, a, repeats))
-            except Exception:
-                continue
-    best, best_time = None, float("inf")
-    for blocks, slopes in samples.items():
-        # the median runs over ALL samples and must be positive with a
-        # positive MAJORITY: filtering negatives first would let a
-        # jitter-swamped tile win on its two tiny surviving samples —
-        # the nonsense-slope crowning this function exists to prevent
-        positive = sum(1 for s in slopes if s > 0)
-        if not slopes or positive < len(slopes) // 2 + 1:
-            continue
-        med = float(_numpy.median(slopes))
-        if med <= 0:
-            continue
-        if med < best_time:
-            best, best_time = blocks, med
+    best, _ranking = sweep_candidates(
+        spec, candidates, repeats=24, rounds=5, device_kind=kind,
+        cache=cache)
     if best is None:
         import logging
         logging.getLogger("veles_tpu.autotune").warning(
             "autotune_matmul: no tile produced a positive timing "
             "slope (size=%d dtype=%s); falling back to %s and NOT "
-            "persisting", size, jnp.dtype(dtype).name, _DEFAULT_BLOCKS)
+            "persisting", size, dtype_name, _DEFAULT_BLOCKS)
         return _DEFAULT_BLOCKS
-    device_info.put(key, list(best))
-    return best
+    return tuple(best["blocks"])

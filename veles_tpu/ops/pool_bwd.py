@@ -42,7 +42,13 @@ from jax.experimental.pallas import tpu as pltpu
 from veles_tpu.ops.common import (ceil_mult, interpret_for, pad_to,
                                    tpu_compiler_params, unpad)
 
-__all__ = ["max_pool_bwd", "max_pool", "POOL_VMEM_BUDGET_BYTES"]
+__all__ = ["max_pool_bwd", "max_pool", "POOL_VMEM_BUDGET_BYTES",
+           "POOL_BWD_KERNEL_VERSION", "pool_block_footprint"]
+
+#: bump when the select-and-scatter kernel's algorithm changes: tuned
+#: W-tilings in the schedule cache are keyed to the algorithm they
+#: were measured on (stale versions miss, never serve)
+POOL_BWD_KERNEL_VERSION = 1
 
 #: per-grid-step VMEM budget for the pool blocks (x + y + dy + out +
 #: f32 accumulator); overlapping-window shapes that exceed it keep the
@@ -84,20 +90,47 @@ def _pool_bwd_kernel(x_ref, y_ref, dy_ref, out_ref, *, window, sliding,
     out_ref[0] = acc[:in_h, :in_w, :].astype(out_ref.dtype)
 
 
-def _plan_blocks(h, w_sp, c, oh, ow, window, sliding, itemsize):
+def pool_block_footprint(h, c, oh, owb, window, sliding, itemsize):
+    """VMEM bytes of one (image, W-tile) grid step: padded x block +
+    y/dy blocks + out block + the f32 accumulator.  The ONE footprint
+    formula — the kernel's planner below and the autotuner's
+    feasibility gate (tune/spec.py) both call it, so they cannot
+    drift when the block layout changes."""
+    ky, kx = window
+    sx, _sy = sliding
+    cb = ceil_mult(c, 128)
+    wb = (owb - 1) * sx + kx
+    elems = ((h + ky) * wb            # padded x block
+             + 2 * oh * owb           # y + dy
+             + h * wb)                # out
+    return elems * cb * itemsize + (h + ky) * wb * cb * 4  # f32 acc
+
+
+def _plan_blocks(h, w_sp, c, oh, ow, window, sliding, itemsize,
+                 owb_override=None):
     """(w-tiles, ow-block) fitting POOL_VMEM_BUDGET_BYTES, or None when
-    the shape cannot tile (overlapping windows need the full W span)."""
+    the shape cannot tile (overlapping windows need the full W span).
+
+    ``owb_override`` is a TUNED W block (docs/kernels.md
+    "Autotuning"): honored only where halo-free tiling exists
+    (kx == sx, ky == sy) and the footprint fits the budget; an
+    infeasible/stale override logs a warning and falls back to the
+    static plan — it can never overflow VMEM or crash the call."""
     ky, kx = window
     sx, sy = sliding
-    cb = ceil_mult(c, 128)
 
     def footprint(owb):
-        wb = (owb - 1) * sx + kx
-        elems = ((h + ky) * wb            # padded x block
-                 + 2 * oh * owb           # y + dy
-                 + h * wb)                # out
-        return elems * cb * itemsize + (h + ky) * wb * cb * 4  # f32 acc
+        return pool_block_footprint(h, c, oh, owb, window, sliding,
+                                    itemsize)
 
+    if (owb_override and 0 < owb_override < ow
+            and kx == sx and ky == sy):
+        if footprint(owb_override) <= POOL_VMEM_BUDGET_BYTES:
+            return -(-ow // owb_override), owb_override
+        import logging
+        logging.getLogger("veles_tpu.tune").warning(
+            "tuned pool W block owb=%d exceeds the VMEM budget for "
+            "this shape; using the static plan", owb_override)
     if footprint(ow) <= POOL_VMEM_BUDGET_BYTES:
         return 1, ow
     if kx != sx or ky != sy:
@@ -111,8 +144,8 @@ def _plan_blocks(h, w_sp, c, oh, ow, window, sliding, itemsize):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("window", "sliding", "interpret"))
-def _max_pool_bwd_jit(x, y, dy, window, sliding, interpret):
+    jax.jit, static_argnames=("window", "sliding", "interpret", "owb"))
+def _max_pool_bwd_jit(x, y, dy, window, sliding, interpret, owb=None):
     from jax import lax
     ky, kx = window
     sx, sy = sliding
@@ -120,7 +153,7 @@ def _max_pool_bwd_jit(x, y, dy, window, sliding, interpret):
     oh, ow = y.shape[1], y.shape[2]
 
     plan = _plan_blocks(h, w_sp, c, oh, ow, window, sliding,
-                        jnp.dtype(x.dtype).itemsize)
+                        jnp.dtype(x.dtype).itemsize, owb_override=owb)
     if plan is None:
         # VMEM-infeasible overlapping shape: stock autodiff routing
         from veles_tpu.models.pooling import MaxPooling
@@ -180,15 +213,40 @@ def _max_pool_bwd_jit(x, y, dy, window, sliding, interpret):
     return unpad(out, (n, h, w_sp, c))
 
 
-def max_pool_bwd(x, y, err_output, *, window, sliding):
+def max_pool_bwd(x, y, err_output, *, window, sliding, owb=None):
     """err_input for max pooling via the scheduled select-and-scatter
     kernel: ``x`` the forward input, ``y`` the forward output (the
     window maxima — no recompute), ``err_output`` the incoming
-    cotangent.  Returns err_input in ``x.dtype``."""
+    cotangent.  Returns err_input in ``x.dtype``.
+
+    ``owb=None`` consults the tuned schedule cache for a W-tiling
+    override (docs/kernels.md "Autotuning"); an explicit ``owb``
+    bypasses the consult (the tuner's own candidate measurements)."""
+    window = (int(window[0]), int(window[1]))
+    sliding = (int(sliding[0]), int(sliding[1]))
+    if owb is None:
+        owb = _tuned_owb(x, y, window, sliding)
     return _max_pool_bwd_jit(x, y, err_output.astype(x.dtype),
-                             (int(window[0]), int(window[1])),
-                             (int(sliding[0]), int(sliding[1])),
-                             interpret_for(x, err_output))
+                             window, sliding,
+                             interpret_for(x, err_output), owb)
+
+
+def _tuned_owb(x, y, window, sliding):
+    """Schedule-cache consult: the tuned output-width block for this
+    pool shape or None (-> the static ``_plan_blocks`` plan).
+    Tracer-safe — shapes only — so it fires at trace time inside the
+    fused step (``tune/walk.py`` records it there)."""
+    from veles_tpu.tune.cache import schedule_for
+    from veles_tpu.tune.spec import pool_bwd_spec, valid_schedule
+    spec = pool_bwd_spec(x.shape, (y.shape[1], y.shape[2]), window,
+                         sliding, jnp.dtype(x.dtype).name)
+    schedule = schedule_for(spec["op"], spec["shape"], spec["dtype"],
+                            spec["precision_level"], spec["extra"],
+                            raw=spec["raw"])
+    if schedule is None:
+        return None
+    normalized = valid_schedule("pool_bwd", schedule)
+    return normalized["owb"] if normalized else None
 
 
 # -- custom_vjp forward wrapper ---------------------------------------------

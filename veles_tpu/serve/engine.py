@@ -241,6 +241,15 @@ class AOTEngine(Logger):
             seconds=round(elapsed, 4),
             cache_dir=self.cache_dir,
         )
+        try:
+            # tuned-schedule provenance beside the compile-cache
+            # receipt: which road the kernel tiles took during this
+            # warm-up (docs/kernels.md "Autotuning") — consult counters
+            # plus the schedule-cache population
+            from veles_tpu.tune.cache import tune_counters
+            self.compile_receipt["tune"] = tune_counters()
+        except Exception:
+            pass  # a broken schedule cache must never fail a warm-up
         _registry.gauge("serve.aot_rungs").set(len(self.ladder))
         _registry.gauge("serve.compile_s").set(round(elapsed, 4))
         self.info(

@@ -1,0 +1,33 @@
+"""veles_tpu.tune — genetics-driven Pallas schedule autotuner.
+
+The pieces (docs/kernels.md, "Autotuning"):
+
+- ``tune.cache`` — the digest-keyed on-disk :class:`ScheduleCache` the
+  kernels consult (beside the XLA compile cache), plus the
+  ``record_specs`` walk hook and the ``tune.*`` counters;
+- ``tune.spec`` — per-kernel-family search spaces (Tune markers),
+  MXU-legal quantization, VMEM feasibility, the shared cache-key spec
+  builders;
+- ``tune.measure`` — the ONE timing discipline (pass filtering,
+  positive-majority ranking, interleaved round-robin sampling) shared
+  with bench.py and ``autotune_matmul``;
+- ``tune.autotune`` — the GA driver (:class:`ScheduleTuner`) and the
+  plain curated sweep (:func:`sweep_candidates`);
+- ``tune.walk`` — spec harvesting from a fused step's lowering;
+- ``python -m veles_tpu.tune`` — tune the shapes a zoo model actually
+  uses and commit a ``TUNE.json`` receipt.
+"""
+
+from veles_tpu.tune.cache import (  # noqa: F401
+    ScheduleCache, cache_for, default_cache_dir, provenance,
+    record_specs, schedule_for, schedule_key, tune_counters)
+from veles_tpu.tune.measure import filter_passes  # noqa: F401
+from veles_tpu.tune.spec import (  # noqa: F401
+    FAMILIES, conv_vjp_spec, family_for, matmul_spec, pool_bwd_spec,
+    valid_schedule)
+
+__all__ = ["ScheduleCache", "cache_for", "default_cache_dir",
+           "provenance", "record_specs", "schedule_for",
+           "schedule_key", "tune_counters", "filter_passes",
+           "FAMILIES", "family_for", "matmul_spec", "conv_vjp_spec",
+           "pool_bwd_spec", "valid_schedule"]

@@ -1,0 +1,326 @@
+"""Digest-keyed persistent schedule cache (docs/kernels.md,
+"Autotuning").
+
+The tuner's winners live beside the XLA compile cache: one JSON table
+under ``<cache-dir>/schedule_cache/`` (``VELES_SCHEDULE_CACHE``
+overrides the directory) mapping a sha256 digest of
+
+    (op name, padded shape tuple, dtype, precision level,
+     device kind, jax version, kernel version)
+
+to the winning schedule — tile/grid parameters ONLY, never anything
+that changes math (the precision level is part of the KEY: a schedule
+tuned at level 0 can never serve a level-1 call).  The kernel version
+rides the digest so optima measured on an old algorithm are a MISS for
+a new one, exactly like ``MATMUL_KERNEL_VERSION`` gated the old
+DeviceInfo table.
+
+``schedule_for`` is the kernels' consult hook (``ops/matmul.py``,
+``ops/conv_vjp.py``, ``ops/pool_bwd.py``): an in-memory table lookup
+after one lazy disk load, counted as ``tune.cache_hits`` /
+``tune.cache_misses``.  A corrupt or stale entry is a logged WARNING
+and a miss — the static ``_DEFAULT_BLOCKS`` tables stay the fallback,
+a bad cache can never crash a kernel call.  Under a
+:func:`record_specs` context every consult also records its full spec,
+which is how ``tune/walk.py`` harvests the shapes a fused step's
+lowering actually uses.
+"""
+
+import functools
+import hashlib
+import json
+import logging
+import os
+import threading
+
+__all__ = ["ScheduleCache", "schedule_key", "schedule_for",
+           "provenance", "cache_for", "default_cache_dir",
+           "record_specs", "tune_counters", "SCHEDULE_CACHE_SCHEMA"]
+
+logger = logging.getLogger("veles_tpu.tune")
+
+#: bump when the cache FILE layout changes (entry payloads carry their
+#: own per-kernel versions inside the digest)
+SCHEDULE_CACHE_SCHEMA = 1
+
+_FILE_NAME = "schedules.json"
+
+
+def default_cache_dir():
+    """``$VELES_SCHEDULE_CACHE`` or ``<root cache dir>/schedule_cache``
+    — resolved per call so tests can redirect via the environment."""
+    env = os.environ.get("VELES_SCHEDULE_CACHE", "")
+    if env:
+        return env
+    from veles_tpu.config import root
+    return os.path.join(root.common.dirs.get("cache", "/tmp"),
+                        "schedule_cache")
+
+
+@functools.lru_cache(maxsize=4096)
+def _digest(payload_json):
+    return hashlib.sha256(payload_json.encode("utf-8")).hexdigest()
+
+
+def schedule_key(op, shape, dtype, precision_level, device_kind,
+                 extra=None):
+    """(digest, payload) for one schedule-cache entry.
+
+    ``shape`` is the PADDED shape tuple (MXU sublane/lane multiples):
+    two raw shapes that pad identically run the identical kernel grid,
+    so they share one entry.  ``extra`` carries per-family versioning
+    (e.g. the kernel algorithm version)."""
+    payload = {
+        "op": str(op),
+        "shape": [int(s) for s in shape],
+        "dtype": str(dtype),
+        "precision_level": int(precision_level),
+        "device_kind": str(device_kind),
+        "jax": _jax_version(),
+    }
+    if extra:
+        payload.update({str(k): extra[k] for k in sorted(extra)})
+    return _digest(json.dumps(payload, sort_keys=True)), payload
+
+
+@functools.lru_cache(maxsize=1)
+def _jax_version():
+    import jax
+    return jax.__version__
+
+
+@functools.lru_cache(maxsize=1)
+def _device_kind_cached():
+    import jax
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
+
+def device_kind():
+    """The default device's kind string — the cache-key coordinate that
+    keeps a v5e's tiles from serving a v4 (or a CPU test host)."""
+    return _device_kind_cached()
+
+
+class ScheduleCache(object):
+    """One on-disk schedule table: lazy load, atomic save, tolerant of
+    corruption (a broken file logs a warning and reads as empty — it
+    is a CACHE; the static tables are the source of truth)."""
+
+    def __init__(self, path=None):
+        self.path = path or os.path.join(default_cache_dir(),
+                                         _FILE_NAME)
+        self._lock = threading.Lock()
+        self._entries = None
+        self._warned = set()
+
+    # -- load/save -----------------------------------------------------------
+
+    def _read_disk(self):
+        """The on-disk table, or {} (with ONE warning when corrupt)."""
+        try:
+            with open(self.path) as fin:
+                data = json.load(fin)
+            if (not isinstance(data, dict)
+                    or data.get("schema") != SCHEDULE_CACHE_SCHEMA
+                    or not isinstance(data.get("entries"), dict)):
+                raise ValueError("unrecognized schedule cache layout")
+            return data["entries"]
+        except FileNotFoundError:
+            return {}
+        except (OSError, ValueError) as exc:
+            self._warn_once(
+                "corrupt", "schedule cache %s unreadable (%s); "
+                "falling back to static tables" % (self.path, exc))
+            return {}
+
+    def _load(self):
+        if self._entries is None:
+            self._entries = self._read_disk()
+        return self._entries
+
+    def _save(self):
+        data = {"schema": SCHEDULE_CACHE_SCHEMA,
+                "entries": self._entries or {}}
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fout:
+            json.dump(data, fout, indent=1, sort_keys=True)
+            fout.flush()
+        os.replace(tmp, self.path)
+
+    def _warn_once(self, key, message):
+        if key not in self._warned:
+            self._warned.add(key)
+            logger.warning(message)
+
+    # -- table API -----------------------------------------------------------
+
+    def get(self, digest):
+        """The full entry dict for ``digest`` or None.  A structurally
+        invalid entry (no ``schedule`` dict) warns and misses."""
+        with self._lock:
+            entry = self._load().get(digest)
+        if entry is None:
+            return None
+        if (not isinstance(entry, dict)
+                or not isinstance(entry.get("schedule"), dict)):
+            self._warn_once(
+                digest, "schedule cache entry %s malformed; ignoring "
+                "(static tables serve this shape)" % digest[:12])
+            return None
+        return entry
+
+    def put(self, digest, payload, schedule, fitness=None,
+            source="ga", evals=None):
+        """Persist one winner.  ``schedule`` is the family's
+        tile/grid dict; ``fitness`` the GA's (negative seconds)."""
+        entry = dict(payload)
+        entry["schedule"] = dict(schedule)
+        entry["source"] = source
+        if fitness is not None:
+            entry["fitness"] = float(fitness)
+        if evals is not None:
+            entry["evals"] = int(evals)
+        with self._lock:
+            # re-read the file before the read-modify-write: another
+            # process (a fleet pre-tune, a concurrent sweep) may have
+            # added OR re-tuned entries since our lazy load — the
+            # fresher disk state wins for every digest except the one
+            # we are writing right now (a stale in-memory snapshot
+            # must neither wipe nor revert them)
+            merged = self._read_disk()
+            merged[digest] = entry
+            self._entries = merged
+            self._save()
+        return entry
+
+    def entries(self):
+        with self._lock:
+            return dict(self._load())
+
+    def __len__(self):
+        with self._lock:
+            return len(self._load())
+
+
+# -- process-wide consult hook ----------------------------------------------
+
+_instances_lock = threading.Lock()
+_instances = {}
+
+
+def cache_for(path=None):
+    """The ScheduleCache singleton for ``path`` (default: the resolved
+    cache dir).  Keyed by resolved path so tests that redirect
+    ``VELES_SCHEDULE_CACHE`` get a fresh table, not a stale singleton."""
+    resolved = path or os.path.join(default_cache_dir(), _FILE_NAME)
+    with _instances_lock:
+        inst = _instances.get(resolved)
+        if inst is None:
+            inst = _instances[resolved] = ScheduleCache(resolved)
+        return inst
+
+
+#: active recording sink (tune/walk.py) — a plain list; consults append
+#: their spec dicts.  Guarded by the GIL like every other module flag.
+_recording = None
+
+
+class record_specs(object):
+    """Context manager: while active, every ``schedule_for`` consult
+    appends ``{"op", "shape", "dtype", "precision_level", "extra",
+    "raw", "digest"}`` to the returned list (dedup by digest) — the
+    walk's harvest of what a lowering actually consulted."""
+
+    def __enter__(self):
+        global _recording
+        self._saved = _recording
+        self._sink = []
+        self._seen = set()
+        _recording = self
+        return self._sink
+
+    def __exit__(self, *exc):
+        global _recording
+        _recording = self._saved
+        return False
+
+    def add(self, spec):
+        if spec["digest"] not in self._seen:
+            self._seen.add(spec["digest"])
+            self._sink.append(spec)
+
+
+def _counters():
+    from veles_tpu.observe.metrics import registry
+    return registry
+
+
+def schedule_for(op, shape, dtype, precision_level, extra=None,
+                 raw=None):
+    """The kernels' consult: the cached ``schedule`` dict for this
+    (op, padded shape, dtype, precision level, device kind) or None.
+
+    Counts ``tune.cache_hits`` / ``tune.cache_misses``; under an
+    active :class:`record_specs` context also records the spec.  Never
+    raises — a broken cache is a warning plus the static fallback."""
+    try:
+        kind = device_kind()
+        digest, payload = schedule_key(op, shape, dtype,
+                                       precision_level, kind, extra)
+        if _recording is not None:
+            _recording.add({
+                "op": str(op), "shape": [int(s) for s in shape],
+                "dtype": str(dtype),
+                "precision_level": int(precision_level),
+                "device_kind": kind, "extra": dict(extra or {}),
+                "raw": dict(raw or {}), "digest": digest})
+        entry = cache_for().get(digest)
+        reg = _counters()
+        if entry is None:
+            reg.counter("tune.cache_misses").inc()
+            return None
+        reg.counter("tune.cache_hits").inc()
+        return entry["schedule"]
+    except Exception as exc:  # never let the cache break a kernel call
+        logger.warning("schedule cache consult failed (%s); using "
+                       "static tables", exc)
+        return None
+
+
+def provenance(op, shape, dtype, precision_level, extra=None):
+    """"tuned" when a cache entry would ACTUALLY serve this spec —
+    same structural validation as the kernels' consult, so an entry
+    the consult rejects (and serves statically) is never attributed as
+    tuned — else "static".  The MFU-attribution annotation (scripts/
+    mfu_breakdown.py); no counters, no recording."""
+    try:
+        digest, _ = schedule_key(op, shape, dtype, precision_level,
+                                 device_kind(), extra)
+        entry = cache_for().get(digest)
+        if entry is None:
+            return "static"
+        from veles_tpu.tune.spec import valid_schedule
+        return ("tuned" if valid_schedule(op, entry["schedule"])
+                else "static")
+    except Exception:
+        return "static"
+
+
+def tune_counters():
+    """Snapshot of the tune metric set + cache population for receipts
+    (the serve engine's compile receipt, the CLI's TUNE.json)."""
+    reg = _counters()
+    out = {}
+    for name in ("tune.cache_hits", "tune.cache_misses", "tune.evals"):
+        metric = reg.peek(name)
+        if metric is not None:
+            out[name.split(".", 1)[1]] = metric.value
+    try:
+        out["entries"] = len(cache_for())
+    except Exception:
+        pass
+    return out

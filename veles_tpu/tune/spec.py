@@ -1,0 +1,464 @@
+"""Per-kernel-family search spaces for the schedule autotuner.
+
+One family per parameterized Pallas kernel (docs/kernels.md):
+
+- ``matmul`` — ``ops/matmul.py``'s (bm, bn, bk) tiles;
+- ``conv_vjp`` — ``ops/conv_vjp.py``'s (bi, bj, bk) wgrad tiles;
+- ``pool_bwd`` — ``ops/pool_bwd.py``'s output-width block (W tiling).
+
+Each family owns four things the GA needs: the **search space** as
+:class:`veles_tpu.genetics.config.Tune` markers (so the stock
+GeneticsOptimizer drives it unchanged), **quantization** of raw genes
+to MXU-legal multiples (sublane 8 on the second-minor axis, lane 128
+on the minor axis — Mosaic tiles below the hardware quanta just pad
+back up, so off-grid genes are pure duplicate schedules), a **VMEM
+feasibility** check that rejects overflowing candidates BEFORE any
+compile is paid, and a **runner builder** that turns (spec, schedule)
+into the timed callable the shared measurement discipline
+(``tune/measure.py``) ranks.
+
+The ``*_spec`` builders at the bottom are the ONE definition of each
+family's cache-key coordinates — the kernels' consult sites and the
+MFU-attribution provenance lookups both call them, so the key a tuner
+writes is byte-identical to the key a kernel later reads.
+
+Schedules change tile/grid SCHEDULING only, never math: the precision
+level and dtype are key coordinates, not genes, and the parity tests
+(tests/test_tune.py) hold tuned-vs-static results bit-equal on
+representable operands.
+"""
+
+import functools
+import logging
+
+from veles_tpu.genetics.config import Tune
+
+__all__ = ["FAMILIES", "family_for", "matmul_spec", "conv_vjp_spec",
+           "pool_bwd_spec", "valid_schedule",
+           "matmul_seed_candidates", "TUNE_VMEM_BUDGET_BYTES"]
+
+logger = logging.getLogger("veles_tpu.tune")
+
+#: per-grid-step VMEM ceiling for candidate REJECTION before compile —
+#: aligned with ops/pool_bwd.POOL_VMEM_BUDGET_BYTES; the compile-time
+#: Mosaic check stays the backstop for shapes that squeak past
+TUNE_VMEM_BUDGET_BYTES = 12 * 2 ** 20
+
+_warned = set()
+
+
+def _warn_once(key, message, *args):
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning(message, *args)
+
+
+def _ceil_mult(value, mult):
+    rem = value % mult
+    return value if rem == 0 else value + mult - rem
+
+
+def _quant(value, mult, lo, hi):
+    """Round a raw gene to the nearest legal multiple inside
+    [lo, hi] — clamped duplicates collapse onto one schedule, which the
+    tuner's fitness memo then serves for free."""
+    q = int(round(float(value) / mult)) * mult
+    if q < mult:
+        q = mult
+    return max(lo, min(hi, q))
+
+
+def _itemsize(dtype):
+    import numpy
+    if str(dtype) == "bfloat16":
+        return 2
+    return numpy.dtype(str(dtype)).itemsize
+
+
+def matmul_seed_candidates(dtype, precision_level):
+    """ops/matmul.py's curated tile list — measured winners on real
+    chips, kept as the GA's seed population AND the plain candidate
+    sweep ``autotune_matmul`` still runs."""
+    candidates = [(256, 256, 256), (512, 512, 512), (512, 512, 1024),
+                  (512, 512, 2048), (256, 256, 1024), (512, 1024, 512),
+                  (1024, 512, 512), (256, 512, 1024)]
+    if str(dtype) == "float32" and precision_level in (0, 1):
+        # taller-M / wider-N tiles for the f32 paths (level 0's three
+        # bf16 dots per K-step and level 1's six-pass HIGHEST products
+        # + Kahan both shift the VMEM/compute balance away from the
+        # square default): a (768, 512, 512) tile measured ~1.25x over
+        # (512, 512, 512) at 3001^2 on v5e for level 0
+        candidates += [(768, 512, 512), (640, 512, 512),
+                       (512, 640, 512), (512, 640, 640)]
+    return candidates
+
+
+class MatmulFamily(object):
+    """(bm, bn, bk) tiles of the tiled Pallas matmul."""
+
+    name = "matmul"
+
+    def space(self, spec):
+        mp, kp, np_ = spec["shape"]
+        return {
+            "bm": Tune(min(512, mp), 8, min(1024, mp)),
+            "bn": Tune(min(512, np_), 128, min(2048, np_)),
+            "bk": Tune(min(512, kp), 128, min(2048, kp)),
+        }
+
+    def quantize(self, spec, genes):
+        mp, kp, np_ = spec["shape"]
+        return {"blocks": [
+            _quant(genes["bm"], 8, 8, min(1024, mp)),
+            _quant(genes["bn"], 128, 128, min(2048, np_)),
+            _quant(genes["bk"], 128, 128, min(2048, kp)),
+        ]}
+
+    def feasible(self, spec, schedule):
+        bm, bn, bk = schedule["blocks"]
+        isz = _itemsize(spec["dtype"])
+        footprint = (bm * bk * isz + bk * bn * isz   # a + b blocks
+                     + 2 * bm * bn * 4               # f32 acc + comp
+                     + bm * bn * isz)                # out block
+        return footprint <= TUNE_VMEM_BUDGET_BYTES
+
+    def seeds(self, spec):
+        # the GA seeds at most `population` chromosomes, so the
+        # dtype-specific measured winners (appended LAST in the sweep's
+        # curated order) go FIRST here — a population of 8 must not
+        # silently drop the known f32 best tiles
+        curated = matmul_seed_candidates(spec["dtype"],
+                                         spec["precision_level"])
+        generic = matmul_seed_candidates("bfloat16", 2)
+        specific = [c for c in curated if c not in generic]
+        return [{"blocks": list(c)} for c in specific + generic]
+
+    def default(self, spec):
+        from veles_tpu.ops import matmul as _m
+        return {"blocks": list(_m._DEFAULT_BLOCKS)}
+
+    def genes_of(self, schedule):
+        bm, bn, bk = schedule["blocks"]
+        return {"bm": bm, "bn": bn, "bk": bk}
+
+    def validate(self, schedule):
+        blocks = schedule.get("blocks")
+        if (isinstance(blocks, (list, tuple)) and len(blocks) == 3
+                and all(isinstance(b, int) and b > 0 for b in blocks)
+                and blocks[0] % 8 == 0 and blocks[1] % 128 == 0
+                and blocks[2] % 128 == 0):
+            return {"blocks": [int(b) for b in blocks]}
+        return None
+
+    def build_runner(self, spec, schedule):
+        """(warm, run): ``warm()`` compiles (VMEM-overflow candidates
+        raise here, before any timed chain); ``run(n)`` executes an
+        n-long chain ended by a completion fetch.  Square self-multiply
+        shapes chain DEPENDENTLY (matmul_benchmark's methodology);
+        rectangular shapes queue n dispatches and block once."""
+        import jax
+        import jax.numpy as jnp
+        import numpy
+
+        from veles_tpu.ops.matmul import matmul
+
+        m, k, n = spec.get("raw", {}).get("mkn", spec["shape"])
+        rng = numpy.random.RandomState(13)
+        dtype = jnp.dtype(spec["dtype"]) if spec["dtype"] != "bfloat16" \
+            else jnp.bfloat16
+        a = jnp.asarray((rng.rand(m, k) - 0.5) * 0.01, dtype)
+        b = jnp.asarray((rng.rand(k, n) - 0.5) * 0.01, dtype)
+        blocks = tuple(schedule["blocks"])
+        level = spec["precision_level"]
+
+        if k == n:
+            def mm(x):
+                return matmul(x, b, precision_level=level,
+                              blocks=blocks)
+
+            def run(count):
+                acc = a
+                for _ in range(count):
+                    acc = mm(acc)
+                float(acc[0, 0].astype(jnp.float32))
+        else:
+            def run(count):
+                out = None
+                for _ in range(count):
+                    out = matmul(a, b, precision_level=level,
+                                 blocks=blocks)
+                jax.block_until_ready(out)
+
+        def warm():
+            run(1)
+
+        return warm, run
+
+
+class ConvVjpFamily(object):
+    """(bi, bj, bk) = (Cin, Cout, P) tiles of the fused conv-VJP
+    wgrad contraction."""
+
+    name = "conv_vjp"
+
+    def space(self, spec):
+        _taps, pp, cip, cop = spec["shape"]
+        return {
+            "bi": Tune(min(256, cip), 128, min(1024, cip)),
+            "bj": Tune(min(256, cop), 128, min(1024, cop)),
+            "bk": Tune(min(512, pp), 8, min(2048, pp)),
+        }
+
+    def quantize(self, spec, genes):
+        _taps, pp, cip, cop = spec["shape"]
+        return {"blocks": [
+            _quant(genes["bi"], 128, 128, min(1024, cip)),
+            _quant(genes["bj"], 128, 128, min(1024, cop)),
+            _quant(genes["bk"], 8, 8, min(2048, pp)),
+        ]}
+
+    def feasible(self, spec, schedule):
+        bi, bj, bk = schedule["blocks"]
+        isz = _itemsize(spec["dtype"])
+        footprint = (bk * bi * isz          # tap-stack block
+                     + 2 * bk * bj * isz    # y + dy blocks
+                     + bk * bj * isz        # err out block
+                     + bi * bj * 4          # gw out block (f32)
+                     + 2 * bi * bj * 4      # acc + comp scratch
+                     + 8 * bj * 4)          # bias scratch
+        return footprint <= TUNE_VMEM_BUDGET_BYTES
+
+    def seeds(self, spec):
+        return [{"blocks": list(c)} for c in
+                [(256, 256, 512), (128, 256, 512), (256, 128, 512),
+                 (256, 256, 1024), (128, 128, 256), (512, 256, 512)]]
+
+    def default(self, spec):
+        from veles_tpu.ops import conv_vjp as _c
+        return {"blocks": list(_c._DEFAULT_BLOCKS)}
+
+    def genes_of(self, schedule):
+        bi, bj, bk = schedule["blocks"]
+        return {"bi": bi, "bj": bj, "bk": bk}
+
+    def validate(self, schedule):
+        blocks = schedule.get("blocks")
+        if (isinstance(blocks, (list, tuple)) and len(blocks) == 3
+                and all(isinstance(b, int) and b > 0 for b in blocks)
+                and blocks[0] % 128 == 0 and blocks[1] % 128 == 0
+                and blocks[2] % 8 == 0):
+            return {"blocks": [int(b) for b in blocks]}
+        return None
+
+    def build_runner(self, spec, schedule):
+        import jax
+        import jax.numpy as jnp
+        import numpy
+
+        from veles_tpu.ops.conv_vjp import fused_conv_vjp
+
+        raw = spec["raw"]
+        n, h, w_sp, ci = raw["x_shape"]
+        oh, ow = raw["y_hw"]
+        ky, kx, cout = raw["ky"], raw["kx"], raw["cout"]
+        rng = numpy.random.RandomState(7)
+        dtype = jnp.bfloat16 if spec["dtype"] == "bfloat16" \
+            else jnp.dtype(spec["dtype"])
+        x = jnp.asarray(rng.randn(n, h, w_sp, ci) * 0.1, dtype)
+        w = jnp.asarray(rng.randn(ky, kx, ci, cout) * 0.1, dtype)
+        y = jnp.asarray(rng.randn(n, oh, ow, cout) * 0.1, dtype)
+        dy = jnp.asarray(rng.randn(n, oh, ow, cout) * 0.1, dtype)
+        blocks = tuple(schedule["blocks"])
+
+        def run(count):
+            gw = None
+            for _ in range(count):
+                _, gw, _ = fused_conv_vjp(
+                    x, w, y, dy, activation=raw["activation"],
+                    padding=tuple(raw["padding"]),
+                    sliding=tuple(raw["sliding"]),
+                    need_err_input=False,
+                    precision_level=spec["precision_level"],
+                    blocks=blocks)
+            jax.block_until_ready(gw)
+
+        def warm():
+            run(1)
+
+        return warm, run
+
+
+class PoolBwdFamily(object):
+    """Output-width block (W tiling) of the pool select-and-scatter
+    backward.  Only non-overlapping windows (kx == sx, ky == sy) admit
+    halo-free W tiling, so overlapping shapes are untunable."""
+
+    name = "pool_bwd"
+
+    def space(self, spec):
+        _n, _h, _w, _c, _oh, ow, ky, kx, sy, sx = spec["shape"]
+        if kx != sx or ky != sy or ow < 2:
+            return None  # untunable: no halo-free W tiling exists
+        return {"owb": Tune(ow, 1, ow)}
+
+    def quantize(self, spec, genes):
+        ow = spec["shape"][5]
+        owb = int(round(float(genes["owb"])))
+        return {"owb": max(1, min(ow, owb))}
+
+    def feasible(self, spec, schedule):
+        # the kernel planner's OWN footprint formula — shared, so the
+        # feasibility gate can never drift from what Mosaic gets
+        from veles_tpu.ops.pool_bwd import (POOL_VMEM_BUDGET_BYTES,
+                                            pool_block_footprint)
+        n, h, w_sp, c, oh, ow, ky, kx, sy, sx = spec["shape"]
+        footprint = pool_block_footprint(
+            h, c, oh, schedule["owb"], (ky, kx), (sx, sy),
+            _itemsize(spec["dtype"]))
+        return footprint <= POOL_VMEM_BUDGET_BYTES
+
+    def seeds(self, spec):
+        ow = spec["shape"][5]
+        owbs = sorted({ow, -(-ow // 2), -(-ow // 4), 1}, reverse=True)
+        return [{"owb": owb} for owb in owbs if owb >= 1]
+
+    def default(self, spec):
+        ow = spec["shape"][5]
+        return {"owb": ow}
+
+    def genes_of(self, schedule):
+        return {"owb": schedule["owb"]}
+
+    def validate(self, schedule):
+        owb = schedule.get("owb")
+        if isinstance(owb, int) and owb > 0:
+            return {"owb": owb}
+        return None
+
+    def build_runner(self, spec, schedule):
+        import jax
+        import jax.numpy as jnp
+        import numpy
+
+        from veles_tpu.models.pooling import MaxPooling
+        from veles_tpu.ops.pool_bwd import max_pool_bwd
+
+        raw = spec["raw"]
+        n, h, w_sp, c = raw["x_shape"]
+        window = tuple(raw["window"])
+        sliding = tuple(raw["sliding"])
+        rng = numpy.random.RandomState(5)
+        dtype = jnp.bfloat16 if spec["dtype"] == "bfloat16" \
+            else jnp.dtype(spec["dtype"])
+        x = jnp.asarray(rng.randn(n, h, w_sp, c), dtype)
+        y = MaxPooling.apply({}, x, window=window, sliding=sliding,
+                             pallas_bwd=False)
+        dy = jnp.asarray(rng.randn(*y.shape), dtype)
+        owb = int(schedule["owb"])
+
+        def run(count):
+            out = None
+            for _ in range(count):
+                out = max_pool_bwd(x, y, dy, window=window,
+                                   sliding=sliding, owb=owb)
+            jax.block_until_ready(out)
+
+        def warm():
+            run(1)
+
+        return warm, run
+
+
+FAMILIES = {
+    "matmul": MatmulFamily(),
+    "conv_vjp": ConvVjpFamily(),
+    "pool_bwd": PoolBwdFamily(),
+}
+
+
+def family_for(op):
+    family = FAMILIES.get(op)
+    if family is None:
+        raise KeyError("unknown kernel family %r (have %s)" %
+                       (op, sorted(FAMILIES)))
+    return family
+
+
+def valid_schedule(op, schedule):
+    """Structural validation of a cache-served schedule: the family's
+    normalized dict, or None (with ONE warning) for anything malformed
+    — a stale/corrupt entry must degrade to the static tables, never
+    crash a kernel call."""
+    family = FAMILIES.get(op)
+    if family is None or not isinstance(schedule, dict):
+        return None
+    normalized = family.validate(schedule)
+    if normalized is None:
+        _warn_once(
+            ("invalid", op, str(schedule)),
+            "ignoring malformed tuned schedule for %s: %r (static "
+            "tables serve this shape)", op, schedule)
+    return normalized
+
+
+# -- cache-key spec builders (ONE definition per family) ---------------------
+
+
+def matmul_spec(m, k, n, dtype, precision_level):
+    """The matmul consult/tune spec: shape is PADDED to the MXU quanta
+    (sublane 8 on M, lane 128 on K/N) so raw shapes that run the same
+    grid share one cache entry; the kernel version rides ``extra``."""
+    from veles_tpu.ops.matmul import MATMUL_KERNEL_VERSION
+    return {
+        "op": "matmul",
+        "shape": [_ceil_mult(int(m), 8), _ceil_mult(int(k), 128),
+                  _ceil_mult(int(n), 128)],
+        "dtype": str(dtype),
+        "precision_level": int(precision_level),
+        "extra": {"kernel_version": MATMUL_KERNEL_VERSION},
+        "raw": {"mkn": [int(m), int(k), int(n)]},
+    }
+
+
+def conv_vjp_spec(x_shape, ky, kx, cout, y_hw, dtype, precision_level,
+                  padding=(0, 0, 0, 0), sliding=(1, 1),
+                  activation="linear"):
+    """The fused conv-VJP consult/tune spec: shape is (taps, padded P,
+    padded Cin, padded Cout) — the wgrad contraction's grid coordinates."""
+    from veles_tpu.ops.conv_vjp import CONV_VJP_KERNEL_VERSION
+    n, _h, _w, ci = [int(s) for s in x_shape]
+    oh, ow = [int(s) for s in y_hw]
+    p = n * oh * ow
+    return {
+        "op": "conv_vjp",
+        "shape": [int(ky) * int(kx), _ceil_mult(p, 8),
+                  _ceil_mult(ci, 128), _ceil_mult(int(cout), 128)],
+        "dtype": str(dtype),
+        "precision_level": int(precision_level),
+        "extra": {"kernel_version": CONV_VJP_KERNEL_VERSION},
+        "raw": {"x_shape": [int(s) for s in x_shape],
+                "y_hw": [oh, ow], "ky": int(ky), "kx": int(kx),
+                "cout": int(cout),
+                "padding": [int(p_) for p_ in padding],
+                "sliding": [int(s) for s in sliding],
+                "activation": str(activation)},
+    }
+
+
+def pool_bwd_spec(x_shape, out_hw, window, sliding, dtype):
+    """The pool-backward consult/tune spec: raw dims ride the key (the
+    kernel's W plan depends on every one of them)."""
+    from veles_tpu.ops.pool_bwd import POOL_BWD_KERNEL_VERSION
+    n, h, w_sp, c = [int(s) for s in x_shape]
+    oh, ow = [int(s) for s in out_hw]
+    ky, kx = [int(s) for s in window]
+    sx, sy = [int(s) for s in sliding]
+    return {
+        "op": "pool_bwd",
+        "shape": [n, h, w_sp, c, oh, ow, ky, kx, sy, sx],
+        "dtype": str(dtype),
+        "precision_level": 0,  # pooling has no precision ladder
+        "extra": {"kernel_version": POOL_BWD_KERNEL_VERSION},
+        "raw": {"x_shape": [n, h, w_sp, c], "window": [ky, kx],
+                "sliding": [sx, sy]},
+    }

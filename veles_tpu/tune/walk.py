@@ -1,0 +1,146 @@
+"""Harvest the kernel specs a model's fused train step actually uses.
+
+Two complementary sources, both derived from ONE ``.lower()`` of the
+fused step (no execution, no device time):
+
+1. **Consult recording** — lowering traces every layer's backward, so
+   the Pallas kernel families' schedule-cache consults
+   (``ops/conv_vjp.py``, ``ops/pool_bwd.py``, ``ops/matmul.py``) fire
+   with the step's real traced shapes.  A :func:`~veles_tpu.tune.
+   cache.record_specs` context captures them verbatim — the exact
+   (op, padded shape, dtype, precision) coordinates the kernels will
+   later look up.  The hand-scheduled backward knob is forced ON for
+   the walk (lowering only — nothing runs), so conv/pool specs are
+   collected even on a CPU host pre-tuning for a TPU pod.
+2. **dot_general harvest** — the model layers' dense matmuls lower to
+   ``stablehlo.dot_general`` (XLA's own kernels, not ops/matmul), but
+   serving/BLAS paths route the same shapes through the Pallas matmul;
+   parsing the lowering's 2-D dots yields those (M, K, N) specs so a
+   tune run covers them too.
+"""
+
+import re
+
+__all__ = ["collect_specs", "dot_specs_from_text"]
+
+_TENSOR = r"tensor<(\d+)x(\d+)x(f32|bf16|f16)>"
+_DOT_RE = re.compile(
+    r"dot_general\s[^\n]*?\(%s,\s*%s\)\s*->\s*%s" %
+    (_TENSOR, _TENSOR, _TENSOR))
+
+
+def _mkn(a0, a1, b0, b1, o0, o1):
+    """(M, K, N) for a 2-D dot with operand/result dims, tolerant of
+    transposed contractions (the backward's dT/xT dots); None when the
+    dims don't tell a consistent GEMM story."""
+    if a0 == o0 and b1 == o1 and a1 == b0:
+        return o0, a1, o1          # (M,K) @ (K,N)
+    if a1 == o0 and b1 == o1 and a0 == b0:
+        return o0, a0, o1          # (K,M)^T @ (K,N)
+    if a0 == o0 and b0 == o1 and a1 == b1:
+        return o0, a1, o1          # (M,K) @ (N,K)^T
+    if a1 == o0 and b0 == o1 and a0 == b1:
+        return o0, a0, o1          # (K,M)^T @ (N,K)^T
+    return None
+
+
+def dot_specs_from_text(text, precision_level=0):
+    """matmul tune specs for every distinct 2-D ``dot_general`` in a
+    lowering's StableHLO text."""
+    from veles_tpu.tune.spec import matmul_spec
+    dtypes = {"f32": "float32", "bf16": "bfloat16", "f16": "float16"}
+    specs, seen = [], set()
+    for match in _DOT_RE.finditer(text):
+        a0, a1, dt_a, b0, b1, dt_b, o0, o1, dt_o = match.groups()
+        if dt_a != dt_b:
+            continue
+        dims = _mkn(*[int(v) for v in (a0, a1, b0, b1, o0, o1)])
+        if dims is None:
+            continue
+        m, k, n = dims
+        key = (m, k, n, dt_a)
+        if key in seen:
+            continue
+        seen.add(key)
+        specs.append(matmul_spec(m, k, n, dtypes[dt_a],
+                                 precision_level))
+    return specs
+
+
+def collect_specs(plans, state, batch, sample_shape, loss="softmax",
+                  dtype="float32", precision_level=0, ops=None):
+    """Lower the fused train step once and return the deduplicated
+    tune-spec list it consulted (+ the dot_general matmul harvest).
+
+    ``plans``/``state`` as from ``models.zoo.build_plans_and_state``;
+    ``ops`` optionally restricts to a family subset (e.g. the CLI's
+    ``--ops matmul``)."""
+    import jax
+    import numpy
+
+    from veles_tpu import compiler
+    from veles_tpu.ops import common
+    from veles_tpu.tune.cache import record_specs, schedule_key
+
+    def aval(leaf):
+        return (None if leaf is None else
+                jax.ShapeDtypeStruct(numpy.shape(leaf),
+                                     numpy.asarray(leaf).dtype))
+
+    state_avals = [{key: aval(value) for key, value in entry.items()}
+                   for entry in state]
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+        np_dtype = jnp.bfloat16
+    else:
+        np_dtype = numpy.dtype(dtype)
+    x_aval = jax.ShapeDtypeStruct((batch,) + tuple(sample_shape),
+                                  np_dtype)
+    if loss == "mse":
+        out_shape = numpy.shape(state[-1]["weights"])[-1]
+        y_aval = jax.ShapeDtypeStruct((batch, out_shape), np_dtype)
+    else:
+        y_aval = jax.ShapeDtypeStruct((batch,), numpy.int32)
+
+    saved_knob = common.PALLAS_BWD_ENV
+    try:
+        # pass 1: hand-scheduled backward ON — the Pallas families'
+        # consults fire with the step's traced shapes (recording only;
+        # in interpret mode this lowering's text also contains the
+        # kernels' INTERNAL tile dots, which must not be harvested as
+        # model matmuls)
+        common.PALLAS_BWD_ENV = "1"
+        step = compiler.build_train_step(plans, loss=loss,
+                                         donate=False)
+        with record_specs() as recorded:
+            step.lower(state_avals, x_aval, y_aval,
+                       numpy.float32(batch))
+        # pass 2: stock autodiff backward — the lowering's dot_generals
+        # are the MODEL's dense contractions, harvested for the Pallas
+        # matmul the serving/BLAS paths route those shapes through
+        common.PALLAS_BWD_ENV = "0"
+        step_stock = compiler.build_train_step(plans, loss=loss,
+                                               donate=False)
+        text = step_stock.lower(state_avals, x_aval, y_aval,
+                                numpy.float32(batch)).as_text()
+    finally:
+        common.PALLAS_BWD_ENV = saved_knob
+
+    specs = list(recorded)
+    seen = {spec["digest"] for spec in specs}
+    from veles_tpu.tune.cache import device_kind
+    kind = device_kind()
+    for spec in dot_specs_from_text(text, precision_level):
+        digest, _ = schedule_key(spec["op"], spec["shape"],
+                                 spec["dtype"],
+                                 spec["precision_level"], kind,
+                                 spec.get("extra"))
+        if digest in seen:
+            continue
+        seen.add(digest)
+        spec = dict(spec, digest=digest)
+        specs.append(spec)
+    if ops:
+        allowed = set(ops)
+        specs = [s for s in specs if s["op"] in allowed]
+    return specs
