@@ -106,6 +106,25 @@ def _schedule_cache_to_tmp(tmp_path, monkeypatch):
                        str(tmp_path / "schedule_cache"))
 
 
+@pytest.fixture(autouse=True)
+def _publish_dir_to_tmp(tmp_path):
+    """The freshness loop's publish directory config
+    (root.common.freshness.publish_dir, the trainer's --publish-dir /
+    the watcher's --watch-dir default) must always point at test-local
+    tmp: a developer's site config (~/.veles_tpu) setting a real
+    publish dir must never leak into — or be watched by — the suite.
+    Deliberate side effect: every default-config Snapshotter in the
+    suite actually exercises the publish path (verify + copy into
+    tmp); the whole-suite cost is noise next to the export itself and
+    buys the publish hook coverage on every snapshotting test."""
+    from veles_tpu.config import root
+    prev = root.common.freshness.get("publish_dir")
+    root.common.freshness.update(
+        {"publish_dir": str(tmp_path / "publish")})
+    yield
+    root.common.freshness.update({"publish_dir": prev})
+
+
 @pytest.fixture
 def cpu_device():
     from veles_tpu.backends import Device

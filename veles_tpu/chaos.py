@@ -71,7 +71,21 @@ point               module                     actions
                                                RESOURCE_EXHAUSTED —
                                                batcher caps the ladder
                                                and replays in chunks)
+``freshness.publish``  snapshotter             truncate (torn
+                    (publish_snapshot)         NON-atomic copy at the
+                                               final published path —
+                                               the watcher must
+                                               skip-and-retry, not
+                                               load), crash (die after
+                                               the copy, before the
+                                               LATEST flip — stale
+                                               pointer, burned
+                                               ordinal)
 ==================  =========================  =========================
+
+(``snapshot.write`` also covers ``serve.freshness``'s
+``export_model_spec`` — a trainer crash mid-export leaves a torn
+``.tmp`` and no final file, the same contract as the Snapshotter.)
 
 Activation: programmatic (``chaos.install(FaultPlan(...))`` /
 ``chaos.uninstall()``) or via ``VELES_CHAOS`` in the environment, e.g.

@@ -13,8 +13,8 @@ import math
 
 import numpy
 
-__all__ = ["all_finite", "DivergenceError", "RollbackExhausted",
-           "is_finite_metric", "PoisonedUpdate"]
+__all__ = ["all_finite", "DivergenceError", "EmaSpikeWatch",
+           "RollbackExhausted", "is_finite_metric", "PoisonedUpdate"]
 
 
 class DivergenceError(RuntimeError):
@@ -85,3 +85,55 @@ def all_finite(obj):
     if arr.dtype.kind not in "fc":
         return True
     return bool(numpy.isfinite(arr).all())
+
+
+class EmaSpikeWatch(object):
+    """The EMA spike discipline the divergence watchdog trips on
+    (docs/health.md), extracted so every plane that needs "has this
+    series suddenly gone bad?" shares ONE definition: the decision
+    unit's train-metric watchdog, and the serve fleet's canary
+    comparator (docs/serving.md "Freshness loop").
+
+    Semantics (bit-for-bit the pre-extraction decision logic): a value
+    spikes when ``value > spike_factor * max(EMA, spike_floor)`` and an
+    EMA exists; a spiking value is reported and NOT folded into the EMA
+    (one outlier must not drag the baseline up to meet the next one),
+    while a healthy value updates ``EMA = beta * EMA + (1-beta) *
+    value``.  The floor keeps near-zero converged baselines from
+    turning ordinary noise into "spikes".  Callers gate non-finite
+    values themselves (:func:`is_finite_metric`) — NaN comparisons are
+    silently False and would sail through."""
+
+    def __init__(self, spike_factor=10.0, spike_floor=1.0, beta=0.5,
+                 label="value"):
+        self.spike_factor = float(spike_factor)
+        self.spike_floor = float(spike_floor)
+        self.beta = float(beta)
+        self.label = label
+        self.ema = None
+
+    def reset(self):
+        """Start a fresh observation window (post-rollback)."""
+        self.ema = None
+
+    def observe(self, value):
+        """Fold a trusted baseline value into the EMA WITHOUT a spike
+        check — how the canary comparator primes its latency baseline
+        from the live fleet before judging the candidate against it."""
+        value = float(value)
+        self.ema = value if self.ema is None else \
+            self.beta * self.ema + (1.0 - self.beta) * value
+
+    def update(self, value):
+        """Check ``value`` against the spike threshold, then fold it in
+        when healthy.  Returns the human-readable spike reason, or
+        None."""
+        value = float(value)
+        threshold = self.spike_factor * max(
+            self.ema if self.ema is not None else value,
+            self.spike_floor)
+        if self.ema is not None and value > threshold:
+            return "%s spiked to %.4g (EMA %.4g, threshold %.4g)" % (
+                self.label, value, self.ema, threshold)
+        self.observe(value)
+        return None

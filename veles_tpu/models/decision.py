@@ -17,7 +17,8 @@ owning workflow's ``on_divergence`` hook (snapshot rollback + LR
 backoff in StandardWorkflow) when training has gone off the rails.
 """
 
-from veles_tpu.health import DivergenceError, is_finite_metric
+from veles_tpu.health import (
+    DivergenceError, EmaSpikeWatch, is_finite_metric)
 from veles_tpu.loader.base import CLASS_NAME, TRAIN, VALID
 from veles_tpu.mutable import Bool
 from veles_tpu.observe.flight import flight as _flight
@@ -60,7 +61,12 @@ class DecisionBase(Unit):
         #: units exposing lazy skip_count / consecutive_skips counters
         #: (the gds, or the fused trainer); wired by the workflow
         self.health_sources = []
-        self._metric_ema = None
+        # the ONE EMA spike discipline (health.EmaSpikeWatch), shared
+        # with the serve canary comparator (docs/serving.md)
+        self._spike_watch = EmaSpikeWatch(
+            spike_factor=self.spike_factor,
+            spike_floor=self.spike_floor, beta=self.ema_beta,
+            label="train metric")
         self._skips_seen = 0
         # linked from loader:
         self.minibatch_class = None
@@ -188,19 +194,9 @@ class DecisionBase(Unit):
             if not is_finite_metric(metric):
                 reasons.append("non-finite train metric %r" % (metric,))
             else:
-                threshold = self.spike_factor * max(
-                    self._metric_ema if self._metric_ema is not None
-                    else metric, self.spike_floor)
-                if self._metric_ema is not None and metric > threshold:
-                    reasons.append(
-                        "train metric spiked to %.4g (EMA %.4g, "
-                        "threshold %.4g)" % (metric, self._metric_ema,
-                                             threshold))
-                else:
-                    beta = self.ema_beta
-                    self._metric_ema = metric if self._metric_ema is \
-                        None else beta * self._metric_ema + \
-                        (1.0 - beta) * metric
+                spike = self._spike_watch.update(metric)
+                if spike is not None:
+                    reasons.append(spike)
         if fresh and not reasons:
             self.warning(
                 "numerics guard skipped %d non-finite train step(s) "
@@ -233,7 +229,7 @@ class DecisionBase(Unit):
         after counters were zeroed): the watchdog starts a fresh
         observation window."""
         self.diverged <<= False
-        self._metric_ema = None
+        self._spike_watch.reset()
         self._skips_seen = 0
 
     def get_metric_names(self):

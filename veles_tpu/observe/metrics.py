@@ -212,6 +212,15 @@ _HEALTH_KEYS = (
     ("serve.replicas", "serve_replicas"),
     ("serve.queue_depth", "serve_queue_depth"),
     ("serve.reloads", "serve_reloads"),
+    # train-to-serve freshness loop (veles_tpu/serve/freshness.py):
+    # publish/candidate/promotion/rollback/poison accounting rides
+    # heartbeats so a post-mortem can line up a latency cliff or a
+    # quality regression against the cutover that shipped it
+    ("serve.freshness.published", "freshness_published"),
+    ("serve.freshness.candidates", "freshness_candidates"),
+    ("serve.freshness.promotions", "freshness_promotions"),
+    ("serve.freshness.rollbacks", "freshness_rollbacks"),
+    ("serve.freshness.poisoned_rejected", "freshness_poisoned"),
     # XLA introspection (observe/xla_introspect.py): live achieved-MFU
     # and compile accounting ride the same health surface
     ("xla.mfu_pct", "mfu_pct"),

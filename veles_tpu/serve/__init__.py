@@ -25,8 +25,16 @@ inference runtime — rebuilt TPU-idiomatically in three layers:
   fixed dtype/shape/raw-bytes tensor codec (the serve port never
   unpickles) and a same-host :class:`ShmChannel` payload bypass;
 - :mod:`veles_tpu.serve.service` — :class:`ServeService`: the tornado
-  front (``/infer``, ``/healthz``, ``/metrics.json``, ``/reload``),
-  async handlers so concurrent clients actually co-batch.
+  front (``/infer``, ``/healthz``, ``/metrics.json``, ``/reload``,
+  ``/publish``), async handlers so concurrent clients actually
+  co-batch;
+- :mod:`veles_tpu.serve.freshness` — the train-to-serve freshness
+  loop: :class:`SnapshotWatcher` (manifest-verified pickup of the
+  trainer's published snapshots), :class:`FreshnessController`
+  (finite gate, background warm-up, mirrored canary judgment via
+  :class:`CanaryComparator`) over the router's canary state machine —
+  promote fleet-wide or auto-roll back to the last-good digest with
+  zero new compiles.
 
 ``python -m veles_tpu.serve --snapshot model.pickle`` serves a trained
 snapshot; ``scripts/serve_load.py`` is the closed-loop load generator
@@ -36,9 +44,13 @@ behind ``BENCH_serve.json``.
 from veles_tpu.serve.batcher import (  # noqa: F401
     ContinuousBatcher, ServeOverload, serve_snapshot)
 from veles_tpu.serve.engine import (  # noqa: F401
-    AOTEngine, DEFAULT_LADDER, enable_persistent_cache, model_digest)
+    AOTEngine, DEFAULT_LADDER, enable_persistent_cache, model_digest,
+    value_digest)
+from veles_tpu.serve.freshness import (  # noqa: F401
+    CanaryComparator, FreshnessController, SnapshotWatcher,
+    export_model_spec)
 from veles_tpu.serve.router import (  # noqa: F401
-    Replica, ReplicaPool, local_devices)
+    CanaryCutover, Replica, ReplicaPool, local_devices)
 from veles_tpu.serve.service import (  # noqa: F401
     ServeService, format_result)
 from veles_tpu.serve.transport import (  # noqa: F401
@@ -46,9 +58,11 @@ from veles_tpu.serve.transport import (  # noqa: F401
     encode_tensor)
 
 __all__ = ["AOTEngine", "BinaryTransportClient",
-           "BinaryTransportServer", "ContinuousBatcher",
-           "Replica", "ReplicaPool", "ServeOverload",
-           "ServeService", "DEFAULT_LADDER", "decode_tensor",
+           "BinaryTransportServer", "CanaryComparator",
+           "CanaryCutover", "ContinuousBatcher",
+           "FreshnessController", "Replica", "ReplicaPool",
+           "ServeOverload", "ServeService", "SnapshotWatcher",
+           "DEFAULT_LADDER", "decode_tensor",
            "enable_persistent_cache", "encode_tensor",
-           "format_result", "local_devices", "model_digest",
-           "serve_snapshot"]
+           "export_model_spec", "format_result", "local_devices",
+           "model_digest", "serve_snapshot", "value_digest"]

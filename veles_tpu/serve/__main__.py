@@ -17,9 +17,13 @@ set, not N:
 bytes, no JSON, no pickle — docs/serving.md wire format) beside the
 JSON front.  ``SIGHUP`` or ``POST /reload {"snapshot": path}``
 hot-swaps the served weights without dropping the queue (same digest =
-zero recompiles).  ``--demo`` trains a tiny blobs MLP in-process
-instead (a smoke target for the load generator and the docs
-walkthrough).
+zero recompiles).  ``--watch-dir`` closes the train-to-serve loop:
+snapshots the trainer publishes there (``--publish-dir``) are
+manifest-verified, canaried on one replica under mirrored traffic, and
+promoted fleet-wide or auto-rolled back (docs/serving.md "Freshness
+loop"); ``POST /publish`` pushes a pickup without waiting for the
+poll.  ``--demo`` trains a tiny blobs MLP in-process instead (a smoke
+target for the load generator and the docs walkthrough).
 """
 
 import argparse
@@ -59,6 +63,27 @@ def build_parser():
                         "disables)")
     parser.add_argument("--slo-p50-ms", type=float, default=None)
     parser.add_argument("--slo-p99-ms", type=float, default=None)
+    parser.add_argument("--watch-dir", default=None, metavar="DIR",
+                        help="run the train-to-serve freshness loop "
+                        "over this publish directory (the trainer's "
+                        "--publish-dir): new manifest-verified "
+                        "snapshots are canaried on one replica and "
+                        "promoted fleet-wide or auto-rolled back "
+                        "(docs/serving.md)")
+    parser.add_argument("--mirror-fraction", type=float, default=0.25,
+                        help="traffic slice mirrored to the canary "
+                        "replica (shadow-scored, never returned to "
+                        "clients)")
+    parser.add_argument("--min-mirrors", type=int, default=8,
+                        help="clean mirrored pairs required before a "
+                        "canary is promoted")
+    parser.add_argument("--freshness-poll-s", type=float, default=0.5,
+                        help="publish-directory poll interval (POST "
+                        "/publish pushes skip the wait)")
+    parser.add_argument("--no-canary", action="store_true",
+                        help="freshness loop reloads candidates "
+                        "directly (still manifest- and finite-gated) "
+                        "instead of canarying them")
     parser.add_argument("--duration", type=float, default=None,
                         help="serve for N seconds then exit (default: "
                         "until interrupted)")
@@ -128,11 +153,19 @@ def main(argv=None):
         slo_p50_ms=args.slo_p50_ms, slo_p99_ms=args.slo_p99_ms,
         **cache_kwargs)
     receipt = pool.compile()
+    freshness = None
+    if args.watch_dir:
+        from veles_tpu.serve import FreshnessController
+        freshness = FreshnessController(
+            pool, args.watch_dir, poll_s=args.freshness_poll_s,
+            mirror_fraction=args.mirror_fraction,
+            min_mirrors=args.min_mirrors,
+            canary=not args.no_canary).start()
     loader = getattr(sw, "loader", None)
     service = ServeService(
         pool, port=args.port, path=args.path,
         labels_mapping=getattr(loader, "reversed_labels_mapping", None),
-        transport_port=args.transport_port)
+        transport_port=args.transport_port, freshness=freshness)
     service.start_background()
     print("serving on http://127.0.0.1:%d%s with %d replica(s)%s  "
           "(compile receipt: %s)"
@@ -162,6 +195,8 @@ def main(argv=None):
     except KeyboardInterrupt:
         pass
     finally:
+        if freshness is not None:
+            freshness.stop()
         service.stop()
     return 0
 

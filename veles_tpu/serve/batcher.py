@@ -65,9 +65,9 @@ class ServeOverload(Exception):
 
 class _Request(object):
     __slots__ = ("sample", "enqueued", "done", "result", "error",
-                 "cancelled", "block")
+                 "cancelled", "block", "shadow", "latency")
 
-    def __init__(self, sample, block=False):
+    def __init__(self, sample, block=False, shadow=False):
         self.sample = sample
         self.enqueued = time.perf_counter()
         self.done = threading.Event()
@@ -82,6 +82,16 @@ class _Request(object):
         #: hand its buffer to ``Device.put`` verbatim when it fills a
         #: rung exactly (the binary transport's zero-copy hot path)
         self.block = block
+        #: canary-mirror shadow copy (docs/serving.md "Freshness
+        #: loop"): computed and scored like any request but NEVER
+        #: counted in the served metrics (``serve.requests`` /
+        #: ``serve.latency_s``) — shadow traffic must not double-count
+        #: in capacity math or skew the SLO watch
+        self.shadow = shadow
+        #: end-to-end seconds, stamped by the worker at completion —
+        #: the canary comparator reads it off shadow/primary pairs
+        #: instead of re-timing around the Event wait
+        self.latency = None
 
     @property
     def rows(self):
@@ -308,6 +318,26 @@ class ContinuousBatcher(Logger):
                 (block.shape[0], self.engine.max_batch))
         return self._enqueue(_Request(block, block=True))
 
+    def submit_shadow(self, sample):
+        """Best-effort enqueue of a canary-mirror shadow copy: never
+        raises :class:`ServeOverload` — a loaded (or chaos-shedding)
+        canary simply mirrors less — and returns None instead of a
+        request when dropped.  Shadow requests co-batch like real ones
+        but are excluded from the served counters (``serve.requests``,
+        ``serve.latency_s``) and never bump the shed counter: mirrored
+        traffic is an observation, not load."""
+        if self._thread is None or self._stop_ or \
+                self._q.qsize() >= self.max_queue:
+            return None
+        sample = numpy.ascontiguousarray(sample, self.engine.dtype)
+        if sample.shape != self.engine.sample_shape:
+            raise ValueError("expected sample shape %s, got %s" %
+                             (self.engine.sample_shape, sample.shape))
+        try:
+            return self._enqueue(_Request(sample, shadow=True))
+        except ServeOverload:
+            return None  # lost the race with stop(): drop the shadow
+
     def infer(self, sample, timeout=30.0):
         """Blocking submit: returns the output row (numpy) or raises
         the request's error."""
@@ -436,7 +466,12 @@ class ContinuousBatcher(Logger):
             return
         done = time.perf_counter()
         self._m_batches.inc()
-        self._m_requests.inc(n)
+        # served accounting EXCLUDES shadow (canary-mirror) rows: a
+        # mirrored request must never double-count in capacity totals
+        # or skew the SLO latency window (docs/serving.md)
+        served = sum(req.rows for req in batch if not req.shadow)
+        if served:
+            self._m_requests.inc(served)
         self._m_batch.observe(n)
         off = 0
         for req in batch:
@@ -450,7 +485,9 @@ class ContinuousBatcher(Logger):
             else:
                 req.result = host[off]
             off += req.rows
-            self._m_latency.observe(done - req.enqueued)
+            req.latency = done - req.enqueued
+            if not req.shadow:
+                self._m_latency.observe(req.latency)
             req.done.set()
         if _tracer.active:
             args = {"n": n, "rung": rung}
@@ -576,7 +613,17 @@ def serve_snapshot(reg=None):
                         ("serve.shed", "shed"),
                         ("serve.errors", "errors"),
                         ("serve.reloads", "reloads"),
-                        ("serve.rung_cap", "rung_cap")):
+                        ("serve.rung_cap", "rung_cap"),
+                        # freshness loop (docs/serving.md): the serve
+                        # column shows cutover traffic next to load
+                        ("serve.freshness.published",
+                         "freshness_published"),
+                        ("serve.freshness.candidates",
+                         "freshness_candidates"),
+                        ("serve.freshness.promotions", "promotions"),
+                        ("serve.freshness.rollbacks", "rollbacks"),
+                        ("serve.freshness.poisoned_rejected",
+                         "poisoned_rejected")):
         metric = reg.peek(name)
         if metric is not None and metric.value is not None:
             out[short] = metric.value

@@ -47,7 +47,7 @@ from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
 
 __all__ = ["AOTEngine", "model_digest", "enable_persistent_cache",
-           "DEFAULT_LADDER"]
+           "value_digest", "DEFAULT_LADDER"]
 
 #: default batch-shape ladder: singles stay latency-optimal, 128 is the
 #: throughput rung (past it, padding waste beats batching gains for the
@@ -82,6 +82,30 @@ def model_digest(plans, params, sample_shape, extra=None):
                 digest.update(("%s:%s:%s" % (
                     key, tuple(leaf.shape),
                     numpy.dtype(leaf.dtype).str)).encode())
+    return digest.hexdigest()[:16]
+
+
+def value_digest(params):
+    """Fingerprint of the parameter VALUES — the complement of
+    :func:`model_digest`, which deliberately excludes them.  Two
+    snapshots of the same architecture share a model digest (same
+    compiled program) but differ here unless their weights are
+    bit-identical; the freshness loop uses this to name *which* weights
+    a fleet serves (last-good identity, rollback-restored-the-right-
+    thing assertions) without holding the arrays themselves up for
+    comparison."""
+    digest = hashlib.sha256()
+    for entry in params:
+        for key in sorted(entry):
+            leaf = entry[key]
+            digest.update(key.encode())
+            if leaf is None:
+                digest.update(b"none")
+            else:
+                arr = numpy.ascontiguousarray(numpy.asarray(leaf))
+                digest.update(arr.dtype.str.encode())
+                digest.update(repr(arr.shape).encode())
+                digest.update(arr.tobytes())
     return digest.hexdigest()[:16]
 
 

@@ -47,7 +47,7 @@ from veles_tpu.serve.batcher import ContinuousBatcher, ServeOverload
 from veles_tpu.serve.engine import (
     AOTEngine, DEFAULT_LADDER, model_digest)
 
-__all__ = ["Replica", "ReplicaPool", "local_devices",
+__all__ = ["CanaryCutover", "Replica", "ReplicaPool", "local_devices",
            "reload_replicas"]
 
 
@@ -72,13 +72,18 @@ def local_devices(count=None):
 class Replica(object):
     """One engine+batcher pair bound to one device."""
 
-    __slots__ = ("index", "device", "engine", "batcher")
+    __slots__ = ("index", "device", "engine", "batcher", "canary")
 
     def __init__(self, index, device, engine, batcher):
         self.index = index
         self.device = device
         self.engine = engine
         self.batcher = batcher
+        #: True while this replica serves a CANDIDATE digest under
+        #: canary cutover (docs/serving.md "Freshness loop"): pulled
+        #: from live rotation — never a routing pick, never a cascade
+        #: target — and fed only mirrored shadow traffic
+        self.canary = False
 
 
 def reload_replicas(replicas, params, plans=None, sample_shape=None,
@@ -132,6 +137,306 @@ def reload_replicas(replicas, params, plans=None, sample_shape=None,
     return receipt
 
 
+class CanaryCutover(Logger):
+    """The canary state machine of the train-to-serve freshness loop
+    (docs/serving.md "Freshness loop"): how a candidate digest enters a
+    fleet, earns (or loses) its place, and how the fleet snaps back.
+
+    States: ``idle`` -> ``canary`` (one replica serves the candidate,
+    fed only mirrored shadow traffic) -> ``promoting`` (rolling
+    between-batches cutover of the live replicas) -> ``idle``; or
+    ``canary``/``promoting`` -> ``idle`` via :meth:`rollback`.
+
+    The rollback cost contract: every transition that replaces a
+    replica's engine SAVES the previous engine object (still compiled)
+    and every same-digest params swap SAVES the previous params list,
+    so :meth:`rollback` is swap-backs only — **zero new backend
+    compiles by construction**, receipted via
+    ``xla_introspect.compile_delta`` and asserted by
+    tests/test_freshness.py.  The driving policy (watcher, mirroring
+    fraction, comparator verdicts) lives in
+    :mod:`veles_tpu.serve.freshness`; this class owns only the fleet
+    mechanics."""
+
+    def __init__(self, pool):
+        super(CanaryCutover, self).__init__()
+        self.pool = pool
+        self.state = "idle"
+        self.digest = None           # candidate digest under test
+        self._canary_index = None
+        self._saved_engines = {}     # replica index -> pre-cutover engine
+        self._saved_params = {}      # replica index -> pre-swap params
+        # the POOL's reload lock, shared on purpose: a cutover
+        # transition and a ReplicaPool.reload must be mutually
+        # exclusive, or a reload racing begin() could clobber the
+        # canary engine mid-judgment and a later rollback would
+        # restore a pre-reload engine onto one replica (mixed fleet)
+        self._lock = pool._reload_lock
+        self._m_promotions = _registry.counter(
+            "serve.freshness.promotions")
+        self._m_rollbacks = _registry.counter(
+            "serve.freshness.rollbacks")
+
+    @property
+    def canary_replica(self):
+        if self._canary_index is None:
+            return None
+        return self.pool.replicas[self._canary_index]
+
+    @staticmethod
+    def _await_engine(rep, engine, timeout=10.0):
+        """Block until ``rep``'s WORKER adopted ``engine``: swaps apply
+        between batches, so there is a window where the replica still
+        serves the previous one.  The state machine must not treat a
+        swap as done inside that window — a shadow mirrored before the
+        canary engine lands would be scored against the OLD model, and
+        a rolled-back replica rejoining rotation early would serve the
+        REJECTED model to real clients.  (The idle worker applies a
+        pending swap within its 0.2s queue poll.)"""
+        deadline = time.monotonic() + timeout
+        while rep.batcher.engine is not engine and \
+                rep.batcher.running and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return rep.batcher.engine is engine
+
+    def begin(self, engine):
+        """Enter ``canary``: the highest-index live replica swaps to
+        the (already COMPILED) candidate ``engine`` between batches and
+        leaves live rotation.  Replica 0 stays live on purpose — it is
+        the pool's metadata anchor."""
+        with self._lock:
+            if self.state != "idle":
+                raise RuntimeError(
+                    "canary cutover already in state %r" % self.state)
+            if engine.compile_receipt is None:
+                raise RuntimeError(
+                    "begin() needs a COMPILED candidate engine (warm "
+                    "it off the dispatch path first)")
+            live = self.pool._live()
+            if len(live) < 2:
+                raise RuntimeError(
+                    "canary cutover needs >= 2 live replicas (one "
+                    "keeps serving while one tests the candidate); "
+                    "use ReplicaPool.reload for a single-replica fleet")
+            rep = live[-1]
+            self._saved_engines = {rep.index: rep.engine}
+            self._saved_params = {}
+            self._canary_index = rep.index
+            saved = self._saved_engines[rep.index]
+            rep.canary = True
+            # drain BEFORE posting the swap: the replica is out of
+            # rotation now (no new routed arrivals), but requests
+            # already queued were promised the LIVE model — the worker
+            # applies a pending engine at the top of its loop, ahead
+            # of the queue, so swapping first would answer them with
+            # the unjudged candidate
+            deadline = time.monotonic() + 10.0
+            while (rep.batcher._q.qsize() or
+                   rep.batcher._carry is not None) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)  # _carry holds a popped live request
+            if rep.batcher._q.qsize() or \
+                    rep.batcher._carry is not None:
+                rep.canary = False
+                self._saved_engines = {}
+                self._canary_index = None
+                raise RuntimeError(
+                    "canary replica %d queue never drained; aborting "
+                    "begin" % rep.index)
+            rep.batcher.swap_engine(engine)
+            rep.engine = engine
+            if not self._await_engine(rep, engine):
+                # the worker never adopted the candidate (wedged past
+                # the timeout): un-begin — shadows scored against the
+                # OLD model would be falsely-clean evidence
+                rep.batcher.swap_engine(saved)
+                rep.engine = saved
+                rep.canary = False
+                self._saved_engines = {}
+                self._canary_index = None
+                raise RuntimeError(
+                    "canary replica %d did not adopt the candidate "
+                    "engine within the swap window; aborting begin" %
+                    rep.index)
+            self.digest = engine.digest
+            self.state = "canary"
+            _tracer.instant("serve.canary", cat="serve", phase="begin",
+                            replica=rep.index, digest=engine.digest)
+            self.info("canary begun on replica %d: candidate digest %s",
+                      rep.index, engine.digest)
+            return rep
+
+    def shadow(self, sample):
+        """Mirror one sample to the canary replica (best-effort; see
+        ``ContinuousBatcher.submit_shadow``).  Returns the shadow
+        request or None.  Deliberately LOCK-FREE (atomic attribute
+        reads only): promote/rollback hold the state lock across
+        engine compiles, and a client thread mirroring through here
+        must never stall behind them — at worst a shadow lands just as
+        a verdict executes, and shadows are discardable by design."""
+        rep = self.canary_replica if self.state == "canary" else None
+        if rep is None:
+            return None
+        return rep.batcher.submit_shadow(sample)
+
+    def promote(self):
+        """Candidate judged healthy: roll it fleet-wide.  Live replicas
+        already on the candidate's DIGEST swap params in place (zero
+        recompiles); a digest change AOT-warms a fresh engine per
+        replica off the dispatch path, then cuts over between batches —
+        rolling, one replica at a time, so the fleet never has fewer
+        than N-1 replicas serving.  The canary replica rejoins rotation
+        last.  Returns the promotion receipt."""
+        from veles_tpu.observe import xla_introspect
+        with self._lock:
+            if self.state != "canary":
+                raise RuntimeError(
+                    "promote() from state %r (need 'canary')" %
+                    self.state)
+            self.state = "promoting"
+            pool = self.pool
+            canary = self.canary_replica
+            candidate = canary.engine
+            start = time.perf_counter()
+            try:
+                with _tracer.span("serve.canary.promote", cat="serve",
+                                  digest=candidate.digest):
+                    with xla_introspect.compile_delta() as delta:
+                        for rep in pool.replicas:
+                            if rep.index == self._canary_index:
+                                continue
+                            if rep.engine.digest == candidate.digest:
+                                # same architecture: the previous params
+                                # reference is the rollback asset; the
+                                # swap is synchronous (atomic buffer-
+                                # list assignment), no adoption wait
+                                self._saved_params.setdefault(
+                                    rep.index, rep.engine.params)
+                                rep.engine.swap_params(candidate.params)
+                            else:
+                                engine = AOTEngine(
+                                    candidate.plans, candidate.params,
+                                    candidate.sample_shape,
+                                    device=rep.device,
+                                    **dict(pool._engine_kwargs,
+                                           ladder=candidate.ladder))
+                                engine.compile()
+                                self._saved_engines[rep.index] = \
+                                    rep.engine
+                                rep.batcher.swap_engine(engine)
+                                rep.engine = engine
+                                # symmetric with rollback: a wedged
+                                # worker still serving the OLD model
+                                # behind a "promoted" receipt would be
+                                # an invisible mixed fleet
+                                if not self._await_engine(rep, engine):
+                                    raise RuntimeError(
+                                        "replica %d never adopted the "
+                                        "promoted engine" % rep.index)
+            except Exception:
+                # a failed mid-roll promotion must not strand a mixed
+                # fleet: snap every already-cut replica back
+                self.exception(
+                    "promotion of %s failed mid-roll; rolling back",
+                    candidate.digest)
+                self.rollback(reason="promotion failed")
+                raise
+            canary.canary = False
+            self._canary_index = None
+            self._saved_engines = {}
+            self._saved_params = {}
+            self.digest = None
+            self.state = "idle"
+            self._m_promotions.inc()
+            receipt = dict(
+                delta.receipt, verdict="promoted",
+                digest=candidate.digest, replicas=len(pool.replicas),
+                seconds=round(time.perf_counter() - start, 4))
+            _tracer.instant("serve.canary", cat="serve",
+                            phase="promoted", digest=candidate.digest)
+            self.info("canary PROMOTED fleet-wide: %s (%d new compiles, "
+                      "%.2fs)", candidate.digest,
+                      receipt["new_compiles"], receipt["seconds"])
+            return receipt
+
+    def rollback(self, reason=""):
+        """Candidate judged bad (or promotion failed): restore the
+        last-good digest everywhere it was displaced.  Swap-backs only
+        — the saved engines are already compiled and saved params swap
+        in place — so the receipt's ``new_compiles`` is 0 by
+        construction (the acceptance assertion of the freshness
+        soak)."""
+        from veles_tpu.observe import xla_introspect
+        with self._lock:
+            if self.state not in ("canary", "promoting"):
+                raise RuntimeError(
+                    "rollback() from state %r (need 'canary' or "
+                    "'promoting')" % self.state)
+            pool = self.pool
+            bad = self.digest
+            start = time.perf_counter()
+            with xla_introspect.compile_delta() as delta:
+                for index, engine in self._saved_engines.items():
+                    rep = pool.replicas[index]
+                    rep.batcher.swap_engine(engine)
+                    rep.engine = engine
+                for index, params in self._saved_params.items():
+                    pool.replicas[index].engine.swap_params(params)
+            # the restored engines must be LIVE in their workers before
+            # any replica rejoins rotation: a client request served by
+            # the rejected candidate after "rollback" would make the
+            # canary contract a lie.  A replica whose worker never
+            # adopts (wedged past the timeout) STAYS out of rotation —
+            # quarantined-by-flag — rather than rejoining with the
+            # rejected engine still live
+            unadopted = []
+            for index, engine in self._saved_engines.items():
+                if not self._await_engine(pool.replicas[index], engine):
+                    unadopted.append(index)
+            canary = self.canary_replica
+            if canary is not None and canary.index not in unadopted:
+                canary.canary = False
+            for index in unadopted:
+                pool.replicas[index].canary = True
+                self.error(
+                    "replica %d never adopted the restored engine; "
+                    "LEAVING it out of live rotation (restart or "
+                    "reload to recover it)", index)
+            self._canary_index = None
+            self._saved_engines = {}
+            self._saved_params = {}
+            self.digest = None
+            self.state = "idle"
+            self._m_rollbacks.inc()
+            receipt = dict(
+                delta.receipt, verdict="rolled_back", digest=bad,
+                restored_digest=pool.digest, reason=reason,
+                seconds=round(time.perf_counter() - start, 4))
+            if unadopted:
+                receipt["unadopted_replicas"] = unadopted
+            _tracer.instant("serve.canary", cat="serve",
+                            phase="rolled_back", digest=bad,
+                            reason=reason)
+            self.warning(
+                "canary ROLLED BACK: candidate %s rejected (%s); fleet "
+                "restored to %s with %d new compiles", bad,
+                reason or "unspecified", receipt["restored_digest"],
+                receipt["new_compiles"])
+            return receipt
+
+    def snapshot(self):
+        """Plain-data state for /healthz and the dashboard.  Lock-free
+        like :meth:`shadow` — the IO loop must never wait out a
+        promotion's compiles for a health read."""
+        out = {"state": self.state}
+        digest, index = self.digest, self._canary_index
+        if digest is not None:
+            out["candidate_digest"] = digest
+        if index is not None:
+            out["replica"] = index
+        return out
+
+
 class ReplicaPool(Logger):
     """N per-device serving replicas behind one least-loaded router.
 
@@ -162,7 +467,16 @@ class ReplicaPool(Logger):
                                         **self._batcher_kwargs)
             self.replicas.append(Replica(i, device, engine, batcher))
         self.compile_receipt = None
-        self._reload_lock = threading.Lock()
+        # RLock: shared with CanaryCutover (see its __init__), whose
+        # promote() re-enters via rollback() on a failed mid-roll
+        self._reload_lock = threading.RLock()
+        #: the canary state machine (docs/serving.md "Freshness loop")
+        self.cutover = CanaryCutover(self)
+        #: set by the freshness controller while a canary is live:
+        #: called as ``hook(sample, primary_request)`` after every
+        #: successful single-sample submit so a traffic slice can be
+        #: mirrored to the canary replica
+        self.mirror_hook = None
         self._m_replicas = _registry.gauge("serve.replicas")
         self._m_replicas.set(len(self.replicas))
         self._m_depth = _registry.gauge("serve.queue_depth")
@@ -172,11 +486,25 @@ class ReplicaPool(Logger):
 
     @staticmethod
     def _workflow_spec(sw, sample_shape=None):
-        from veles_tpu.compiler import extract_state, workflow_plan
+        from veles_tpu.compiler import workflow_plan
         plans = workflow_plan(sw)
-        state = extract_state(sw)
-        params = [{"weights": s["weights"], "bias": s["bias"]}
-                  for s in state]
+        # read params through the HOST side, not extract_state's
+        # devmem: a freshly-unpickled snapshot (restore_workflow, the
+        # freshness watcher) has no device attached yet, so its Arrays'
+        # devmem is None until someone re-initializes the workflow —
+        # serving only needs the values, and host numpy is exactly what
+        # AOTEngine wants to place per replica device anyway
+        params = []
+        for fwd in sw.forwards:
+            entry = {}
+            for key, arr in (("weights", fwd.weights),
+                             ("bias", fwd.bias)):
+                if arr:
+                    arr.map_read()
+                    entry[key] = numpy.array(arr.mem, copy=True)
+                else:
+                    entry[key] = None
+            params.append(entry)
         if sample_shape is None:
             loader = getattr(sw, "loader", None)
             if loader is not None and loader.minibatch_data:
@@ -198,8 +526,13 @@ class ReplicaPool(Logger):
 
     @property
     def engine(self):
-        """Replica 0's engine: the pool's metadata anchor (digest,
-        ladder, sample shape, dtype) — LIVE across hot reloads."""
+        """The first LIVE replica's engine: the pool's metadata anchor
+        (digest, ladder, sample shape, dtype) — LIVE across hot reloads
+        and canary cutovers (a replica testing a candidate digest must
+        not change what /healthz says the fleet serves)."""
+        for rep in self.replicas:
+            if not rep.canary:
+                return rep.engine
         return self.replicas[0].engine
 
     @property
@@ -249,32 +582,68 @@ class ReplicaPool(Logger):
         self._m_depth.set(sum(rep.batcher._q.qsize()
                               for rep in self.replicas))
 
+    def _live(self):
+        """Replicas in live rotation.  A canary replica is excluded
+        from the routing pick AND from the overload cascade — mirrored
+        shadow traffic is its only diet, so overflow landing there
+        would both overload the measurement and serve real clients
+        from an unjudged candidate — and the fleet's 503 retry_after
+        is computed over the replicas that will actually serve the
+        retry.  Falls back to all replicas if (impossibly) every one
+        is canary."""
+        live = [rep for rep in self.replicas if not rep.canary]
+        return live or self.replicas
+
     def _submit(self, fn):
-        """Least-queue-depth pick with overload cascade: try replicas
-        in depth order; only when EVERY replica sheds does the pool
-        itself shed, with the smallest retry_after any replica offered
-        (the fleet's best promise, not its worst)."""
-        ranked = sorted(self.replicas,
-                        key=lambda rep: rep.batcher._q.qsize())
-        sheds = []
-        for nth, rep in enumerate(ranked):
-            try:
-                req = fn(rep.batcher)
-            except ServeOverload as exc:
-                sheds.append(exc)
-                continue
-            if nth:
-                self._m_cascades.inc()
+        """Least-queue-depth pick with overload cascade: try LIVE
+        replicas in depth order; only when every live replica sheds
+        does the pool itself shed, with the smallest retry_after any
+        live replica offered (the fleet's best promise, not its
+        worst)."""
+        for _ in range(3):
+            ranked = sorted(self._live(),
+                            key=lambda rep: rep.batcher._q.qsize())
+            sheds = []
+            for nth, rep in enumerate(ranked):
+                try:
+                    req = fn(rep.batcher)
+                except ServeOverload as exc:
+                    sheds.append(exc)
+                    continue
+                if rep.canary:
+                    # lost the race with CanaryCutover.begin(): the
+                    # pick was live at ranking time but the replica
+                    # turned canary before the enqueue landed — that
+                    # request would be answered by the unjudged
+                    # candidate.  Cancel it (the worker drops
+                    # cancelled requests at dispatch) and re-route.
+                    req.cancelled = True
+                    continue
+                if nth:
+                    self._m_cascades.inc()
+                self._update_depth()
+                return req
             self._update_depth()
-            return req
-        self._update_depth()
-        raise ServeOverload(
-            "all %d replicas shedding (%s)" %
-            (len(ranked), sheds[-1]),
-            retry_after=min(exc.retry_after for exc in sheds))
+            if sheds:
+                raise ServeOverload(
+                    "all %d live replicas shedding (%s)" %
+                    (len(ranked), sheds[-1]),
+                    retry_after=min(exc.retry_after
+                                    for exc in sheds))
+            # every pick raced a cutover transition: re-rank and retry
+        raise ServeOverload("fleet reconfiguring", retry_after=0.1)
 
     def submit(self, sample):
-        return self._submit(lambda batcher: batcher.submit(sample))
+        req = self._submit(lambda batcher: batcher.submit(sample))
+        hook = self.mirror_hook
+        if hook is not None:
+            try:
+                hook(sample, req)
+            except Exception:
+                # mirroring is an observation: it must never fail (or
+                # slow) the request it observes
+                self.exception("canary mirror hook failed")
+        return req
 
     def submit_block(self, block):
         return self._submit(
@@ -313,10 +682,25 @@ class ReplicaPool(Logger):
         engines — then cut over between batches.  Either way no queued
         request is dropped or failed by the reload itself."""
         with self._reload_lock:
+            # checked INSIDE the shared lock: cutover transitions hold
+            # it too, so the state cannot flip between check and swap
+            if self.cutover.state != "idle":
+                raise RuntimeError(
+                    "hot-reload refused: canary cutover in progress "
+                    "(state %r) — promote or roll back first, or "
+                    "route new models through the freshness loop" %
+                    self.cutover.state)
             receipt = reload_replicas(
                 self.replicas, params, plans=plans,
                 sample_shape=sample_shape, ladder=ladder,
                 engine_kwargs=self._engine_kwargs)
+            # a full-fleet reload re-homogenizes every replica, so a
+            # rollback-quarantined one (canary flag left True because
+            # its worker never adopted the restored engine) is
+            # recovered here — the quarantine error message promises
+            # exactly this
+            for rep in self.replicas:
+                rep.canary = False
             self.info(
                 "hot reload (%s): %s -> %s in %.2fs, %d new compiles",
                 receipt["mode"], receipt["previous_digest"],
@@ -337,7 +721,7 @@ class ReplicaPool(Logger):
 
     def snapshot(self):
         """Plain-data pool state for /healthz and the dashboard."""
-        return {
+        out = {
             "replicas": len(self.replicas),
             "digest": self.digest,
             "queue_depths": [rep.batcher._q.qsize()
@@ -346,3 +730,6 @@ class ReplicaPool(Logger):
                         + ":%d" % getattr(rep.device, "device_index", 0)
                         for rep in self.replicas],
         }
+        if self.cutover.state != "idle":
+            out["canary"] = self.cutover.snapshot()
+        return out

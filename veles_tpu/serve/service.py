@@ -78,7 +78,7 @@ class ServeService(Logger):
     def __init__(self, engine, batcher=None, port=0, path="/infer",
                  labels_mapping=None, executor_workers=64,
                  transport_port=None, transport_secret=None,
-                 **batcher_kwargs):
+                 freshness=None, **batcher_kwargs):
         super(ServeService, self).__init__()
         from veles_tpu.serve.router import ReplicaPool
         if isinstance(engine, ReplicaPool):
@@ -105,6 +105,11 @@ class ServeService(Logger):
         self._transport = None
         self._transport_port = transport_port
         self._transport_secret = transport_secret
+        #: optional FreshnessController (docs/serving.md "Freshness
+        #: loop"): referenced, not owned — the caller manages its
+        #: lifecycle; the service adds the ``POST /publish`` push
+        #: front and the /healthz freshness block
+        self.freshness = freshness
 
     @property
     def engine(self):
@@ -271,6 +276,8 @@ class ServeService(Logger):
                     health["transport_port"] = svc.transport_port
                 if svc.last_reload is not None:
                     health["last_reload"] = svc.last_reload
+                if svc.freshness is not None:
+                    health["freshness"] = svc.freshness.snapshot()
                 self.write(health)
 
         class MetricsHandler(RequestTimer, tornado.web.RequestHandler):
@@ -305,11 +312,35 @@ class ServeService(Logger):
                 else:
                     self.write(receipt)
 
+        class PublishHandler(RequestTimer, tornado.web.RequestHandler):
+            def post(self):
+                """Freshness push: a trainer (or CI) announces a new
+                publish instead of waiting out the poll interval.  The
+                body's ``snapshot`` path is ADVISORY — the watcher
+                still reads LATEST and verifies the manifest before
+                unpickling; a push can never bypass the gate."""
+                if svc.freshness is None:
+                    self.set_status(409)
+                    self.write({"error": "no freshness loop attached "
+                                "(start the service with a "
+                                "FreshnessController / --watch-dir)"})
+                    return
+                try:
+                    body = json.loads(self.request.body or b"{}")
+                except Exception as exc:
+                    self.set_status(400)
+                    self.write({"error": "bad request: %s" % exc})
+                    return
+                svc.freshness.notify(body.get("snapshot"))
+                self.write({"status": "notified",
+                            "freshness": svc.freshness.snapshot()})
+
         return tornado.web.Application([
             (self.path, InferHandler),
             (r"/healthz", HealthHandler),
             (r"/metrics.json", MetricsHandler),
             (r"/reload", ReloadHandler),
+            (r"/publish", PublishHandler),
         ])
 
     def start_background(self):

@@ -46,10 +46,14 @@ from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.units import Unit
 
 __all__ = ["SnapshotterBase", "Snapshotter", "SnapshotError",
-           "RollbackExhausted", "MANIFEST_SUFFIX"]
+           "RollbackExhausted", "MANIFEST_SUFFIX", "LATEST_NAME",
+           "publish_snapshot", "read_latest"]
 
 #: sidecar manifest filename suffix (next to the snapshot it describes)
 MANIFEST_SUFFIX = ".manifest"
+
+#: the publish directory's atomic pointer file (freshness loop)
+LATEST_NAME = "LATEST"
 
 #: module-level logger for the static restore/verify paths
 _log = logging.getLogger("Snapshotter")
@@ -130,6 +134,18 @@ except ImportError:
 SIZE_WARNING = 1 << 30
 
 
+def _write_bytes_atomic(path, data):
+    """The ONE tmp -> fsync -> ``os.replace`` -> dir-fsync sequence for
+    small metadata files (manifests, the publish LATEST pointer)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fout:
+        fout.write(data)
+        fout.flush()
+        os.fsync(fout.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
 def _fsync_file(path):
     fd = os.open(path, os.O_RDONLY)
     try:
@@ -167,6 +183,153 @@ def _manifest_path(path):
     return os.path.realpath(path) + MANIFEST_SUFFIX
 
 
+def read_latest(publish_dir):
+    """The publish directory's ``LATEST`` pointer as a dict, or None
+    when absent/unparseable/mid-replace — the watcher treats every
+    failure mode as "nothing new yet"."""
+    try:
+        with open(os.path.join(publish_dir, LATEST_NAME), "rb") as fin:
+            latest = json.loads(fin.read().decode())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(latest, dict) or "snapshot" not in latest:
+        return None
+    return latest
+
+
+def _next_publish_ordinal(publish_dir):
+    """Next export ordinal: one past the largest already published
+    (scanned from filenames AND the LATEST pointer, so a crashed
+    publish that never flipped LATEST still cannot reuse its
+    ordinal)."""
+    best = 0
+    latest = read_latest(publish_dir)
+    if latest is not None:
+        try:
+            best = int(latest.get("ordinal", 0))
+        except (TypeError, ValueError):
+            best = 0
+    try:
+        names = os.listdir(publish_dir)
+    except OSError:
+        names = []
+    for name in names:
+        head = name.split("_", 1)[0]
+        if head.isdigit():
+            best = max(best, int(head))
+    return best + 1
+
+
+def _copy_atomic(src, dest):
+    """Stream-copy ``src`` to ``dest`` through tmp -> fsync ->
+    os.replace, so the final path is never torn."""
+    tmp = dest + ".tmp"
+    with open(src, "rb") as fin, open(tmp, "wb") as fout:
+        for block in iter(lambda: fin.read(1 << 20), b""):
+            fout.write(block)
+        fout.flush()
+        os.fsync(fout.fileno())
+    os.replace(tmp, dest)
+
+
+def publish_snapshot(path, publish_dir, keep=8):
+    """Publish a manifest-verified snapshot into the watched publish
+    directory — the trainer half of the train-to-serve freshness loop
+    (docs/serving.md "Freshness loop").
+
+    The publish contract the serve-side ``SnapshotWatcher`` relies on:
+
+    - the snapshot bytes and the sidecar manifest are copied (manifest
+      FIRST, both atomically) under an export-ordinal-ordered name
+      ``NNNNNN_<basename>``, so publication order survives clock skew
+      and same-second exports;
+    - only after both are in place does the ``LATEST`` pointer flip
+      (atomic tmp -> ``os.replace``), so a watcher that sees an ordinal
+      can always find its files — a crash at any instant leaves LATEST
+      pointing at a complete previous publish;
+    - the publish dir keeps its own bounded history (``keep`` newest
+      ordinals; the LATEST target always survives) and is EXEMPT from
+      the train directory's ``keep=N`` retention — it is a *view* for
+      the serve fleet, not the training run's crash-recovery history
+      (docs/checkpointing.md).
+
+    An unverifiable snapshot is refused here — the publish side is the
+    first line of the "a poisoned snapshot never reaches the fleet"
+    defense.  Returns ``{"ordinal", "snapshot", "sha256"}``.
+
+    Chaos point ``freshness.publish`` (docs/health.md table):
+    ``truncate`` writes only half the snapshot bytes at the FINAL path
+    (a non-atomic publisher / torn copy — the watcher must
+    skip-and-retry), ``crash`` dies after the copy but before the
+    LATEST flip (stale pointer; the ordinal is burned)."""
+    real = os.path.realpath(path)
+    ok, detail = SnapshotterBase.verify_snapshot(real)
+    if ok is False:
+        raise SnapshotError(
+            "refusing to publish %s: %s" % (path, detail))
+    if ok is None:
+        raise SnapshotError(
+            "refusing to publish %s without a manifest: the watcher "
+            "verifies BEFORE unpickling, an unverifiable snapshot "
+            "could never be accepted (%s)" % (path, detail))
+    os.makedirs(publish_dir, exist_ok=True)
+    ordinal = _next_publish_ordinal(publish_dir)
+    name = "%06d_%s" % (ordinal, os.path.basename(real))
+    dest = os.path.join(publish_dir, name)
+    # manifest first: from the instant the data file exists the watcher
+    # can verify it — there is no window where a complete-looking
+    # snapshot sits beside no manifest
+    _copy_atomic(real + MANIFEST_SUFFIX, dest + MANIFEST_SUFFIX)
+    fault = chaos.plan.fire("freshness.publish") \
+        if chaos.plan is not None else None
+    if fault is not None and fault.action == "truncate":
+        # a torn, NON-atomic copy at the final path: manifest present,
+        # bytes short — exactly the half-written case the watcher's
+        # skip-and-retry discipline exists for
+        with open(real, "rb") as fin:
+            payload = fin.read()
+        with open(dest, "wb") as fout:
+            fout.write(payload[:max(1, len(payload) // 2)])
+    else:
+        _copy_atomic(real, dest)
+    _fsync_dir(publish_dir)
+    if fault is not None and fault.action == "crash":
+        raise chaos.ChaosCrash("simulated crash mid-publish (LATEST "
+                               "not flipped)")
+    manifest = SnapshotterBase.read_manifest(dest) or {}
+    latest = {
+        "version": 1,
+        "ordinal": ordinal,
+        "snapshot": name,
+        "sha256": manifest.get("sha256"),
+        "published": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+    _write_bytes_atomic(
+        os.path.join(publish_dir, LATEST_NAME),
+        json.dumps(latest, indent=1, sort_keys=True).encode())
+    # bounded history: this is a serve-side view, not the training
+    # run's recovery history — prune ordinals past `keep` (the LATEST
+    # target is by construction among the newest)
+    if keep and keep > 0:
+        published = []
+        for entry in os.listdir(publish_dir):
+            head = entry.split("_", 1)[0]
+            if head.isdigit() and not entry.endswith(".tmp") and \
+                    not entry.endswith(MANIFEST_SUFFIX):
+                published.append((int(head), entry))
+        for _, entry in sorted(published)[:-keep]:
+            for victim in (entry, entry + MANIFEST_SUFFIX):
+                try:
+                    os.remove(os.path.join(publish_dir, victim))
+                except OSError:
+                    pass
+    _registry.counter("serve.freshness.published").inc()
+    _tracer.instant("freshness.publish", cat="freshness",
+                    ordinal=ordinal, snapshot=name)
+    return {"ordinal": ordinal, "snapshot": dest,
+            "sha256": latest["sha256"]}
+
+
 class SnapshotterBase(Unit):
     """Common logic: gating, naming, codec selection, restore."""
 
@@ -202,6 +365,16 @@ class SnapshotterBase(Unit):
             "--rollback-budget", type=int, default=None, metavar="N",
             help="in-process divergence rollbacks allowed before the "
                  "run hard-fails (docs/health.md)")
+        parser.add_argument(
+            "--publish-dir", default=None, metavar="DIR",
+            help="also publish every manifest-verified snapshot into "
+                 "this watched directory for the serve fleet's "
+                 "freshness loop (docs/serving.md)")
+        parser.add_argument(
+            "--publish-keep", type=int, default=None, metavar="N",
+            help="published snapshots retained in the publish dir "
+                 "(its own bounded view; the train dir's "
+                 "--snapshot-keep is separate)")
         return parser
 
     @classmethod
@@ -222,6 +395,13 @@ class SnapshotterBase(Unit):
         if getattr(args, "rollback_budget", None) is not None:
             cfg["rollback_budget"] = args.rollback_budget
         root.common.snapshot.update(cfg)
+        fresh = {}
+        if getattr(args, "publish_dir", None):
+            fresh["publish_dir"] = args.publish_dir
+        if getattr(args, "publish_keep", None) is not None:
+            fresh["keep"] = args.publish_keep
+        if fresh:
+            root.common.freshness.update(fresh)
         if getattr(args, "disable_snapshotting", False):
             root.common.disable.update({"snapshotting": True})
 
@@ -245,6 +425,12 @@ class SnapshotterBase(Unit):
         # allowed before the run hard-fails with RollbackExhausted
         self.rollback_budget = kwargs.pop(
             "rollback_budget", cfg.get("rollback_budget", 3))
+        # freshness-loop publishing (docs/serving.md): None = off
+        fresh = root.common.freshness
+        self.publish_dir = kwargs.pop(
+            "publish_dir", fresh.get("publish_dir"))
+        self.publish_keep = kwargs.pop(
+            "publish_keep", fresh.get("keep", 8))
         super(SnapshotterBase, self).__init__(workflow, **kwargs)
         self.skip = Bool(False)
         self.suffix = None
@@ -380,15 +566,9 @@ class SnapshotterBase(Unit):
             "best_metric": best_metric,
             "timestamp": time.strftime("%Y-%m-%d %H:%M:%S"),
         }
-        mpath = destination + MANIFEST_SUFFIX
-        tmp = mpath + ".tmp"
-        with open(tmp, "wb") as fout:
-            fout.write(json.dumps(manifest, indent=1,
-                                  sort_keys=True).encode())
-            fout.flush()
-            os.fsync(fout.fileno())
-        os.replace(tmp, mpath)
-        _fsync_dir(os.path.dirname(mpath) or ".")
+        _write_bytes_atomic(
+            destination + MANIFEST_SUFFIX,
+            json.dumps(manifest, indent=1, sort_keys=True).encode())
         return manifest
 
     @staticmethod
@@ -678,7 +858,12 @@ class Snapshotter(SnapshotterBase):
         self._update_current_link()
         self._record_in_db(destination, len(payload))
         self._apply_retention()
+        # elapsed stamped BEFORE the publish copy: snapshot.write_s is
+        # the checkpoint write cost (docs/checkpointing.md), and the
+        # train-dir snapshot is already durable whether or not the
+        # freshness view gets its copy
         elapsed = time.perf_counter() - start
+        self._publish(destination)
         _registry.counter("snapshot.exports").inc()
         _registry.histogram("snapshot.write_s").observe(elapsed)
         if _tracer.enabled:
@@ -688,6 +873,25 @@ class Snapshotter(SnapshotterBase):
                                    "destination": destination})
         self.info("snapshot -> %s (%.1f MB, %.2f s)", destination,
                   len(payload) / 1e6, elapsed)
+
+    def _publish(self, destination):
+        """Trainer-side freshness hook: push the finished (verified,
+        manifested) snapshot into the publish directory.  A publish
+        failure degrades freshness, not training — warn and continue;
+        the train-dir snapshot is already safe."""
+        if not self.publish_dir:
+            return
+        try:
+            receipt = publish_snapshot(destination, self.publish_dir,
+                                       keep=self.publish_keep)
+        except Exception as exc:
+            self.warning(
+                "snapshot publish to %s failed (%s: %s); training "
+                "continues, the serve fleet keeps its current model",
+                self.publish_dir, type(exc).__name__, exc)
+            return
+        self.info("published snapshot #%d -> %s", receipt["ordinal"],
+                  receipt["snapshot"])
 
     def _write_atomic(self, destination, payload):
         """tmp -> fsync -> os.replace -> directory fsync.  A crash at
