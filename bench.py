@@ -89,6 +89,9 @@ SECTION_EST = {
     # + two warm legs of interleaved slopes; on CPU a tiny compile-
     # fitness GA + cache-hit receipt
     "tune_ab": 60.0,
+    # f32-vs-int8 quantized engine A/B: one PTQ pass + two small AOT
+    # ladders; CPU = parity + receipts, TPU adds interleaved slopes
+    "quant_ab": 50.0,
 }
 
 # a section whose dominant cost (the one-time server compile) loosely
@@ -162,6 +165,11 @@ def _compact_record(value, small, extras):
     tune = extras.get("tune_ab") or {}
     if "speedup" in tune:
         rec["tune_ab_speedup"] = tune["speedup"]
+    quant = extras.get("quant_ab") or {}
+    if "speedup" in quant:
+        rec["quant_ab_speedup"] = quant["speedup"]
+    if "top1_delta_pct" in quant:
+        rec["quant_top1_delta_pct"] = quant["top1_delta_pct"]
     if "wall_s" in extras:
         rec["wall_s"] = extras["wall_s"]
     if extras.get("shed"):
@@ -1122,6 +1130,138 @@ def bench_tune_ab(small):
     return result
 
 
+def bench_quant_ab(small):
+    """f32 vs int8 quantized engine A/B (docs/serving.md "Quantized
+    ladder").
+
+    One MLP spec is post-training-quantized (percentile calibration on
+    a seeded stream) and BOTH engines stand up in one process — two
+    digests, one persistent cache, the quantized ladder beside the f32
+    one exactly as a serving host would run an A/B.
+
+    On CPU the row is parity + machinery evidence (the kernels execute
+    through the Pallas interpreter, whose wall time measures the
+    interpreter): top-1 agreement and max|dprob| between the engines on
+    a seeded stream, the int8 Pallas matmul's bit-exactness vs the
+    jitted interpret-mode reference, and both compile receipts.  On
+    TPU the engines race their throughput rung under the shared
+    interleaved pass-filtered slope discipline — speedup, weather
+    band, and the int8-vs-bf16 peak context so the row reads against
+    the right ceiling."""
+    import jax
+
+    from veles_tpu.backends import Device
+    from veles_tpu.compiler import LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.quant import quantize_model_spec
+    from veles_tpu.serve import AOTEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    fan_in, hidden, classes = (196, 64, 10) if small else (784, 256, 10)
+    rng = numpy.random.RandomState(23)
+    plans = [LayerPlan(All2AllTanh), LayerPlan(All2AllSoftmax)]
+    params = [
+        {"weights": (rng.randn(fan_in, hidden) /
+                     numpy.sqrt(fan_in)).astype(numpy.float32),
+         "bias": numpy.zeros(hidden, numpy.float32)},
+        {"weights": (rng.randn(hidden, classes) /
+                     numpy.sqrt(hidden)).astype(numpy.float32),
+         "bias": numpy.zeros(classes, numpy.float32)},
+    ]
+    calib = rng.rand(512, fan_in).astype(numpy.float32)
+    qparams, calibration = quantize_model_spec(plans, params, calib)
+    rung = 32 if small else 128
+    engines = {}
+    for leg, p in (("f32", params), ("int8", qparams)):
+        # donate=False: the timed legs re-dispatch ONE device batch;
+        # on TPU the default donation would delete it at the first
+        # warm run and every slope sample after would raise
+        engines[leg] = AOTEngine(plans, p, (fan_in,), ladder=(rung,),
+                                 device=Device(), donate=False)
+        engines[leg].compile()
+    result = {
+        "device_kind": jax.devices()[0].device_kind,
+        "rung": rung,
+        "clip_fraction": round(calibration.clip_fraction, 6),
+        "digests": {leg: engines[leg].digest for leg in engines},
+        "compiles": {leg: engines[leg].compile_receipt["new_compiles"]
+                     for leg in engines},
+    }
+
+    # parity row — the accuracy side of the receipt on every backend
+    x = rng.rand(256, fan_in).astype(numpy.float32)
+    y32 = engines["f32"].infer(x)
+    y8 = engines["int8"].infer(x)
+    result["top1_delta_pct"] = round(
+        100.0 * float((y32.argmax(1) != y8.argmax(1)).mean()), 3)
+    result["max_abs_dprob"] = float(numpy.abs(y32 - y8).max())
+
+    # kernel-vs-reference bit-exactness (the QUANT.json anchor)
+    import jax.numpy as jnp
+
+    from veles_tpu.ops.matmul_int8 import (matmul_int8,
+                                           matmul_int8_reference)
+    qa = jnp.asarray(rng.randint(-127, 128, (64, 256)), jnp.int8)
+    qb = jnp.asarray(rng.randint(-127, 128, (256, 128)), jnp.int8)
+    qs = jnp.asarray(rng.rand(128).astype(numpy.float32) * 1e-2)
+    result["pallas_bitexact"] = bool(
+        (numpy.asarray(matmul_int8(qa, qb, qs)) ==
+         numpy.asarray(jax.jit(matmul_int8_reference)(qa, qb, qs)))
+        .all())
+
+    if not on_tpu:
+        result["note"] = ("CPU: Pallas interpreter — parity + compile "
+                          "receipt only; the speedup row rides TPU "
+                          "rounds")
+        return result
+
+    # TPU: interleaved pass-filtered throughput race on the rung
+    from veles_tpu.observe.xla_introspect import (PEAK_BF16_TFLOPS,
+                                                  PEAK_INT8_TFLOPS)
+    from veles_tpu.tune.measure import interleaved_slopes, rank
+
+    batch = x[:rung] if rung <= x.shape[0] else numpy.resize(x, (rung,
+                                                                 fan_in))
+    runners = {}
+    for leg, eng in engines.items():
+        x_dev = eng.device.put(numpy.ascontiguousarray(batch))
+
+        def run(count, eng=eng, x_dev=x_dev):
+            out = None
+            for _ in range(count):
+                out = eng.run(x_dev, rung)
+            jax.block_until_ready(out)
+
+        run(1)  # warm
+        runners[leg] = run
+    repeats = 8 if small else 24
+    samples = interleaved_slopes(runners, 1, repeats + 1, rounds=5)
+    meds = rank(samples)
+    band = 1.0
+    for leg in runners:
+        result.setdefault("legs", {})[leg] = {
+            "spread": _spread(samples[leg])}
+        used = _filter_passes(samples[leg])
+        band = max(band, max(used) / max(float(numpy.median(used)),
+                                         1e-12))
+    kind = result["device_kind"].lower()
+    for table, key in ((PEAK_BF16_TFLOPS, "peak_bf16_tflops"),
+                       (PEAK_INT8_TFLOPS, "peak_int8_tflops")):
+        for sub, tflops in table:
+            if sub in kind:
+                result[key] = tflops
+                break
+    if meds.get("f32") and meds.get("int8"):
+        result["speedup"] = round(meds["f32"] / meds["int8"], 4)
+        result["weather_band"] = round(band, 4)
+        result["beats_weather"] = (result["speedup"]
+                                   > result["weather_band"])
+    else:
+        result["note"] = ("jitter-rejected leg: no honest ranking "
+                          "this round")
+    return result
+
+
 def bench_serve_ab(small):
     """Serving-path A/B (docs/serving.md): sequential single-sample
     inference through the AOT engine vs continuous batching under a
@@ -1420,6 +1560,14 @@ def main():
     tune_res = section("tune_ab", lambda: bench_tune_ab(small))
     if tune_res is not None:
         extras["tune_ab"] = tune_res
+
+    # quantized-inference A/B (docs/serving.md "Quantized ladder"):
+    # f32 vs int8 engine in one process; CPU = parity + bit-exactness
+    # + compile receipts, TPU adds the interleaved speedup row against
+    # the int8 peak
+    quant_res = section("quant_ab", lambda: bench_quant_ab(small))
+    if quant_res is not None:
+        extras["quant_ab"] = quant_res
 
     # AlexNet rows, one program (= one ~60-200 s server compile) each.
     # Batch 256 bf16 = the throughput/MFU sweet spot and the only

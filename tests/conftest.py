@@ -107,6 +107,17 @@ def _schedule_cache_to_tmp(tmp_path, monkeypatch):
 
 
 @pytest.fixture(autouse=True)
+def _calibration_to_tmp(tmp_path, monkeypatch):
+    """The post-training quantization pass writes a calibration
+    sidecar JSON on every quantize (veles_tpu/quant/ptq.py) — those
+    artifacts must land in the test's tmp dir, never in a developer's
+    real ~/.cache where they would accumulate one file per quantizing
+    test forever."""
+    monkeypatch.setenv("VELES_QUANT_CALIB",
+                       str(tmp_path / "quant_calib"))
+
+
+@pytest.fixture(autouse=True)
 def _publish_dir_to_tmp(tmp_path):
     """The freshness loop's publish directory config
     (root.common.freshness.publish_dir, the trainer's --publish-dir /

@@ -183,10 +183,14 @@ def test_warm_cache_reports_zero_new_compiles(
             == after["cache_hits"] - before["cache_hits"])
     # same architecture, new weights -> same digest (the cache must
     # survive retraining); new topology -> different digest
+    from veles_tpu.serve.engine import engine_digest_extra
+    extra = engine_digest_extra(numpy.float32)
     plans2, params2 = _mlp_spec(seed=8)
-    assert model_digest(plans2, params2, (16,)) == warm.digest
+    assert model_digest(plans2, params2, (16,),
+                        extra=extra) == warm.digest
     plans3, params3 = _mlp_spec(seed=7, hidden=32)
-    assert model_digest(plans3, params3, (16,)) != warm.digest
+    assert model_digest(plans3, params3, (16,),
+                        extra=extra) != warm.digest
 
     rng = numpy.random.RandomState(4)
     x = rng.rand(3, 16).astype(numpy.float32)

@@ -237,6 +237,13 @@ _HEALTH_KEYS = (
     ("tune.cache_hits", "tune_cache_hits"),
     ("tune.cache_misses", "tune_cache_misses"),
     ("tune.evals", "tune_evals"),
+    # int8 quantized serving (veles_tpu/quant/, docs/serving.md
+    # "Quantized ladder"): whether this process serves a quantized
+    # engine, and the calibration clip fraction — a clip fraction
+    # drifting up between calibrations means the activation
+    # distribution moved and the published scales are stale
+    ("serve.quantized", "serve_quantized"),
+    ("serve.quant.clip_fraction", "quant_clip_fraction"),
 )
 
 

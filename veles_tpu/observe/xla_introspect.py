@@ -36,9 +36,10 @@ from veles_tpu.observe.metrics import registry as _registry
 
 __all__ = ["CompileWatcher", "watcher", "ensure_installed", "watch",
            "poll_recompiles", "device_memory_gauges", "set_step_flops",
-           "set_fwd_flops", "peak_flops", "mfu_snapshot",
-           "bwd_snapshot", "compile_snapshot", "compile_delta",
-           "PEAK_BF16_TFLOPS"]
+           "set_fwd_flops", "set_step_dtype", "step_dtype",
+           "peak_flops", "mfu_snapshot", "bwd_snapshot",
+           "compile_snapshot", "compile_delta", "PEAK_BF16_TFLOPS",
+           "PEAK_INT8_TFLOPS"]
 
 #: bf16 MXU peak TFLOP/s by device-kind substring (public spec sheets);
 #: bench.py shares this table for its offline MFU context.
@@ -46,6 +47,20 @@ PEAK_BF16_TFLOPS = (
     ("v6", 918.0), ("v5p", 459.0), ("v5", 197.0), ("v4", 275.0),
     ("v3", 123.0), ("v2", 45.0),
 )
+
+#: int8 MXU peak TOP/s by device-kind substring: v5e/v5p/v6 run int8 at
+#: 2x the bf16 rate (spec sheets); v2-v4 have no separate 8-bit mode —
+#: their entries equal bf16 so an int8 MFU there is merely conservative,
+#: never inflated.  The quantized serve engine's MFU/attribution
+#: ceiling (docs/serving.md "Quantized ladder") — dividing an int8
+#: step by the bf16 peak would double-count the headroom the MXU's
+#: 8-bit mode actually provides.
+PEAK_INT8_TFLOPS = (
+    ("v6", 1836.0), ("v5p", 918.0), ("v5", 394.0), ("v4", 275.0),
+    ("v3", 123.0), ("v2", 45.0),
+)
+
+_PEAK_TABLES = {"bf16": PEAK_BF16_TFLOPS, "int8": PEAK_INT8_TFLOPS}
 
 #: the jax.monitoring duration event emitted once per XLA backend
 #: compilation (jaxpr trace / MLIR lowering events are deliberately
@@ -298,6 +313,28 @@ def set_fwd_flops(flops, reg=None):
 
 _peak_cache = {}
 _peak_lock = threading.Lock()
+_step_dtype = ["bf16"]
+
+
+def set_step_dtype(name, reg=None):
+    """Record the DOMINANT arithmetic dtype of the measured step
+    ("bf16" covers the f32/bf16 ladder — one MXU rate; "int8" the
+    quantized level), so :func:`mfu_snapshot` divides by the matching
+    peak instead of always the bf16 ceiling.  Set by the quantized
+    serve engine at compile; training paths keep the default."""
+    if name not in _PEAK_TABLES:
+        raise ValueError("unknown step dtype %r (have %s)" %
+                         (name, sorted(_PEAK_TABLES)))
+    with _peak_lock:
+        _step_dtype[0] = name
+    reg = reg if reg is not None else _registry
+    reg.gauge("xla.step_dtype_int8").set(1 if name == "int8" else 0)
+
+
+def step_dtype():
+    """The recorded dominant step dtype ("bf16" default)."""
+    with _peak_lock:
+        return _step_dtype[0]
 
 
 def _measured_peak():
@@ -327,14 +364,21 @@ def _measured_peak():
     return 2.0 * n * n * n / max(best, 1e-9)
 
 
-def peak_flops():
-    """This process's peak FLOP/s reference for MFU, resolved once:
-    ``VELES_PEAK_TFLOPS`` env override -> TPU device-kind spec table
-    -> measured matmul ceiling (CPU dev runs).  None when jax itself
-    is unusable."""
+def peak_flops(dtype=None):
+    """This process's peak FLOP/s reference for MFU, resolved once per
+    dtype: ``VELES_PEAK_TFLOPS`` env override -> the device-kind spec
+    table for ``dtype`` (``None`` -> the recorded :func:`step_dtype`,
+    so a quantized engine's steps rate against the int8 peak) ->
+    measured matmul ceiling (CPU dev runs, one ceiling for every
+    dtype — the interpreter has no 8-bit mode to rate against).  None
+    when jax itself is unusable."""
+    if dtype is None:
+        dtype = step_dtype()
+    table = _PEAK_TABLES.get(dtype, PEAK_BF16_TFLOPS)
+    key = ("peak", dtype)
     with _peak_lock:
-        if "peak" in _peak_cache:
-            return _peak_cache["peak"]
+        if key in _peak_cache:
+            return _peak_cache[key]
         peak = None
         env = os.environ.get("VELES_PEAK_TFLOPS", "")
         if env:
@@ -346,18 +390,21 @@ def peak_flops():
             try:
                 import jax
                 kind = jax.local_devices()[0].device_kind.lower()
-                for key, tflops in PEAK_BF16_TFLOPS:
-                    if key in kind:
+                for kind_key, tflops in table:
+                    if kind_key in kind:
                         peak = tflops * 1e12
                         break
             except Exception:
                 pass
         if peak is None:
             try:
-                peak = _measured_peak()
+                peak = _peak_cache.get(("measured",))
+                if peak is None:
+                    peak = _measured_peak()
+                    _peak_cache[("measured",)] = peak
             except Exception:
                 peak = None
-        _peak_cache["peak"] = peak
+        _peak_cache[key] = peak
         return peak
 
 

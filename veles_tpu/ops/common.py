@@ -19,7 +19,8 @@ from jax.experimental.pallas import tpu as _pltpu
 
 __all__ = ["interpret_mode", "interpret_for", "pad_to", "unpad", "kernel_cast",
            "ceil_mult", "tpu_compiler_params", "mxu_partial_dot",
-           "pallas_bwd_enabled", "DEBUG_NONFINITE", "PALLAS_BWD_ENV"]
+           "mxu_int8_dot", "pallas_bwd_enabled", "DEBUG_NONFINITE",
+           "PALLAS_BWD_ENV"]
 
 #: opt-in per-call output validation (docs/health.md); the check forces
 #: a device sync per eager kernel call, so it is for debugging only
@@ -115,6 +116,22 @@ def mxu_partial_dot(a, b, precision_level):
                  else jax.lax.Precision.HIGHEST)
     return jnp.dot(a, b, preferred_element_type=jnp.float32,
                    precision=precision)
+
+
+def mxu_int8_dot(a, b):
+    """One MXU tile product ``a @ b`` for int8 operands -> int32
+    partial: the quantized level BELOW the f32/bf16 precision ladder
+    (docs/kernels.md), shared by the int8 matmul kernel and the int8
+    conv forward exactly like :func:`mxu_partial_dot` is shared by the
+    f32/bf16 kernels.
+
+    Integer products and sums are exact, so — unlike the float levels —
+    tile grouping can never change the result: any schedule of this
+    product step accumulates to bit-identical int32 totals, which is
+    what makes the int8 kernels' tuned-vs-static and Pallas-vs-
+    reference parity contracts *bit*-equalities rather than ULP
+    bounds."""
+    return jnp.dot(a, b, preferred_element_type=jnp.int32)
 
 
 def ceil_mult(value, mult):

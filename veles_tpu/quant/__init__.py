@@ -1,0 +1,34 @@
+"""Post-training int8 quantization (docs/serving.md "Quantized
+ladder").
+
+The serve-side cash-in of the TPU paper's 8-bit argument: a trained
+f32 model spec (``plans``/``params``/``sample_shape`` — the same
+triple ``serve.freshness.export_model_spec`` publishes) is calibrated
+against a sample stream and rewritten with per-channel symmetric int8
+weights plus per-layer activation scales.  The quantized spec is
+*still* a model spec — it round-trips through ``export_model_spec`` /
+``publish_snapshot`` and the freshness watcher unchanged, and an
+:class:`~veles_tpu.serve.engine.AOTEngine` built from it detects the
+quantized entries and compiles the int8 forward
+(:mod:`veles_tpu.quant.forward` over ``ops/matmul_int8.py``) instead
+of the f32 one — a quantized engine is "just another digest" to the
+hot-reload/canary/rung-cap machinery.
+
+- :mod:`veles_tpu.quant.ptq` — calibration (min/max or percentile
+  activation ranges, clip-fraction accounting) and the weight
+  quantization pass;
+- :mod:`veles_tpu.quant.forward` — the quantized forward builder
+  (``compiler.build_forward``'s int8 twin) and the spec predicates.
+"""
+
+from veles_tpu.quant.forward import (  # noqa: F401
+    build_quantized_forward, is_quantized_entry, is_quantized_params)
+from veles_tpu.quant.ptq import (  # noqa: F401
+    CalibrationResult, calibrate_activations, calibration_dir,
+    quantize_model_spec, quantize_tensor, quantize_weights)
+
+__all__ = ["CalibrationResult", "build_quantized_forward",
+           "calibrate_activations", "calibration_dir",
+           "is_quantized_entry", "is_quantized_params",
+           "quantize_model_spec", "quantize_tensor",
+           "quantize_weights"]

@@ -1,0 +1,266 @@
+"""Post-training quantization pass: calibrate, scale, round, clip.
+
+Scheme (the TPU paper's serving recipe, zero-point-free):
+
+- **Weights**: per-output-channel symmetric scales — ``s_w[c] =
+  max|W[..., c]| / 127`` (an all-zero channel gets scale 1.0 so the
+  divide stays exact and the channel quantizes to zeros), ``W_q =
+  clip(round(W / s_w), -127, 127)``.  Symmetric means no zero points:
+  the int8 matmul needs no cross-term corrections and the dequant
+  epilogue is one multiply.  ``weight_granularity="tensor"`` collapses
+  to one scale per layer — kept for the accuracy A/B
+  (tests/test_quant.py proves per-channel strictly tighter on
+  channel-skewed weights), not for production.
+- **Activations**: per-tensor symmetric scales calibrated from a
+  sample stream run through the f32 forward — ``mode="minmax"`` takes
+  the observed ``max|x|``; ``mode="percentile"`` (default) takes the
+  ``percentile``-th percentile of ``|x|``, deliberately CLIPPING the
+  outlier tail (saturating a few extreme activations costs less top-1
+  than stretching the whole int8 grid to cover them).  The clipped
+  fraction per layer is part of the calibration record and rides the
+  ``serve.quant.clip_fraction`` gauge — a clip fraction drifting up
+  between calibrations means the activation distribution moved and
+  the scales are stale.
+
+Biases stay f32 (they add AFTER the dequant epilogue; quantizing them
+buys no MXU time and costs accuracy).  The calibration record is
+written as JSON into :func:`calibration_dir` (``VELES_QUANT_CALIB``
+overrides — the test suite routes it to tmp) so a published quantized
+spec always has a sidecar saying how its scales were chosen.
+"""
+
+import json
+import logging
+import os
+
+import numpy
+
+from veles_tpu.observe.metrics import registry as _registry
+
+__all__ = ["CalibrationResult", "calibrate_activations",
+           "calibration_dir", "quantize_model_spec", "quantize_tensor",
+           "quantize_weights", "QMAX"]
+
+logger = logging.getLogger("veles_tpu.quant")
+
+#: symmetric int8 grid: [-127, 127].  -128 is deliberately unused so
+#: the grid is symmetric around zero and |q| * s never overflows the
+#: magnitude the scale was solved for
+QMAX = 127
+
+
+def calibration_dir():
+    """``$VELES_QUANT_CALIB`` or ``<root cache dir>/quant_calib`` —
+    resolved per call so tests can redirect via the environment (the
+    ``_calibration_to_tmp`` conftest fixture)."""
+    env = os.environ.get("VELES_QUANT_CALIB", "")
+    if env:
+        return env
+    from veles_tpu.config import root
+    return os.path.join(root.common.dirs.get("cache", "/tmp"),
+                        "quant_calib")
+
+
+def quantize_tensor(x, scale):
+    """``clip(round(x / scale), -127, 127)`` as int8 — numpy in, numpy
+    out; ``numpy.rint`` is round-half-even, matching ``jnp.round`` so
+    host-side weight quantization and the on-device activation
+    quantization in :mod:`veles_tpu.quant.forward` share one rounding
+    rule."""
+    x = numpy.asarray(x, numpy.float32)
+    q = numpy.rint(x / numpy.asarray(scale, numpy.float32))
+    return numpy.clip(q, -QMAX, QMAX).astype(numpy.int8)
+
+
+def quantize_weights(weights, granularity="channel"):
+    """(W_q int8, scales f32 (Cout,)): per-output-channel symmetric
+    quantization of a weight array — last axis is the output channel
+    for both the all2all (fan_in, fan_out) and conv HWIO (ky, kx, Cin,
+    Cout) layouts, so ONE reduction rule covers both families.
+    ``granularity="tensor"`` broadcasts a single max-over-everything
+    scale to the channel vector (same downstream shape, so the engine
+    path is identical)."""
+    w = numpy.asarray(weights, numpy.float32)
+    cout = w.shape[-1]
+    flat = numpy.abs(w.reshape(-1, cout))
+    if granularity == "channel":
+        amax = flat.max(axis=0)
+    elif granularity == "tensor":
+        amax = numpy.full((cout,), flat.max() if flat.size else 0.0,
+                          numpy.float32)
+    else:
+        raise ValueError("granularity must be 'channel' or 'tensor', "
+                         "got %r" % (granularity,))
+    # an all-zero channel has no magnitude to solve a scale for: scale
+    # 1.0 keeps the divide exact (0/1 == 0) and dequant returns zeros
+    scales = numpy.where(amax > 0, amax / QMAX, 1.0).astype(
+        numpy.float32)
+    return quantize_tensor(w, scales), scales
+
+
+class CalibrationResult(object):
+    """Per-layer activation calibration: what the quantizer consumes
+    and the sidecar JSON records."""
+
+    __slots__ = ("mode", "percentile", "samples", "layers")
+
+    def __init__(self, mode, percentile, samples, layers):
+        self.mode = mode
+        self.percentile = percentile
+        self.samples = int(samples)
+        self.layers = layers  # {layer index: {"act_scale", "amax",
+        #                       "clip_fraction", "cls"}}
+
+    @property
+    def clip_fraction(self):
+        """Mean clipped fraction over the calibrated layers — the
+        one-number health signal the ``serve.quant.clip_fraction``
+        gauge carries."""
+        if not self.layers:
+            return 0.0
+        return float(numpy.mean(
+            [e["clip_fraction"] for e in self.layers.values()]))
+
+    def to_dict(self):
+        return {"mode": self.mode, "percentile": self.percentile,
+                "samples": self.samples,
+                "clip_fraction": round(self.clip_fraction, 6),
+                "layers": {str(i): dict(e)
+                           for i, e in sorted(self.layers.items())}}
+
+    def save(self, path=None):
+        """Write the sidecar JSON record; returns the path."""
+        if path is None:
+            digest = "%08x" % (hash(tuple(sorted(
+                (i, round(e["act_scale"], 9))
+                for i, e in self.layers.items()))) & 0xffffffff)
+            path = os.path.join(calibration_dir(),
+                                "calib_%s.json" % digest)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fout:
+            json.dump(self.to_dict(), fout, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+
+def _quantizable(plan, entry):
+    """True for layers the int8 path covers: parameterized all2all and
+    conv forwards.  Everything else (pooling, dropout, and any future
+    family) stays f32 — the quantized forward mixes levels per layer."""
+    if entry.get("weights") is None:
+        return False
+    from veles_tpu.models.all2all import All2All
+    from veles_tpu.models.conv import Conv
+    return issubclass(plan.forward_cls, (All2All, Conv))
+
+
+def calibrate_activations(plans, params, samples, mode="percentile",
+                          percentile=99.9):
+    """Run ``samples`` through the f32 forward, recording each
+    quantizable layer's INPUT range; returns a
+    :class:`CalibrationResult`.
+
+    The stats are taken on the f32 activations (standard PTQ: the
+    quantized net sees slightly different inputs layer by layer, but
+    the drift is second-order next to the grid resolution).  The walk
+    is the shared :func:`veles_tpu.quant.forward.walk_forward` —
+    dropout identity, softmax-keeps-logits — so the statistics are
+    solved on EXACTLY the activations the f32 reference produces; the
+    stream should be representative serving traffic — a training-set
+    slice or a traffic capture."""
+    import jax.numpy as jnp
+
+    from veles_tpu.quant.forward import f32_layer_apply, walk_forward
+
+    if mode not in ("minmax", "percentile"):
+        raise ValueError("mode must be 'minmax' or 'percentile', got %r"
+                         % (mode,))
+    x = numpy.asarray(samples, numpy.float32)
+    if x.ndim and x.shape[0] == 0:
+        raise ValueError("calibration needs a non-empty sample stream")
+    layers = {}
+
+    def record_then_apply(i, plan, entry, h):
+        if _quantizable(plan, entry):
+            vals = numpy.abs(numpy.asarray(h, numpy.float32)).ravel()
+            full = float(vals.max()) if vals.size else 0.0
+            if mode == "percentile" and vals.size:
+                amax = float(numpy.percentile(vals, percentile))
+            else:
+                amax = full
+            if amax <= 0:
+                amax = 1.0  # degenerate stream: identity-safe scale
+            clipped = float(numpy.mean(vals > amax)) if vals.size \
+                else 0.0
+            layers[i] = {
+                "act_scale": amax / QMAX, "amax": amax,
+                "observed_max": full,
+                "clip_fraction": round(clipped, 6),
+                "cls": plan.forward_cls.__name__}
+        # advance on the f32 level; entries may carry solver state
+        # (a zoo training state) — the forward sees weights/bias only
+        fentry = {"weights": entry.get("weights"),
+                  "bias": entry.get("bias")}
+        return f32_layer_apply(plan, fentry, h)
+
+    walk_forward(plans, params, jnp.asarray(x), record_then_apply)
+    result = CalibrationResult(mode, percentile, x.shape[0], layers)
+    _registry.gauge("serve.quant.clip_fraction").set(
+        round(result.clip_fraction, 6))
+    return result
+
+
+def quantize_model_spec(plans, params, samples=None, calibration=None,
+                        mode="percentile", percentile=99.9,
+                        weight_granularity="channel",
+                        save_report=True):
+    """The post-training quantization pass: f32 (plans, params) -> the
+    quantized params list; plans are unchanged (the architecture IS
+    the same — only the arithmetic level differs).
+
+    Quantizable entries come back as ``{"weights": int8,
+    "weights_scale": f32 (Cout,), "act_scale": f32 scalar, "bias":
+    f32}`` — arrays only, so ``AOTEngine._put_params`` ships them to
+    the device unmodified and ``model_digest`` separates them from
+    the f32 source by dtype and key set.  Non-quantizable entries
+    keep their ``{"weights", "bias"}`` shape.  The result pickles
+    through ``export_model_spec``/``publish_snapshot`` and back
+    bit-identically (tests/test_quant.py round-trip).
+
+    Pass ``samples`` (a calibration stream) or a precomputed
+    ``calibration``; returns ``(qparams, calibration)``."""
+    if calibration is None:
+        if samples is None:
+            raise ValueError("need samples or a CalibrationResult")
+        calibration = calibrate_activations(
+            plans, params, samples, mode=mode, percentile=percentile)
+    qparams = []
+    for i, (plan, entry) in enumerate(zip(plans, params)):
+        if not _quantizable(plan, entry) or i not in calibration.layers:
+            qparams.append({
+                "weights": None if entry.get("weights") is None
+                else numpy.asarray(entry["weights"], numpy.float32),
+                "bias": None if entry.get("bias") is None
+                else numpy.asarray(entry["bias"], numpy.float32)})
+            continue
+        w_q, scales = quantize_weights(entry["weights"],
+                                       granularity=weight_granularity)
+        qparams.append({
+            "weights": w_q,
+            "weights_scale": scales,
+            "act_scale": numpy.asarray(
+                calibration.layers[i]["act_scale"], numpy.float32),
+            "bias": None if entry.get("bias") is None
+            else numpy.asarray(entry["bias"], numpy.float32)})
+    if save_report:
+        try:
+            path = calibration.save()
+            logger.info("quantized %d/%d layers (%s, clip %.4f%%); "
+                        "calibration record: %s",
+                        len(calibration.layers), len(plans),
+                        weight_granularity,
+                        100.0 * calibration.clip_fraction, path)
+        except OSError as exc:  # a read-only cache must not fail PTQ
+            logger.warning("calibration record not written: %s", exc)
+    return qparams, calibration

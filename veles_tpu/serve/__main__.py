@@ -84,10 +84,58 @@ def build_parser():
                         help="freshness loop reloads candidates "
                         "directly (still manifest- and finite-gated) "
                         "instead of canarying them")
+    parser.add_argument("--quantize", action="store_true",
+                        help="post-training-quantize the model to int8 "
+                        "before serving (docs/serving.md 'Quantized "
+                        "ladder'): per-channel symmetric weight scales "
+                        "+ activation scales calibrated from "
+                        "--calibrate (or the loader's data)")
+    parser.add_argument("--calibrate", default=None, metavar="FILE.npy",
+                        help="calibration sample stream for --quantize "
+                        "(numpy .npy of shape (N,) + sample_shape); "
+                        "default: the loader's first samples, else a "
+                        "random stream (smoke-grade scales, warned)")
+    parser.add_argument("--calibration-percentile", type=float,
+                        default=99.9,
+                        help="abs-activation percentile the int8 grid "
+                        "covers (100 = min/max calibration)")
     parser.add_argument("--duration", type=float, default=None,
                         help="serve for N seconds then exit (default: "
                         "until interrupted)")
     return parser
+
+
+def _quantize_spec(sw, args):
+    """--quantize: extract the f32 spec from the workflow, calibrate,
+    and return the quantized (plans, params, sample_shape) triple."""
+    import numpy
+
+    from veles_tpu.quant import quantize_model_spec
+    from veles_tpu.serve.router import ReplicaPool
+
+    plans, params, sample_shape = ReplicaPool._workflow_spec(sw)
+    if args.calibrate:
+        samples = numpy.load(args.calibrate)
+    else:
+        loader = getattr(sw, "loader", None)
+        data = getattr(loader, "original_data", None)
+        if data is not None and data:
+            samples = numpy.asarray(data.mem[:1024], numpy.float32)
+        else:
+            print("WARNING: no calibration stream (--calibrate) and no "
+                  "loader data; calibrating on random samples — "
+                  "smoke-grade activation scales only")
+            rng = numpy.random.RandomState(11)
+            samples = rng.randn(
+                256, *sample_shape).astype(numpy.float32)
+    mode = ("minmax" if args.calibration_percentile >= 100.0
+            else "percentile")
+    qparams, calib = quantize_model_spec(
+        plans, params, samples, mode=mode,
+        percentile=args.calibration_percentile)
+    print("quantized %d/%d layers (clip fraction %.5f)"
+          % (len(calib.layers), len(plans), calib.clip_fraction))
+    return plans, qparams, sample_shape
 
 
 def _demo_workflow():
@@ -147,11 +195,16 @@ def main(argv=None):
         cache_kwargs["persistent_cache"] = True
         if args.cache_root:
             cache_kwargs["cache_root"] = args.cache_root
-    pool = ReplicaPool.from_workflow(
-        sw, replicas=args.replicas, ladder=ladder,
+    pool_kwargs = dict(
+        replicas=args.replicas, ladder=ladder,
         max_delay_s=args.max_delay_ms / 1e3, max_queue=args.max_queue,
         slo_p50_ms=args.slo_p50_ms, slo_p99_ms=args.slo_p99_ms,
         **cache_kwargs)
+    if args.quantize:
+        plans, qparams, sample_shape = _quantize_spec(sw, args)
+        pool = ReplicaPool(plans, qparams, sample_shape, **pool_kwargs)
+    else:
+        pool = ReplicaPool.from_workflow(sw, **pool_kwargs)
     receipt = pool.compile()
     freshness = None
     if args.watch_dir:

@@ -614,6 +614,12 @@ def serve_snapshot(reg=None):
                         ("serve.errors", "errors"),
                         ("serve.reloads", "reloads"),
                         ("serve.rung_cap", "rung_cap"),
+                        # int8 quantized engine flag + calibration
+                        # clip health (docs/serving.md "Quantized
+                        # ladder")
+                        ("serve.quantized", "quantized"),
+                        ("serve.quant.clip_fraction",
+                         "quant_clip_fraction"),
                         # freshness loop (docs/serving.md): the serve
                         # column shows cutover traffic next to load
                         ("serve.freshness.published",

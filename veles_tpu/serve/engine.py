@@ -47,7 +47,27 @@ from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
 
 __all__ = ["AOTEngine", "model_digest", "enable_persistent_cache",
+           "engine_digest_extra", "publish_quantized_state",
            "value_digest", "DEFAULT_LADDER"]
+
+
+def publish_quantized_state(quantized):
+    """Publish the process's served-arithmetic level: the
+    ``serve.quantized`` gauge (serve_snapshot / healthz / heartbeats)
+    and the MFU-ceiling dtype (``xla_introspect.set_step_dtype`` —
+    int8 steps must not rate against the bf16 peak).
+
+    Process-global, so it must track what the fleet actually SERVES:
+    ``AOTEngine.compile`` publishes its own level (cold starts,
+    standalone engines, new-digest reload warm-ups), and every
+    transition that can change the live fleet without a compile —
+    canary promote/rollback are swap-backs with 0 compiles by
+    construction — republishes from the pool's live anchor engine, so
+    a REJECTED quantized canary cannot leave an f32 fleet branded
+    quantized (and rating MFU against the int8 peak) forever."""
+    from veles_tpu.observe import xla_introspect
+    _registry.gauge("serve.quantized").set(1 if quantized else 0)
+    xla_introspect.set_step_dtype("int8" if quantized else "bf16")
 
 #: default batch-shape ladder: singles stay latency-optimal, 128 is the
 #: throughput rung (past it, padding waste beats batching gains for the
@@ -83,6 +103,19 @@ def model_digest(plans, params, sample_shape, extra=None):
                     key, tuple(leaf.shape),
                     numpy.dtype(leaf.dtype).str)).encode())
     return digest.hexdigest()[:16]
+
+
+def engine_digest_extra(dtype):
+    """The ``extra`` an AOTEngine mixes into :func:`model_digest`: the
+    ladder's INPUT dtype.  Param shapes/dtypes already ride the digest
+    (so an int8-quantized spec and its f32 source can never collide —
+    the regression test in tests/test_quant.py), but the input dtype
+    determines the compiled program too and lives nowhere in the
+    params: two engines serving the same weights at f32 vs bf16 inputs
+    would otherwise share one persistent-cache directory and one
+    freshness last-good identity.  Shared by ``AOTEngine`` and the
+    router's ``reload_replicas`` so their digests agree byte-for-byte."""
+    return {"input_dtype": numpy.dtype(dtype).str}
 
 
 def value_digest(params):
@@ -177,7 +210,13 @@ class AOTEngine(Logger):
         self.device = device
         self.dtype = numpy.dtype(dtype)
         self.donate = donate
-        self.digest = model_digest(plans, self.params, self.sample_shape)
+        # int8-quantized spec (docs/serving.md "Quantized ladder"): the
+        # quantization pass's artifacts in the entries are the ONLY
+        # flag — no side channel through snapshots/publishes needed
+        from veles_tpu.quant.forward import is_quantized_params
+        self.quantized = is_quantized_params(self.params)
+        self.digest = model_digest(plans, self.params, self.sample_shape,
+                                   extra=engine_digest_extra(self.dtype))
         self.cache_root = cache_root
         self.cache_dir = None
         if persistent_cache or cache_root is not None:
@@ -246,7 +285,15 @@ class AOTEngine(Logger):
         start = time.perf_counter()
         with xla_introspect.compile_delta() as delta:
             self._params_dev = self._put_params(self.params)
-            forward = build_forward(self.plans)
+            if self.quantized:
+                # the int8 ladder: same plans, the quantized forward
+                # (quant/forward.py) over the int8 Pallas kernels —
+                # "just another digest" to everything downstream
+                from veles_tpu.quant.forward import \
+                    build_quantized_forward
+                forward = build_quantized_forward(self.plans)
+            else:
+                forward = build_forward(self.plans)
             donate = self._donate_argnums()
             for rung in self.ladder:
                 x_aval = jax.ShapeDtypeStruct(
@@ -264,7 +311,12 @@ class AOTEngine(Logger):
             rungs=list(self.ladder),
             seconds=round(elapsed, 4),
             cache_dir=self.cache_dir,
+            quantized=self.quantized,
         )
+        # the quantized-engine flag + int8 MFU-ceiling accounting
+        # (docs/serving.md): serve_snapshot / healthz read the gauge,
+        # and mfu_snapshot must not divide int8 steps by the bf16 peak
+        publish_quantized_state(self.quantized)
         try:
             # tuned-schedule provenance beside the compile-cache
             # receipt: which road the kernel tiles took during this
@@ -307,7 +359,8 @@ class AOTEngine(Logger):
         engine + ladder warm-up (the router's reload path).
         """
         params = [dict(entry) for entry in params]
-        digest = model_digest(self.plans, params, self.sample_shape)
+        digest = model_digest(self.plans, params, self.sample_shape,
+                              extra=engine_digest_extra(self.dtype))
         if digest != self.digest:
             raise ValueError(
                 "swap_params digest mismatch (%s != %s): architecture "

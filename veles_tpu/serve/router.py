@@ -45,7 +45,8 @@ from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.serve.batcher import ContinuousBatcher, ServeOverload
 from veles_tpu.serve.engine import (
-    AOTEngine, DEFAULT_LADDER, model_digest)
+    AOTEngine, DEFAULT_LADDER, engine_digest_extra, model_digest,
+    publish_quantized_state)
 
 __all__ = ["CanaryCutover", "Replica", "ReplicaPool", "local_devices",
            "reload_replicas"]
@@ -103,7 +104,11 @@ def reload_replicas(replicas, params, plans=None, sample_shape=None,
     new_shape = tuple(sample_shape) if sample_shape is not None \
         else current.sample_shape
     params = [dict(entry) for entry in params]
-    new_digest = model_digest(new_plans, params, new_shape)
+    # the engines' own digest recipe, input dtype included — a reload
+    # that changes only the arithmetic level (f32 -> int8 spec) must
+    # compare as a DIFFERENT digest and take the new-engine road
+    new_digest = model_digest(new_plans, params, new_shape,
+                              extra=engine_digest_extra(current.dtype))
     same = (new_digest == current.digest and
             (ladder is None or
              tuple(sorted({int(b) for b in ladder})) == current.ladder))
@@ -134,6 +139,10 @@ def reload_replicas(replicas, params, plans=None, sample_shape=None,
         previous_digest=current.digest, replicas=len(replicas),
         seconds=round(time.perf_counter() - start, 4))
     _registry.counter("serve.reloads").inc()
+    # the fleet's served arithmetic level may have changed (f32 <->
+    # int8 reload); the same-digest road compiles nothing, so the
+    # flag must be republished here, from what is live now
+    publish_quantized_state(replicas[0].engine.quantized)
     return receipt
 
 
@@ -348,6 +357,8 @@ class CanaryCutover(Logger):
             self.digest = None
             self.state = "idle"
             self._m_promotions.inc()
+            # the fleet now serves the candidate's arithmetic level
+            publish_quantized_state(pool.engine.quantized)
             receipt = dict(
                 delta.receipt, verdict="promoted",
                 digest=candidate.digest, replicas=len(pool.replicas),
@@ -408,6 +419,12 @@ class CanaryCutover(Logger):
             self.digest = None
             self.state = "idle"
             self._m_rollbacks.inc()
+            # rollback is swap-backs only (0 compiles by construction)
+            # so nothing recompiled to republish the level: a rejected
+            # quantized candidate's warm-up flipped the process-global
+            # flag/MFU ceiling, and the restored fleet must flip it
+            # back (regression: tests/test_quant.py)
+            publish_quantized_state(pool.engine.quantized)
             receipt = dict(
                 delta.receipt, verdict="rolled_back", digest=bad,
                 restored_digest=pool.digest, reason=reason,

@@ -23,11 +23,12 @@ from veles_tpu.tune.cache import (  # noqa: F401
     record_specs, schedule_for, schedule_key, tune_counters)
 from veles_tpu.tune.measure import filter_passes  # noqa: F401
 from veles_tpu.tune.spec import (  # noqa: F401
-    FAMILIES, conv_vjp_spec, family_for, matmul_spec, pool_bwd_spec,
-    valid_schedule)
+    FAMILIES, conv_vjp_spec, family_for, matmul_int8_spec,
+    matmul_spec, pool_bwd_spec, valid_schedule)
 
 __all__ = ["ScheduleCache", "cache_for", "default_cache_dir",
            "provenance", "record_specs", "schedule_for",
            "schedule_key", "tune_counters", "filter_passes",
-           "FAMILIES", "family_for", "matmul_spec", "conv_vjp_spec",
-           "pool_bwd_spec", "valid_schedule"]
+           "FAMILIES", "family_for", "matmul_spec",
+           "matmul_int8_spec", "conv_vjp_spec", "pool_bwd_spec",
+           "valid_schedule"]

@@ -33,8 +33,8 @@ import logging
 
 from veles_tpu.genetics.config import Tune
 
-__all__ = ["FAMILIES", "family_for", "matmul_spec", "conv_vjp_spec",
-           "pool_bwd_spec", "valid_schedule",
+__all__ = ["FAMILIES", "family_for", "matmul_spec", "matmul_int8_spec",
+           "conv_vjp_spec", "pool_bwd_spec", "valid_schedule",
            "matmul_seed_candidates", "TUNE_VMEM_BUDGET_BYTES"]
 
 logger = logging.getLogger("veles_tpu.tune")
@@ -188,6 +188,92 @@ class MatmulFamily(object):
                     out = matmul(a, b, precision_level=level,
                                  blocks=blocks)
                 jax.block_until_ready(out)
+
+        def warm():
+            run(1)
+
+        return warm, run
+
+
+class MatmulInt8Family(object):
+    """(bm, bn, bk) tiles of the int8 quantized matmul
+    (``ops/matmul_int8.py``) — its OWN family: int8 shifts the
+    MXU-legal quanta (sublane 32 on M vs f32's 8, lanes still 128) and
+    the VMEM balance (1-byte operand tiles vs a 4-byte int32
+    accumulator), so f32-tuned tiles are off-grid here and the digest
+    carries ``MATMUL_INT8_KERNEL_VERSION`` so neither family can ever
+    serve the other."""
+
+    name = "matmul_int8"
+
+    def space(self, spec):
+        mp, kp, np_ = spec["shape"]
+        return {
+            "bm": Tune(min(256, mp), 32, min(1024, mp)),
+            "bn": Tune(min(512, np_), 128, min(2048, np_)),
+            "bk": Tune(min(512, kp), 128, min(2048, kp)),
+        }
+
+    def quantize(self, spec, genes):
+        mp, kp, np_ = spec["shape"]
+        return {"blocks": [
+            _quant(genes["bm"], 32, 32, min(1024, mp)),
+            _quant(genes["bn"], 128, 128, min(2048, np_)),
+            _quant(genes["bk"], 128, 128, min(2048, kp)),
+        ]}
+
+    def feasible(self, spec, schedule):
+        bm, bn, bk = schedule["blocks"]
+        footprint = (bm * bk + bk * bn     # int8 a + b blocks (1 B)
+                     + bm * bn * 4         # int32 accumulator
+                     + bm * bn * 4         # f32 out block
+                     + 2 * bn * 4)         # scale + bias rows
+        return footprint <= TUNE_VMEM_BUDGET_BYTES
+
+    def seeds(self, spec):
+        return [{"blocks": list(c)} for c in
+                [(256, 512, 512), (512, 512, 512), (256, 256, 512),
+                 (512, 512, 1024), (256, 512, 1024), (128, 512, 512)]]
+
+    def default(self, spec):
+        from veles_tpu.ops import matmul_int8 as _m
+        return {"blocks": list(_m._DEFAULT_BLOCKS)}
+
+    def genes_of(self, schedule):
+        bm, bn, bk = schedule["blocks"]
+        return {"bm": bm, "bn": bn, "bk": bk}
+
+    def validate(self, schedule):
+        blocks = schedule.get("blocks")
+        if (isinstance(blocks, (list, tuple)) and len(blocks) == 3
+                and all(isinstance(b, int) and b > 0 for b in blocks)
+                and blocks[0] % 32 == 0 and blocks[1] % 128 == 0
+                and blocks[2] % 128 == 0):
+            return {"blocks": [int(b) for b in blocks]}
+        return None
+
+    def build_runner(self, spec, schedule):
+        """Queued-dispatch runner: the int8 matmul's output is f32, so
+        there is no dependent int8 chain to thread — ``run(n)`` queues
+        n dispatches and blocks once, like the rectangular f32 path."""
+        import jax
+        import jax.numpy as jnp
+        import numpy
+
+        from veles_tpu.ops.matmul_int8 import matmul_int8
+
+        m, k, n = spec.get("raw", {}).get("mkn", spec["shape"])
+        rng = numpy.random.RandomState(17)
+        a = jnp.asarray(rng.randint(-127, 128, (m, k)), jnp.int8)
+        b = jnp.asarray(rng.randint(-127, 128, (k, n)), jnp.int8)
+        scale = jnp.asarray(rng.rand(n) * 1e-3 + 1e-4, jnp.float32)
+        blocks = tuple(schedule["blocks"])
+
+        def run(count):
+            out = None
+            for _ in range(count):
+                out = matmul_int8(a, b, scale, blocks=blocks)
+            jax.block_until_ready(out)
 
         def warm():
             run(1)
@@ -371,6 +457,7 @@ class PoolBwdFamily(object):
 
 FAMILIES = {
     "matmul": MatmulFamily(),
+    "matmul_int8": MatmulInt8Family(),
     "conv_vjp": ConvVjpFamily(),
     "pool_bwd": PoolBwdFamily(),
 }
@@ -416,6 +503,23 @@ def matmul_spec(m, k, n, dtype, precision_level):
         "dtype": str(dtype),
         "precision_level": int(precision_level),
         "extra": {"kernel_version": MATMUL_KERNEL_VERSION},
+        "raw": {"mkn": [int(m), int(k), int(n)]},
+    }
+
+
+def matmul_int8_spec(m, k, n):
+    """The int8 matmul consult/tune spec: shape PADDED to the int8 MXU
+    quanta (sublane 32 on M, lane 128 on K/N); dtype is pinned
+    ``int8`` and the precision level 0 — the int8 level has no
+    sub-ladder (integer accumulation is already exact)."""
+    from veles_tpu.ops.matmul_int8 import MATMUL_INT8_KERNEL_VERSION
+    return {
+        "op": "matmul_int8",
+        "shape": [_ceil_mult(int(m), 32), _ceil_mult(int(k), 128),
+                  _ceil_mult(int(n), 128)],
+        "dtype": "int8",
+        "precision_level": 0,
+        "extra": {"kernel_version": MATMUL_INT8_KERNEL_VERSION},
         "raw": {"mkn": [int(m), int(k), int(n)]},
     }
 
