@@ -92,6 +92,10 @@ SECTION_EST = {
     # f32-vs-int8 quantized engine A/B: one PTQ pass + two small AOT
     # ladders; CPU = parity + receipts, TPU adds interleaved slopes
     "quant_ab": 50.0,
+    # flash-vs-stock attention A/B (docs/kernels.md): two grad
+    # programs per shape; CPU = compile + parity, TPU adds the
+    # interleaved pass-filtered slope rounds
+    "attention_ab": 60.0,
 }
 
 # a section whose dominant cost (the one-time server compile) loosely
@@ -170,6 +174,9 @@ def _compact_record(value, small, extras):
         rec["quant_ab_speedup"] = quant["speedup"]
     if "top1_delta_pct" in quant:
         rec["quant_top1_delta_pct"] = quant["top1_delta_pct"]
+    attn = extras.get("attention_ab") or {}
+    if "speedup" in attn:
+        rec["attention_ab_speedup"] = attn["speedup"]
     if "wall_s" in extras:
         rec["wall_s"] = extras["wall_s"]
     if extras.get("shed"):
@@ -1041,6 +1048,112 @@ def bench_bwd_ab(small):
     return result
 
 
+def bench_attention_ab(small):
+    """Flash-vs-stock-autodiff attention A/B (docs/kernels.md "The
+    attention kernel"): the SAME (B, T, dh) attention gradient program
+    built twice — stock jnp softmax attention under jax.grad
+    (``attention_reference``, the ``VELES_PALLAS_BWD=0`` path) vs the
+    tiled online-softmax Pallas forward + hand-scheduled backward pair
+    (``flash_attention``'s custom_vjp).  Both legs compile and
+    parity-check everywhere; interleaved round-robin slope rounds run
+    only on real TPU backends through ``tune/measure.py``'s ONE
+    discipline (``interleaved_slopes`` + positive-majority ``rank`` +
+    ``filter_passes``) — on CPU the kernels execute through the Pallas
+    interpreter, whose wall time measures the interpreter, not the
+    schedule, so the CPU row is compile+parity evidence only.  The
+    published ``weather_band`` is the per-leg max/median slope ratio:
+    a speedup inside it is congestion, not code."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.ops.attention import (attention_reference,
+                                         flash_attention)
+    from veles_tpu.tune.measure import interleaved_slopes, rank
+
+    on_tpu = jax.default_backend() == "tpu"
+    b, t, dh = (4, 128, 64) if (small or not on_tpu) else (8, 1024, 64)
+    rng = numpy.random.RandomState(29)
+    q = jax.device_put(rng.randn(b, t, dh).astype(numpy.float32) * 0.1)
+    k = jax.device_put(rng.randn(b, t, dh).astype(numpy.float32) * 0.1)
+    v = jax.device_put(rng.randn(b, t, dh).astype(numpy.float32) * 0.1)
+
+    def grad_of(attn):
+        return jax.jit(jax.grad(
+            lambda q_, k_, v_: jnp.sum(attn(q_, k_, v_) ** 2),
+            argnums=(0, 1, 2)))
+
+    legs, rows = {}, {}
+    for leg, attn in (("stock_autodiff",
+                       lambda *a: attention_reference(*a)),
+                      ("flash", lambda *a: flash_attention(*a))):
+        fn = grad_of(attn)
+        t0 = time.perf_counter()
+        out = fn(q, k, v)
+        jax.block_until_ready(out)
+        rows[leg] = {"compile_s": round(time.perf_counter() - t0, 3)}
+        legs[leg] = (fn, out)
+
+    # parity receipt: outputs + all three gradients inside the
+    # documented multi-tile ULP band (docs/kernels.md)
+    ref_out = numpy.asarray(attention_reference(q, k, v),
+                            numpy.float64)
+    fl_out = numpy.asarray(flash_attention(q, k, v), numpy.float64)
+    fwd_rel = float(numpy.abs(ref_out - fl_out).max() /
+                    max(numpy.abs(ref_out).max(), 1e-12))
+    grad_rel = 0.0
+    for ga, gf in zip(legs["stock_autodiff"][1], legs["flash"][1]):
+        a64 = numpy.asarray(ga, numpy.float64)
+        f64 = numpy.asarray(gf, numpy.float64)
+        grad_rel = max(grad_rel, float(
+            numpy.abs(a64 - f64).max() /
+            max(numpy.abs(a64).max(), 1e-12)))
+    result = {
+        "shape": {"batch_heads": b, "seq": t, "head_dim": dh},
+        "fwd_max_rel_diff": float("%.3g" % fwd_rel),
+        "grad_max_rel_diff": float("%.3g" % grad_rel),
+        "parity_ok": fwd_rel < 1e-4 and grad_rel < 1e-4,
+        "stock_autodiff": rows["stock_autodiff"],
+        "flash": rows["flash"],
+    }
+
+    if not on_tpu:
+        result["note"] = ("CPU: Pallas interpreter — compile+parity "
+                          "evidence only; timing rides TPU rounds")
+        return result
+
+    def make_run(leg):
+        fn = legs[leg][0]
+
+        def run(count):
+            out = None
+            for _ in range(count):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+        return run
+
+    runners = {leg: make_run(leg) for leg in rows}
+    repeats = 8 if small else 24
+    samples = interleaved_slopes(runners, 1, repeats + 1, rounds=5)
+    meds = rank(samples)
+    band = 1.0
+    for leg in runners:
+        used = _filter_passes(samples[leg])
+        rows[leg].update(step_seconds=round(
+            float(numpy.median(used)), 9), spread=_spread(samples[leg]))
+        band = max(band, max(used) / max(float(numpy.median(used)),
+                                         1e-12))
+    if meds.get("stock_autodiff") and meds.get("flash"):
+        result["speedup"] = round(
+            meds["stock_autodiff"] / meds["flash"], 4)
+        result["weather_band"] = round(band, 4)
+        result["beats_weather"] = (result["speedup"]
+                                   > result["weather_band"])
+    else:
+        result["note"] = ("jitter-rejected leg: no honest ranking "
+                          "this round")
+    return result
+
+
 def bench_tune_ab(small):
     """Tuned-vs-static schedule A/B (docs/kernels.md "Autotuning").
 
@@ -1568,6 +1681,14 @@ def main():
     quant_res = section("quant_ab", lambda: bench_quant_ab(small))
     if quant_res is not None:
         extras["quant_ab"] = quant_res
+
+    # flash-vs-stock attention A/B (docs/kernels.md "The attention
+    # kernel"): interleaved pass-filtered gradient-program slopes on
+    # TPU; compile + parity receipt on CPU
+    attn_res = section("attention_ab",
+                       lambda: bench_attention_ab(small))
+    if attn_res is not None:
+        extras["attention_ab"] = attn_res
 
     # AlexNet rows, one program (= one ~60-200 s server compile) each.
     # Batch 256 bf16 = the throughput/MFU sweet spot and the only

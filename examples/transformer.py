@@ -1,0 +1,63 @@
+"""Transformer sequence classification on real handwritten digits
+(the post-recurrent sibling of examples/sequence.py): each 8x8 digit
+is fed as a sequence of 8 row-vectors, a stack of pre-LN transformer
+blocks (flash-attention Pallas kernel when VELES_PALLAS_BWD resolves
+on, docs/kernels.md) mixes the rows, and a softmax head classifies the
+flattened sequence.
+
+    python -m veles_tpu examples/transformer.py
+"""
+
+from veles_tpu.config import root
+from veles_tpu.datasets import DigitsLoader
+from veles_tpu.models.nn_workflow import StandardWorkflow
+from veles_tpu.prng import RandomGenerator
+
+root.transformer.update({
+    "blocks": 2,
+    "heads": 2,
+    "hidden": 32,
+    "learning_rate": 0.05,
+    "gradient_moment": 0.9,
+    "minibatch_size": 48,
+    "max_epochs": 60,
+    "fail_iterations": 15,
+})
+
+
+class DigitsRowsLoader(DigitsLoader):
+    """Serves digits reshaped (batch, 8, 8): a sequence of 8 rows
+    (the same presentation examples/sequence.py feeds its LSTM)."""
+
+    def load_data(self):
+        super(DigitsRowsLoader, self).load_data()
+        data = self.original_data.mem
+        self.original_data = data.reshape(len(data), 8, 8)
+
+
+def build(launcher):
+    cfg = root.transformer
+    layers = [
+        {"type": "transformer", "heads": cfg.heads,
+         "hidden": cfg.hidden, "learning_rate": cfg.learning_rate,
+         "gradient_moment": cfg.gradient_moment}
+        for _ in range(cfg.blocks)
+    ]
+    layers.append({"type": "softmax", "output_sample_shape": 10,
+                   "learning_rate": cfg.learning_rate,
+                   "gradient_moment": cfg.gradient_moment})
+    return StandardWorkflow(
+        launcher,
+        layers=layers,
+        loader_factory=lambda w: DigitsRowsLoader(
+            w, minibatch_size=cfg.minibatch_size,
+            prng=RandomGenerator("transformer", seed=21)),
+        decision_config=dict(max_epochs=cfg.max_epochs,
+                             fail_iterations=cfg.fail_iterations),
+        result_file=root.common.get("result_file"),
+    )
+
+
+def run(load, main):
+    load(build)
+    main()

@@ -97,11 +97,13 @@ def _flight_dumps_to_tmp(tmp_path, monkeypatch):
 @pytest.fixture(autouse=True)
 def _schedule_cache_to_tmp(tmp_path, monkeypatch):
     """The kernels consult the tuned schedule cache on every
-    ``blocks=None`` call (ops/matmul.py, conv_vjp.py, pool_bwd.py) —
+    ``blocks=None`` call (ops/matmul.py, conv_vjp.py, pool_bwd.py,
+    matmul_int8.py, and the attention family in ops/attention.py) —
     a developer's real cache under ~/.cache would silently change the
-    tiles (and thus the f32 accumulation grouping) every numeric
-    parity test runs with.  Tests always see a private empty cache;
-    the ones that WANT entries plant them here."""
+    tiles (and thus the f32 accumulation grouping — for attention,
+    the online-softmax rescale grouping) every numeric parity test
+    runs with.  Tests always see a private empty cache; the ones that
+    WANT entries plant them here."""
     monkeypatch.setenv("VELES_SCHEDULE_CACHE",
                        str(tmp_path / "schedule_cache"))
 

@@ -106,7 +106,8 @@ def adopt_state(sw, new_state, device=None):
         arr.detach_device()   # ...then collect, dropping references
 
 
-def _forward_for_loss(plans, params, x, key=None, remat=False):
+def _forward_for_loss(plans, params, x, key=None, remat=False,
+                      layer_fn=None, fold_offset=0):
     """Forward pass; returns (pre-softmax logits | final output).
 
     ``key``: dropout rng; None (inference / keyless step) makes dropout
@@ -118,6 +119,13 @@ def _forward_for_loss(plans, params, x, key=None, remat=False):
     backward-decongestion set (docs/kernels.md).  Recomputation replays
     identical ops, so gradients stay bit-identical; it trades MXU time
     for activation HBM pressure and is off by default.
+
+    ``layer_fn(i, plan, p, h, key)``: optional per-layer override hook
+    (the model-parallel builders swap a sharded apply in for specific
+    layers); returning None falls through to the stock walk.
+    ``fold_offset`` shifts the dropout key-fold index — a caller
+    walking a SLICE of a larger model (the pipeline step's tail) must
+    key dropout on the global layer index to match the fused step.
     """
     from veles_tpu.models.all2all import All2All, All2AllSoftmax
     from veles_tpu.models.dropout import DropoutForward
@@ -128,13 +136,18 @@ def _forward_for_loss(plans, params, x, key=None, remat=False):
 
     h = x
     for i, (plan, p) in enumerate(zip(plans, params)):
+        if layer_fn is not None:
+            override = layer_fn(i, plan, p, h, key)
+            if override is not None:
+                h = override
+                continue
         if plan.forward_cls is All2AllSoftmax:
             # keep logits for a numerically-stable CE
             h = layer(All2All.apply)(p, h)
         elif issubclass(plan.forward_cls, DropoutForward):
             if key is not None:
                 mask = DropoutForward.make_mask(
-                    jax.random.fold_in(key, i), h.shape,
+                    jax.random.fold_in(key, i + fold_offset), h.shape,
                     plan.static.get("dropout_ratio", 0.5), h.dtype)
                 h = h * mask
         else:
@@ -187,7 +200,7 @@ def build_forward(plans):
 
 def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
                    row_offset_fn=None, bwd_schedule=None,
-                   bwd_remat=False):
+                   bwd_remat=False, forward_fn=None, gsq_fn=None):
     """The raw (unjitted) train-step function shared by
     build_train_step (which jits one minibatch per dispatch) and
     build_train_epoch (which lax.scans it — one dispatch per epoch).
@@ -206,7 +219,15 @@ def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
     follow the VELES_PALLAS_BWD knob) threads the per-layer gradients
     through an optimization_barrier chain in backward production order
     — a pure scheduling hint, bit-identical results; ``bwd_remat``
-    checkpoints each layer's forward to cut activation pressure."""
+    checkpoints each layer's forward to cut activation pressure.
+
+    Model-parallel hooks (parallel/tensor.py, parallel/pipeline.py):
+    ``forward_fn(params, x, key, remat)`` replaces the stock layer walk
+    (a tensor-parallel forward slices local shards and psums; a
+    pipeline forward runs the stage wavefront) and ``gsq_fn(grads)``
+    replaces the flat squared-sum for the numerics guard (sharded
+    leaves need a model-axis psum so every shard sees the SAME global
+    norm and a poisoned step skips uniformly)."""
     import jax
     import jax.numpy as jnp
 
@@ -217,8 +238,11 @@ def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
     hypers = [p.hyper_full() for p in plans]
 
     def loss_fn(params, x, target, batch_size, key):
-        out = _forward_for_loss(plans, params, x, key,
-                                remat=bwd_remat)
+        if forward_fn is not None:
+            out = forward_fn(params, x, key, bwd_remat)
+        else:
+            out = _forward_for_loss(plans, params, x, key,
+                                    remat=bwd_remat)
         if loss == "softmax":
             labels = target
             valid = labels >= 0
@@ -280,8 +304,11 @@ def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
         # gradients makes the squared-sum non-finite, so isfinite of
         # the norm covers every leaf; both flags stay LAZY device
         # scalars riding the existing metrics result — no host sync
-        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                  for g in jax.tree_util.tree_leaves(grads))
+        if gsq_fn is not None:
+            gsq = gsq_fn(grads)
+        else:
+            gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in jax.tree_util.tree_leaves(grads))
         grad_norm = jnp.sqrt(gsq)
         step_finite = jnp.isfinite(loss_value) & jnp.isfinite(grad_norm)
 
@@ -452,6 +479,31 @@ def _fixed_arity_lower(jitted):
     return lower
 
 
+def _finalize_step(fn, donate, compiler_options, **attrs):
+    """The ONE jit + fixed-arity-wrapper + ``.lower`` scaffold shared
+    by every shard_map step builder (the SPMD path here,
+    parallel/tensor.py, parallel/pipeline.py) — extra ``attrs`` land
+    on the returned step (mesh, axes, bucket sizes) for callers that
+    introspect it."""
+    import jax
+
+    jit_kwargs = {}
+    if compiler_options:
+        jit_kwargs["compiler_options"] = compiler_options
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    jitted = jax.jit(fn, **jit_kwargs)
+
+    def step(state, x, target, batch_size, step_key=None,
+             grad_poison=None, loss_poison=None):
+        return jitted(state, x, target, batch_size, step_key,
+                      grad_poison, loss_poison)
+    step.lower = _fixed_arity_lower(jitted)
+    for key, value in attrs.items():
+        setattr(step, key, value)
+    return step
+
+
 def _build_spmd_train_step(plans, loss, mesh, data_axis, grad_bucket_mb,
                            grad_compress, grad_allreduce_impl, donate,
                            compiler_options, bwd_schedule=None,
@@ -511,23 +563,9 @@ def _build_spmd_train_step(plans, loss, mesh, data_axis, grad_bucket_mb,
         local_step, mesh=mesh,
         in_specs=(P(), P(data_axis), P(data_axis), P(), P(), P(), P()),
         out_specs=(P(), P()), check_vma=False)
-
-    jit_kwargs = {}
-    if compiler_options:
-        jit_kwargs["compiler_options"] = compiler_options
-    if donate:
-        jit_kwargs["donate_argnums"] = (0,)
-    jitted = jax.jit(spmd, **jit_kwargs)
-
-    def spmd_step(state, x, target, batch_size, step_key=None,
-                  grad_poison=None, loss_poison=None):
-        return jitted(state, x, target, batch_size, step_key,
-                      grad_poison, loss_poison)
-    spmd_step.lower = _fixed_arity_lower(jitted)
-    spmd_step.mesh = mesh
-    spmd_step.data_axis = data_axis
-    spmd_step.bucket_bytes = bucket_bytes
-    return spmd_step
+    return _finalize_step(spmd, donate, compiler_options, mesh=mesh,
+                          data_axis=data_axis,
+                          bucket_bytes=bucket_bytes)
 
 
 def _labels_sharding(mesh, data_axis, loss):

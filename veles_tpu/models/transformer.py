@@ -1,0 +1,432 @@
+"""Transformer units: LayerNorm, MultiHeadAttention, TransformerBlock.
+
+No reference behavior to match (the 2015 platform predates attention);
+this is the model zoo's first post-recurrent sequence family, built on
+the unit contracts the rest of the zoo uses:
+
+- forward math lives in pure ``apply(params, x, **static)`` class
+  methods, so the same code serves the per-unit jit path, the fused
+  whole-step compiler (``StandardWorkflow.fuse``), and the numpy
+  fallback;
+- parameters pack into the ONE (weights, bias) Array pair per unit
+  (the LSTM precedent: gates pack on an axis) — ``MultiHeadAttention``
+  stores ``(D, 4D)`` = [Wq | Wk | Wv | Wo], ``TransformerBlock`` packs
+  its six matrices/gains into one flat f32 vector with static offsets
+  (solver updates are elementwise, so packing never changes them);
+- backwards are stock ``jax.vjp`` through the forward (the rnn.py
+  pattern) guarded by ``finite_guard``, so a poisoned cotangent
+  cascades and the whole chain skips the step together.  When
+  ``VELES_PALLAS_BWD`` resolves on, the attention inside ``apply`` is
+  :func:`veles_tpu.ops.attention.flash_attention` — a custom_vjp whose
+  backward is the hand-scheduled Pallas pair — so the SAME vjp drives
+  the flash backward; knob off runs
+  :func:`~veles_tpu.ops.attention.attention_reference` with stock
+  autodiff (the documented bit-exact fallback).
+
+Blocks are pre-LN (``x + attn(ln(x))``; ``h + ffn(ln(h))``) with a
+position-wise strict-ReLU MLP; activations keep (B, T, D), so blocks
+compose into homogeneous stacks — exactly the shape contract the
+pipeline-parallel stage split needs (parallel/pipeline.py).
+"""
+
+import numpy
+
+from veles_tpu.models.nn_units import ForwardBase, GradientDescentBase
+
+__all__ = ["LayerNorm", "MultiHeadAttention", "TransformerBlock",
+           "GDLayerNorm", "GDMultiHeadAttention", "GDTransformerBlock",
+           "layer_norm", "multi_head_attention", "attention_heads",
+           "position_wise_mlp", "block_param_sizes",
+           "split_block_params"]
+
+
+# -- pure math (shared by the unit classes and the parallel layer) ----------
+
+
+def layer_norm(x, gamma, beta, eps=1e-5):
+    """Per-token normalization over the feature axis, f32 statistics."""
+    import jax.numpy as jnp
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * (1.0 / jnp.sqrt(var + eps))
+    return (y * gamma + beta).astype(x.dtype)
+
+
+def _attend(q, k, v, pallas_bwd):
+    """Route one (B*H, T, dh) attention through the flash kernel or
+    the stock reference per the VELES_PALLAS_BWD contract."""
+    from veles_tpu.ops.attention import (attention_reference,
+                                         flash_attention)
+    if pallas_bwd is None:
+        from veles_tpu.ops.common import pallas_bwd_enabled
+        pallas_bwd = pallas_bwd_enabled()
+    fn = flash_attention if pallas_bwd else attention_reference
+    return fn(q, k, v)
+
+
+def attention_heads(x, w_qkv, b_qkv, heads, pallas_bwd=None):
+    """QKV projection + per-head attention + head merge over (B, T, *):
+    the sub-layer shared VERBATIM by the single-device block and the
+    tensor-parallel shard (parallel/tensor.py slices ``w_qkv`` to its
+    heads' columns and passes its local head count — the head dim
+    ``dh`` comes from the PROJECTION width, so local and global calls
+    run identical per-head math).  Returns the merged (B, T, width/3)
+    activations in x's dtype, BEFORE the output projection."""
+    import jax.numpy as jnp
+    b, t = x.shape[0], x.shape[1]
+    dh = w_qkv.shape[1] // 3 // heads
+    z = jnp.einsum("btf,fg->btg", x, w_qkv,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    if b_qkv is not None:
+        z = z + b_qkv.astype(x.dtype)
+    q, k, v = jnp.split(z, 3, axis=-1)
+
+    def fold(a):  # (B, T, H*dh) -> (B*H, T, dh)
+        a = a.reshape(b, t, heads, dh)
+        return a.transpose(0, 2, 1, 3).reshape(b * heads, t, dh)
+
+    o = _attend(fold(q), fold(k), fold(v), pallas_bwd)
+    return o.reshape(b, heads, t, dh).transpose(0, 2, 1, 3).reshape(
+        b, t, heads * dh)
+
+
+def position_wise_mlp(x, w1, b1, w2):
+    """ReLU(x W1 + b1) W2 in f32 — the block's MLP core shared with
+    the tensor-parallel shard (which passes column/row slices), kept
+    BEFORE the final bias so the TP path can psum the partial first.
+    Returns the f32 pre-b2 activations."""
+    import jax.numpy as jnp
+    z = jnp.einsum("btf,fg->btg", x, w1,
+                   preferred_element_type=jnp.float32) + b1
+    z = jnp.maximum(z, 0)
+    return jnp.einsum("btf,fg->btg", z.astype(x.dtype), w2,
+                      preferred_element_type=jnp.float32)
+
+
+def multi_head_attention(x, w_qkv, b_qkv, w_o, b_o, heads,
+                         pallas_bwd=None):
+    """Multi-head scaled-dot-product attention over (B, T, D):
+    one packed QKV projection, heads folded into the leading dim for
+    the kernel, merged output projection."""
+    import jax.numpy as jnp
+    o = attention_heads(x, w_qkv, b_qkv, heads, pallas_bwd)
+    out = jnp.einsum("btf,fg->btg", o, w_o,
+                     preferred_element_type=jnp.float32)
+    if b_o is not None:
+        out = out + b_o
+    return out.astype(x.dtype)
+
+
+def block_param_sizes(d, hidden):
+    """(name, shape) layout of one TransformerBlock's packed weights
+    and bias vectors — the ONE definition the unit packer, the fused
+    apply, and the tensor-parallel splitter all read."""
+    weights = [("ln1_gamma", (d,)), ("w_qkv", (d, 3 * d)),
+               ("w_o", (d, d)), ("ln2_gamma", (d,)),
+               ("w1", (d, hidden)), ("w2", (hidden, d))]
+    bias = [("ln1_beta", (d,)), ("b_qkv", (3 * d,)), ("b_o", (d,)),
+            ("ln2_beta", (d,)), ("b1", (hidden,)), ("b2", (d,))]
+    return weights, bias
+
+
+def _unpack(vec, layout):
+    pieces, offset = {}, 0
+    for name, shape in layout:
+        size = int(numpy.prod(shape))
+        pieces[name] = vec[offset:offset + size].reshape(shape)
+        offset += size
+    return pieces
+
+
+def split_block_params(weights, bias, d, hidden):
+    """Packed flat (weights, bias) -> name->array dicts."""
+    w_layout, b_layout = block_param_sizes(d, hidden)
+    return _unpack(weights, w_layout), _unpack(bias, b_layout)
+
+
+def transformer_block(x, w, b, *, heads, hidden, eps=1e-5,
+                      pallas_bwd=None):
+    """One pre-LN block over packed flat params:
+    ``h = x + MHA(LN1(x)); y = h + ReLU(LN2(h) W1 + b1) W2 + b2``."""
+    d = x.shape[-1]
+    wp, bp = split_block_params(w, b, d, hidden)
+    h = x + multi_head_attention(
+        layer_norm(x, wp["ln1_gamma"], bp["ln1_beta"], eps),
+        wp["w_qkv"], bp["b_qkv"], wp["w_o"], bp["b_o"], heads,
+        pallas_bwd)
+    z = position_wise_mlp(
+        layer_norm(h, wp["ln2_gamma"], bp["ln2_beta"], eps),
+        wp["w1"], bp["b1"], wp["w2"]) + bp["b2"]
+    return (h + z.astype(x.dtype)).astype(x.dtype)
+
+
+def _uniform(rng, shape, fan_in):
+    bound = 1.0 / numpy.sqrt(fan_in) if fan_in else 0.01
+    return rng.uniform(-bound, bound, shape).astype(numpy.float32)
+
+
+def init_block_params(d, hidden, rng):
+    """Packed (weights, bias) init: LN gains 1, matrices 1/sqrt(fan_in)
+    uniform, every bias/beta 0."""
+    w_layout, b_layout = block_param_sizes(d, hidden)
+    pieces = []
+    for name, shape in w_layout:
+        if name.endswith("gamma"):
+            pieces.append(numpy.ones(shape, numpy.float32))
+        else:
+            pieces.append(_uniform(rng, shape, shape[0]).ravel())
+    weights = numpy.concatenate([p.ravel() for p in pieces])
+    bias = numpy.zeros(sum(int(numpy.prod(s)) for _, s in b_layout),
+                       numpy.float32)
+    return weights, bias
+
+
+# -- forward units -----------------------------------------------------------
+
+
+class _SequenceUnit(ForwardBase):
+    """Shared (B, T, D)-preserving plumbing: output shape mirrors the
+    input, the feature dim comes from the linked input at initialize."""
+
+    def _seq_shape(self):
+        if not self.input or self.input.sample_size == 0:
+            raise AttributeError(
+                "%s: input shape unknown at initialize" % self.name)
+        shape = self.input.shape
+        if len(shape) != 3:
+            raise ValueError(
+                "%s expects (batch, time, features) input, got %s"
+                % (type(self).__name__, (shape,)))
+        return shape
+
+    def _ensure_output(self, shape):
+        if not self.output:
+            self.output.mem = numpy.zeros(shape, numpy.float32)
+
+
+class LayerNorm(_SequenceUnit):
+    """y = gamma * (x - mean) / sqrt(var + eps) + beta over the
+    feature axis; weights = gamma, bias = beta."""
+
+    MAPPING = "layer_norm"
+
+    def __init__(self, workflow, **kwargs):
+        super(LayerNorm, self).__init__(workflow, **kwargs)
+        self.eps = kwargs.get("eps", 1e-5)
+
+    def static_config(self):
+        return {"eps": self.eps}
+
+    def create_params(self):
+        shape = self._seq_shape()
+        self._ensure_output(shape)
+        if self.weights:
+            return  # restored from snapshot
+        d = shape[-1]
+        self.weights.mem = numpy.ones((d,), numpy.float32)
+        if self.include_bias:
+            self.bias.mem = numpy.zeros((d,), numpy.float32)
+
+    @classmethod
+    def apply(cls, params, x, *, eps=1e-5):
+        import jax.numpy as jnp
+        bias = params.get("bias")
+        beta = jnp.zeros((), x.dtype) if bias is None else bias
+        return layer_norm(x, params["weights"], beta, eps)
+
+
+class MultiHeadAttention(_SequenceUnit):
+    """Multi-head scaled-dot-product attention, (B, T, D) -> same.
+    weights pack (D, 4D) = [Wq | Wk | Wv | Wo]; bias packs (4D,)."""
+
+    MAPPING = "attention"
+
+    def __init__(self, workflow, **kwargs):
+        super(MultiHeadAttention, self).__init__(workflow, **kwargs)
+        self.heads = kwargs.get("heads", 1)
+
+    def static_config(self):
+        return {"heads": self.heads}
+
+    def create_params(self):
+        shape = self._seq_shape()
+        d = shape[-1]
+        if d % self.heads:
+            raise ValueError("features %d %% heads %d != 0"
+                             % (d, self.heads))
+        self._ensure_output(shape)
+        if self.weights:
+            return
+        weights = numpy.zeros((d, 4 * d), numpy.float32)
+        self.fill_array(weights, self.weights_filling,
+                        self.weights_stddev, d)
+        self.weights.mem = weights
+        if self.include_bias:
+            self.bias.mem = numpy.zeros((4 * d,), numpy.float32)
+
+    @classmethod
+    def apply(cls, params, x, *, heads, pallas_bwd=None):
+        d = x.shape[-1]
+        w = params["weights"]
+        b = params.get("bias")
+        return multi_head_attention(
+            x, w[:, :3 * d], None if b is None else b[:3 * d],
+            w[:, 3 * d:], None if b is None else b[3 * d:], heads,
+            pallas_bwd)
+
+
+class TransformerBlock(_SequenceUnit):
+    """One pre-LN transformer block (attention + position-wise MLP
+    with residuals), packed into one flat (weights, bias) pair — see
+    :func:`block_param_sizes` for the layout."""
+
+    MAPPING = "transformer"
+
+    def __init__(self, workflow, **kwargs):
+        super(TransformerBlock, self).__init__(workflow, **kwargs)
+        self.heads = kwargs.get("heads", 1)
+        self.hidden = kwargs.get("hidden")
+        self.eps = kwargs.get("eps", 1e-5)
+
+    def static_config(self):
+        return {"heads": self.heads, "hidden": self.hidden,
+                "eps": self.eps}
+
+    def create_params(self):
+        shape = self._seq_shape()
+        d = shape[-1]
+        if self.hidden is None:
+            self.hidden = 4 * d
+        if d % self.heads:
+            raise ValueError("features %d %% heads %d != 0"
+                             % (d, self.heads))
+        self._ensure_output(shape)
+        if self.weights:
+            return
+        w_layout, b_layout = block_param_sizes(d, self.hidden)
+        pieces = []
+        for name, piece_shape in w_layout:
+            if name.endswith("gamma"):
+                pieces.append(numpy.ones(piece_shape, numpy.float32))
+            else:
+                arr = numpy.zeros(piece_shape, numpy.float32)
+                self.fill_array(arr, self.weights_filling,
+                                self.weights_stddev, piece_shape[0])
+                pieces.append(arr)
+        self.weights.mem = numpy.concatenate(
+            [p.ravel() for p in pieces])
+        if self.include_bias:
+            self.bias.mem = numpy.zeros(
+                sum(int(numpy.prod(s)) for _, s in b_layout),
+                numpy.float32)
+
+    @classmethod
+    def apply(cls, params, x, *, heads, hidden, eps=1e-5,
+              pallas_bwd=None):
+        return transformer_block(x, params["weights"], params["bias"],
+                                 heads=heads, hidden=hidden, eps=eps,
+                                 pallas_bwd=pallas_bwd)
+
+
+# -- gradient-descent units --------------------------------------------------
+
+
+class _GDAutodiff(GradientDescentBase):
+    """Stock-vjp backward over FORWARD_CLS.apply (the rnn.py pattern):
+    one jitted call produces err_input + the guarded solver update.
+    The vjp drives whatever backward the forward's static config
+    routes to — with VELES_PALLAS_BWD on, attention's custom_vjp runs
+    the hand-scheduled Pallas pair."""
+
+    MAPPING = None  # abstract
+    FORWARD_CLS = None
+
+    @classmethod
+    def backward(cls, state, hyper, x, y, err_output, *, solver,
+                 include_bias, need_err_input, **static):
+        import jax
+        import jax.numpy as jnp
+        W = state["weights"]
+        b = state["bias"] if include_bias else None
+
+        def fwd(W_, b_, x_):
+            return cls.FORWARD_CLS.apply(
+                {"weights": W_, "bias": b_}, x_, **static)
+
+        _, vjp = jax.vjp(fwd, W, b, x)
+        grad_w, grad_b, err_input = vjp(err_output.astype(y.dtype))
+        if not need_err_input:
+            err_input = None
+        grad_w = GradientDescentBase.regularized(
+            grad_w.astype(jnp.float32), W, hyper["weights_decay"],
+            hyper["l1_vs_l2"])
+        new_w, acc_w, acc2_w = GradientDescentBase.solver_update(
+            solver, W, grad_w.astype(W.dtype), state["accum_weights"],
+            state["accum2_weights"], hyper["learning_rate"],
+            hyper["gradient_moment"], hyper["adadelta_rho"],
+            hyper["solver_epsilon"])
+        new_state = {"weights": new_w, "accum_weights": acc_w,
+                     "accum2_weights": acc2_w}
+        if include_bias and grad_b is not None:
+            new_b, acc_b, acc2_b = GradientDescentBase.solver_update(
+                solver, b, grad_b.astype(b.dtype), state["accum_bias"],
+                state["accum2_bias"], hyper["learning_rate_bias"],
+                hyper["gradient_moment_bias"], hyper["adadelta_rho"],
+                hyper["solver_epsilon"])
+            new_state.update({"bias": new_b, "accum_bias": acc_b,
+                              "accum2_bias": acc2_b})
+        # numerics guard: a non-finite gradient skips the update and
+        # cascades through err_input so the whole chain skips together
+        # (docs/health.md)
+        new_state = GradientDescentBase.finite_guard(
+            state, new_state, grad_w,
+            grad_b if include_bias else None)
+        return err_input, new_state
+
+
+class GDLayerNorm(_GDAutodiff):
+    MAPPING = "layer_norm"
+    FORWARD_CLS = LayerNorm
+
+    def __init__(self, workflow, **kwargs):
+        super(GDLayerNorm, self).__init__(workflow, **kwargs)
+        self.eps = kwargs.get("eps", 1e-5)
+
+    def backward_static(self):
+        return {"eps": self.eps}
+
+
+class GDMultiHeadAttention(_GDAutodiff):
+    MAPPING = "attention"
+    FORWARD_CLS = MultiHeadAttention
+
+    def __init__(self, workflow, **kwargs):
+        super(GDMultiHeadAttention, self).__init__(workflow, **kwargs)
+        self.heads = kwargs.get("heads", 1)
+
+    def backward_static(self):
+        return {"heads": self.heads}
+
+
+class GDTransformerBlock(_GDAutodiff):
+    MAPPING = "transformer"
+    FORWARD_CLS = TransformerBlock
+
+    def __init__(self, workflow, **kwargs):
+        super(GDTransformerBlock, self).__init__(workflow, **kwargs)
+        self.heads = kwargs.get("heads", 1)
+        self.hidden = kwargs.get("hidden")
+        self.eps = kwargs.get("eps", 1e-5)
+
+    def backward_static(self):
+        hidden = self.hidden
+        if hidden is None:
+            # the forward resolved hidden = 4*D at create_params; the
+            # packed length determines it uniquely: L = 2D + 4D^2 +
+            # 2*D*hidden, with weights linked BY OBJECT from the fwd
+            d = self.input.shape[-1]
+            packed = int(numpy.prod(self.weights.shape))
+            self.hidden = (packed - 2 * d - 4 * d * d) // (2 * d)
+        return {"heads": self.heads, "hidden": self.hidden,
+                "eps": self.eps}

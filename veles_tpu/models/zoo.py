@@ -9,7 +9,8 @@ that the compiler lowers onto the MXU.
 """
 
 __all__ = ["alexnet_layers", "vgg_layers", "mnist_mlp_layers",
-           "autoencoder_layers", "build_plans_and_state"]
+           "autoencoder_layers", "transformer_layers",
+           "build_plans_and_state"]
 
 
 def build_plans_and_state(specs, input_shape, seed=0):
@@ -84,6 +85,47 @@ def build_plans_and_state(specs, input_shape, seed=0):
                 static={"dropout_ratio": spec.get("dropout_ratio",
                                                   0.5)}))
             state.append(none_entry())
+        elif ltype == "transformer":
+            from veles_tpu.models.transformer import init_block_params
+            d = shape[-1]
+            heads = spec.get("heads", 1)
+            if d % heads:
+                # the unit path's clear error, not a deep-jit reshape
+                # failure at first trace
+                raise ValueError("features %d %% heads %d != 0"
+                                 % (d, heads))
+            hidden = spec.get("hidden") or 4 * d
+            plans.append(LayerPlan(
+                cls, hyper=hyper,
+                static={"heads": heads, "hidden": hidden,
+                        "eps": spec.get("eps", 1e-5)}))
+            weights, bias = init_block_params(d, hidden, rng)
+            state.append({
+                "weights": weights, "bias": bias,
+                "accum_weights": numpy.zeros_like(weights),
+                "accum_bias": numpy.zeros_like(bias),
+                "accum2_weights": None, "accum2_bias": None})
+        elif ltype == "attention":
+            d = shape[-1]
+            heads = spec.get("heads", 1)
+            if d % heads:
+                raise ValueError("features %d %% heads %d != 0"
+                                 % (d, heads))
+            plans.append(LayerPlan(
+                cls, hyper=hyper, static={"heads": heads}))
+            state.append(entry((d, 4 * d), (4 * d,)))
+        elif ltype == "layer_norm":
+            d = shape[-1]
+            plans.append(LayerPlan(
+                cls, hyper=hyper,
+                static={"eps": spec.get("eps", 1e-5)}))
+            gamma = numpy.ones((d,), numpy.float32)
+            state.append({
+                "weights": gamma,
+                "bias": numpy.zeros((d,), numpy.float32),
+                "accum_weights": numpy.zeros_like(gamma),
+                "accum_bias": numpy.zeros((d,), numpy.float32),
+                "accum2_weights": None, "accum2_bias": None})
         else:  # all2all family
             fan_in = int(numpy.prod(shape))
             out = spec["output_sample_shape"]
@@ -93,6 +135,21 @@ def build_plans_and_state(specs, input_shape, seed=0):
             state.append(entry((fan_in, out), (out,)))
             shape = (out,)
     return plans, state, shape
+
+
+def transformer_layers(blocks=2, heads=2, hidden=None, classes=10,
+                       lr=0.05, moment=0.9):
+    """Sequence-classification transformer: a homogeneous pre-LN block
+    stack over (B, T, D) input with a softmax head flattening the
+    final sequence — the workload the flash-attention kernel, the
+    tensor-parallel head sharding, and the pipeline stage split all
+    drive (docs/distributed.md "Model parallelism")."""
+    spec = [{"type": "transformer", "heads": heads, "hidden": hidden,
+             "learning_rate": lr, "gradient_moment": moment}
+            for _ in range(blocks)]
+    spec.append({"type": "softmax", "output_sample_shape": classes,
+                 "learning_rate": lr, "gradient_moment": moment})
+    return spec
 
 
 def mnist_mlp_layers(hidden=100, classes=10, lr=0.1, moment=0.9):

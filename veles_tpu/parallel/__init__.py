@@ -14,7 +14,10 @@ requeue) stay in veles_tpu.server/client as a host-side concern.
                 production order (the overlap-credited SPMD data plane)
 - ring.py     — ring + Ulysses sequence-parallel attention, plus the
                 explicit ppermute ring all-reduce
-- pipeline.py — GPipe wavefront pipeline parallelism
+- pipeline.py — GPipe wavefront pipeline parallelism + the
+                stage-split transformer train step
+- tensor.py   — Megatron-style tensor-parallel transformer train step
+                (head-sharded attention, column/row-split MLP)
 - moe.py      — sharded mixture-of-experts
 """
 

@@ -29,7 +29,7 @@ import time
 
 __all__ = ["main"]
 
-_MODELS = ("mlp", "convnet", "alexnet", "vgg16")
+_MODELS = ("mlp", "convnet", "alexnet", "vgg16", "transformer")
 
 
 def _model(name, hidden):
@@ -54,6 +54,11 @@ def _model(name, hidden):
         return zoo.alexnet_layers(), (227, 227, 3)
     if name == "vgg16":
         return zoo.vgg_layers(), (224, 224, 3)
+    if name == "transformer":
+        # the sequence workload: its fused step records attention
+        # consults (and the head/MLP matmuls) at trace time
+        return zoo.transformer_layers(blocks=2, heads=8,
+                                      hidden=2048), (128, 512)
     raise SystemExit("unknown --model %r (have %s)" %
                      (name, ", ".join(_MODELS)))
 
@@ -86,8 +91,11 @@ def _parser():
     parser.add_argument("--worker", metavar="HOST:PORT",
                         help="run as a remote farm worker for a "
                         "tuning master at HOST:PORT (blocks)")
+    # choices derive from the family registry so a new kernel family
+    # (matmul_int8, attention, ...) is reachable the day it lands
+    from veles_tpu.tune.spec import FAMILIES
     parser.add_argument("--ops", action="append",
-                        choices=("matmul", "conv_vjp", "pool_bwd"),
+                        choices=tuple(sorted(FAMILIES)),
                         help="restrict to these kernel families")
     parser.add_argument("--max-specs", type=int, default=0,
                         help="tune at most N specs (0 = all)")

@@ -4,7 +4,8 @@ One family per parameterized Pallas kernel (docs/kernels.md):
 
 - ``matmul`` — ``ops/matmul.py``'s (bm, bn, bk) tiles;
 - ``conv_vjp`` — ``ops/conv_vjp.py``'s (bi, bj, bk) wgrad tiles;
-- ``pool_bwd`` — ``ops/pool_bwd.py``'s output-width block (W tiling).
+- ``pool_bwd`` — ``ops/pool_bwd.py``'s output-width block (W tiling);
+- ``attention`` — ``ops/attention.py``'s (bq, bk) flash tiles.
 
 Each family owns four things the GA needs: the **search space** as
 :class:`veles_tpu.genetics.config.Tune` markers (so the stock
@@ -34,8 +35,9 @@ import logging
 from veles_tpu.genetics.config import Tune
 
 __all__ = ["FAMILIES", "family_for", "matmul_spec", "matmul_int8_spec",
-           "conv_vjp_spec", "pool_bwd_spec", "valid_schedule",
-           "matmul_seed_candidates", "TUNE_VMEM_BUDGET_BYTES"]
+           "conv_vjp_spec", "pool_bwd_spec", "attention_spec",
+           "valid_schedule", "matmul_seed_candidates",
+           "TUNE_VMEM_BUDGET_BYTES"]
 
 logger = logging.getLogger("veles_tpu.tune")
 
@@ -374,6 +376,100 @@ class ConvVjpFamily(object):
         return warm, run
 
 
+class AttentionFamily(object):
+    """(bq, bk) q/k tiles of the flash-attention kernels
+    (``ops/attention.py``).  bq rides sublanes of the score tile
+    (quantum 8); bk rides its lanes (quantum 128).  The head dim is
+    lane-padded to 128 and is a key coordinate, not a gene — the
+    kernel holds a whole (padded) head row per tile."""
+
+    name = "attention"
+
+    def space(self, spec):
+        _b, tq, tk, _dhp = spec["shape"]
+        return {
+            "bq": Tune(min(256, tq), 8, min(1024, tq)),
+            "bk": Tune(min(256, tk), 128, min(2048, tk)),
+        }
+
+    def quantize(self, spec, genes):
+        _b, tq, tk, _dhp = spec["shape"]
+        return {"blocks": [
+            _quant(genes["bq"], 8, 8, min(1024, tq)),
+            _quant(genes["bk"], 128, 128, min(2048, tk)),
+        ]}
+
+    def feasible(self, spec, schedule):
+        bq, bk = schedule["blocks"]
+        dhp = spec["shape"][3]
+        isz = _itemsize(spec["dtype"])
+        footprint = (bq * dhp * isz          # q block
+                     + 2 * bk * dhp * isz    # k + v blocks
+                     + bq * dhp * isz        # out block
+                     + bq * dhp * 4          # f32 acc scratch
+                     + 2 * bq * 128 * 4      # m + l scratch
+                     + bq * 128 * 4          # lse block
+                     + 2 * bq * bk * 4)      # score + prob tiles
+        return footprint <= TUNE_VMEM_BUDGET_BYTES
+
+    def seeds(self, spec):
+        return [{"blocks": list(c)} for c in
+                [(256, 256), (128, 256), (256, 512), (512, 256),
+                 (128, 128), (512, 512)]]
+
+    def default(self, spec):
+        from veles_tpu.ops import attention as _a
+        return {"blocks": list(_a._DEFAULT_BLOCKS)}
+
+    def genes_of(self, schedule):
+        bq, bk = schedule["blocks"]
+        return {"bq": bq, "bk": bk}
+
+    def validate(self, schedule):
+        blocks = schedule.get("blocks")
+        if (isinstance(blocks, (list, tuple)) and len(blocks) == 2
+                and all(isinstance(b, int) and b > 0 for b in blocks)
+                and blocks[0] % 8 == 0 and blocks[1] % 128 == 0):
+            return {"blocks": [int(b) for b in blocks]}
+        return None
+
+    def build_runner(self, spec, schedule):
+        """Queued-dispatch runner over the full custom_vjp step
+        (forward + both backward kernels via jax.grad — the composition
+        a train step actually pays for)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy
+
+        from veles_tpu.ops.attention import flash_attention
+
+        b, t, dh = spec["raw"]["btd"]
+        rng = numpy.random.RandomState(23)
+        dtype = jnp.bfloat16 if spec["dtype"] == "bfloat16" \
+            else jnp.dtype(spec["dtype"])
+        q = jnp.asarray(rng.randn(b, t, dh) * 0.1, dtype)
+        k = jnp.asarray(rng.randn(b, t, dh) * 0.1, dtype)
+        v = jnp.asarray(rng.randn(b, t, dh) * 0.1, dtype)
+        blocks = tuple(schedule["blocks"])
+        level = spec["precision_level"]
+
+        grad = jax.grad(lambda q_, k_, v_: jnp.sum(
+            flash_attention(q_, k_, v_, precision_level=level,
+                            blocks=blocks).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))
+
+        def run(count):
+            out = None
+            for _ in range(count):
+                out = grad(q, k, v)
+            jax.block_until_ready(out)
+
+        def warm():
+            run(1)
+
+        return warm, run
+
+
 class PoolBwdFamily(object):
     """Output-width block (W tiling) of the pool select-and-scatter
     backward.  Only non-overlapping windows (kx == sx, ky == sy) admit
@@ -460,6 +556,7 @@ FAMILIES = {
     "matmul_int8": MatmulInt8Family(),
     "conv_vjp": ConvVjpFamily(),
     "pool_bwd": PoolBwdFamily(),
+    "attention": AttentionFamily(),
 }
 
 
@@ -546,6 +643,23 @@ def conv_vjp_spec(x_shape, ky, kx, cout, y_hw, dtype, precision_level,
                 "padding": [int(p_) for p_ in padding],
                 "sliding": [int(s) for s in sliding],
                 "activation": str(activation)},
+    }
+
+
+def attention_spec(b, t, dh, dtype, precision_level):
+    """The flash-attention consult/tune spec: shape is (batch-heads,
+    T padded to the q sublane quantum, T padded to the k lane quantum,
+    lane-padded head dim) — the kernel grid's coordinates; the raw
+    (B, T, dh) rides ``raw`` for the runner."""
+    from veles_tpu.ops.attention import ATTENTION_KERNEL_VERSION
+    return {
+        "op": "attention",
+        "shape": [int(b), _ceil_mult(int(t), 8),
+                  _ceil_mult(int(t), 128), _ceil_mult(int(dh), 128)],
+        "dtype": str(dtype),
+        "precision_level": int(precision_level),
+        "extra": {"kernel_version": ATTENTION_KERNEL_VERSION},
+        "raw": {"btd": [int(b), int(t), int(dh)]},
     }
 
 
