@@ -96,6 +96,10 @@ SECTION_EST = {
     # programs per shape; CPU = compile + parity, TPU adds the
     # interleaved pass-filtered slope rounds
     "attention_ab": 60.0,
+    # multi-host hedging A/B (docs/serving.md "Multi-host tier"):
+    # two small in-process serve hosts + ~2 s of closed-loop
+    # measurement per leg, interleaved off/on passes
+    "hedge_ab": 40.0,
 }
 
 # a section whose dominant cost (the one-time server compile) loosely
@@ -177,6 +181,9 @@ def _compact_record(value, small, extras):
     attn = extras.get("attention_ab") or {}
     if "speedup" in attn:
         rec["attention_ab_speedup"] = attn["speedup"]
+    hedge = extras.get("hedge_ab") or {}
+    if hedge.get("hedge_p99_cut_pct") is not None:
+        rec["hedge_p99_cut"] = hedge["hedge_p99_cut_pct"]
     if "wall_s" in extras:
         rec["wall_s"] = extras["wall_s"]
     if extras.get("shed"):
@@ -1508,6 +1515,135 @@ def bench_serve_ab(small):
     }
 
 
+def bench_hedge_ab(small):
+    """Multi-host hedging A/B (docs/serving.md "Multi-host tier"):
+    closed-loop p50/p95/p99 through a :class:`FleetRouter` over two
+    in-process serve hosts with a seeded ``serve.host.stall``
+    straggler, hedging OFF vs ON — the TPU paper's p99-bound serving
+    argument, measured.  Passes are INTERLEAVED (off, on, off, on, …)
+    and the published p99 cut is the positive-majority median of the
+    per-pass deltas — the shared tune/measure.py discipline, so a
+    host-load window cannot crown either leg.  The multi-process
+    SIGKILL variant (real subprocess hosts) is
+    scripts/fleet_soak.py -> HEDGE.json."""
+    import socket as _socket
+    import threading as _threading
+
+    from veles_tpu import chaos
+    from veles_tpu.backends import Device
+    from veles_tpu.compiler import LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.observe.metrics import percentiles as _percentiles
+    from veles_tpu.serve import (
+        AOTEngine, BinaryTransportServer, ContinuousBatcher,
+        FleetRouter)
+    from veles_tpu.tune.measure import positive_majority_median
+
+    fan_in, hidden, classes = 16, 24, 4
+    rng = numpy.random.RandomState(0)
+    plans = [LayerPlan(All2AllTanh), LayerPlan(All2AllSoftmax)]
+    params = [
+        {"weights": rng.rand(fan_in, hidden).astype(numpy.float32),
+         "bias": rng.rand(hidden).astype(numpy.float32)},
+        {"weights": rng.rand(hidden, classes).astype(numpy.float32),
+         "bias": rng.rand(classes).astype(numpy.float32)},
+    ]
+    hosts = []
+    for i in range(2):
+        engine = AOTEngine(plans, params, (fan_in,), ladder=(8, 32),
+                           device=Device())
+        engine.compile()
+        batcher = ContinuousBatcher(engine, max_delay_s=0.001,
+                                    max_queue=4096).start()
+        server = BinaryTransportServer(
+            batcher, port=None, host_meta={"host_id": "bench-h%d" % i})
+        server.start_background()
+        hosts.append((engine, batcher, server))
+    samples = rng.rand(64, fan_in).astype(numpy.float32)
+    duration = 1.0 if small else 2.0
+    passes = 3
+    # the stall must DOMINATE one-process scheduling jitter (~tens of
+    # ms on a small shared host): 150 ms on ~20% of the straggler's
+    # frames is unambiguous; the hedge answers from the healthy
+    # sibling within ~floor+service
+    stall_p, stall_s = 0.2, 0.15
+
+    def leg(hedge_on, seed):
+        # a fresh seeded chaos stream per leg: both legs of a pass
+        # face the same stall pattern.  The stall is HOST-SCOPED to
+        # bench-h0 (the transport's point:host_id convention): ONE
+        # straggler, one healthy sibling — the fleet shape hedging is
+        # for (a fleet-wide stall leaves nothing to hedge to)
+        chaos.install(chaos.FaultPlan(seed=seed).add(
+            "serve.host.stall:bench-h0", "stall",
+            probability=stall_p, param=stall_s))
+        router = FleetRouter(hedge=hedge_on, hedge_factor=2.0,
+                             hedge_floor_s=0.03,
+                             hedge_tick_s=0.01).start()
+        try:
+            for _, _, server in hosts:
+                ours, theirs = _socket.socketpair()
+                server.serve_socket(ours)
+                router.add_host(sock=theirs)
+            latencies, lock = [], _threading.Lock()
+            stop_at = time.perf_counter() + duration
+
+            def client(k):
+                mine, n = [], 0
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    router.infer(samples[(k * 31 + n) % len(samples)],
+                                 timeout=30.0)
+                    mine.append(time.perf_counter() - t0)
+                    n += 1
+                with lock:
+                    latencies.extend(mine)
+
+            threads = [_threading.Thread(target=client, args=(k,))
+                       for k in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            router.stop()
+            chaos.uninstall()
+        ps = _percentiles(latencies)
+        return {"requests": len(latencies),
+                **{p: round(v * 1e3, 3) for p, v in ps.items()}}
+
+    try:
+        rows = {"off": [], "on": []}
+        deltas = []
+        for i in range(passes):
+            off = leg(False, seed=100 + i)
+            on = leg(True, seed=100 + i)
+            rows["off"].append(off)
+            rows["on"].append(on)
+            deltas.append(off["p99"] - on["p99"])
+    finally:
+        for _, batcher, server in hosts:
+            server.stop()
+            batcher.stop()
+    from veles_tpu.observe.metrics import registry as _reg
+    med_delta = positive_majority_median(deltas)
+    p99_off = float(numpy.median([r["p99"] for r in rows["off"]]))
+    cut_pct = (round(100.0 * med_delta / p99_off, 2)
+               if med_delta is not None and p99_off else None)
+    return {
+        "hosts": 2,
+        "clients": 3,
+        "passes": passes,
+        "straggler": "serve.host.stall p%.2f %.0fms" % (
+            stall_p, stall_s * 1e3),
+        "off": rows["off"],
+        "on": rows["on"],
+        "p99_deltas_ms": [round(d, 3) for d in deltas],
+        "hedges_fired": _reg.counter("serve.hedge.fired").value,
+        "hedge_p99_cut_pct": cut_pct,
+    }
+
+
 def _build_native():
     from veles_tpu import native
     native.build_native()
@@ -1689,6 +1825,13 @@ def main():
                        lambda: bench_attention_ab(small))
     if attn_res is not None:
         extras["attention_ab"] = attn_res
+
+    # multi-host hedging A/B (docs/serving.md "Multi-host tier"):
+    # closed-loop p99 with hedging off vs on under a seeded
+    # serve.host.stall straggler, interleaved passes
+    hedge_res = section("hedge_ab", lambda: bench_hedge_ab(small))
+    if hedge_res is not None:
+        extras["hedge_ab"] = hedge_res
 
     # AlexNet rows, one program (= one ~60-200 s server compile) each.
     # Batch 256 bf16 = the throughput/MFU sweet spot and the only

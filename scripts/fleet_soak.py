@@ -1,0 +1,438 @@
+"""Multi-host serve-tier chaos soak -> HEDGE.json receipt.
+
+The acceptance proof of the fleet tier (docs/serving.md "Multi-host
+tier", ISSUE 15): a front-tier :class:`FleetRouter` dispatching over
+REAL serve-host subprocesses, with the two headline failure semantics
+measured rather than assumed:
+
+- **kill**: a seeded driver-side ``serve.host.preempt`` schedule
+  SIGKILLs a serve host mid-stream while closed-loop clients hammer
+  the fleet.  Every in-flight request on the dead link must be
+  re-answered by survivors — **zero failed requests**, every answer
+  bit-identical to the sequential single-engine reference — at
+  bounded p99; the host then respawns against its digest-keyed
+  persistent compile cache and rejoins with a **0-new-compiles**
+  re-warm receipt before re-entering rotation (membership epochs
+  bumped for the leave AND the rejoin).
+- **hedge_ab**: EVERY host armed with seeded random stalls
+  (``serve.host.stall`` on a fraction of each host's frames — the
+  tail-at-scale shape: any request may straggle, so the
+  throughput-EMA routing cannot simply learn to avoid one sick host;
+  a PERSISTENT straggler is the routing weights' job, and the EMA
+  penalty on cancelled hedge losers makes sure hedging never masks
+  one).  Closed-loop p50/p95/p99 measured with hedging OFF then ON:
+  hedging must measurably cut p99 — a stalled request is
+  re-dispatched to a sibling past the throughput-corrected
+  threshold, first result wins, losers rejected at the exactly-once
+  fence.
+
+Usage::
+
+    python scripts/fleet_soak.py --out HEDGE.json          # full
+    python scripts/fleet_soak.py --fast --out /tmp/H.json  # smoke
+
+The fast profile is the slow-marked test in
+tests/test_serve_fleet.py; the full profile is the committed
+HEDGE.json receipt.  (``--host`` is the internal serve-host
+subprocess entry the driver spawns.)
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy  # noqa: E402
+
+SAMPLE_SHAPE = (16,)
+LADDER = (8, 32)  # starts at 8: rung-1 is the ~1-ulp odd one out
+
+
+def _mlp_spec(seed):
+    from veles_tpu.compiler import LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    rng = numpy.random.RandomState(seed)
+    plans = [LayerPlan(All2AllTanh), LayerPlan(All2AllSoftmax)]
+    params = [
+        {"weights": rng.rand(16, 24).astype(numpy.float32),
+         "bias": rng.rand(24).astype(numpy.float32)},
+        {"weights": rng.rand(24, 4).astype(numpy.float32),
+         "bias": rng.rand(4).astype(numpy.float32)},
+    ]
+    return plans, params
+
+
+def _build_engine(seed, cache_root=None):
+    from veles_tpu.backends import Device
+    from veles_tpu.serve import AOTEngine
+    plans, params = _mlp_spec(seed)
+    engine = AOTEngine(plans, params, SAMPLE_SHAPE, ladder=LADDER,
+                       device=Device(backend="cpu"),
+                       cache_root=cache_root)
+    return engine, engine.compile()
+
+
+def host_main(args):
+    """The serve-host subprocess: one engine + batcher behind the
+    binary transport, identity + re-warm receipt on the READY line.
+    VELES_CHAOS in the environment arms per-host faults (the
+    straggler's ``serve.host.stall``); the driver's SIGKILL is the
+    preemption."""
+    from veles_tpu.serve import BinaryTransportServer, ContinuousBatcher
+    engine, receipt = _build_engine(args.seed,
+                                    cache_root=args.cache_root or None)
+    batcher = ContinuousBatcher(engine, max_delay_s=0.001,
+                                max_queue=4096).start()
+    server = BinaryTransportServer(
+        batcher, port=0, host_meta={"host_id": args.host_id})
+    server.start_background()
+    print("FLEET_HOST_READY port=%d host_id=%s new_compiles=%d"
+          % (server.port, args.host_id, receipt["new_compiles"]),
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        batcher.stop()
+    return 0
+
+
+class _HostProc(object):
+    """Driver-side handle on one serve-host subprocess."""
+
+    def __init__(self, host_id, seed, cache_root, chaos_spec=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("VELES_CHAOS", None)
+        if chaos_spec:
+            env["VELES_CHAOS"] = chaos_spec
+        self.host_id = host_id
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--host",
+             "--host-id", host_id, "--seed", str(seed),
+             "--cache-root", cache_root],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        deadline = time.monotonic() + 120.0
+        self.port = None
+        self.new_compiles = None
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith("FLEET_HOST_READY"):
+                fields = dict(kv.split("=") for kv in line.split()[1:])
+                self.port = int(fields["port"])
+                self.new_compiles = int(fields["new_compiles"])
+                break
+        if self.port is None:
+            raise RuntimeError("host %s never came up" % host_id)
+
+    def sigkill(self):
+        os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+def _closed_loop(router, reference, clients, duration_s, on_ok=None):
+    """Closed-loop client pool: every answer verified bit-identical to
+    the sequential reference row.  Returns (latencies, failures,
+    mismatches, ok_count)."""
+    samples = reference["samples"]
+    ref = reference["ref"]
+    stop_at = time.perf_counter() + duration_s
+    latencies, failures, mismatches = [], [], []
+    lock = threading.Lock()
+
+    def client(k):
+        mine, bad, fail = [], 0, []
+        n = 0
+        while time.perf_counter() < stop_at:
+            idx = (k * 131 + n) % len(samples)
+            n += 1
+            t0 = time.perf_counter()
+            try:
+                out = router.infer(samples[idx], timeout=30.0)
+            except Exception as exc:  # EVERY failure is a drop
+                fail.append("%s: %s" % (type(exc).__name__, exc))
+                continue
+            dt = time.perf_counter() - t0
+            mine.append(dt)
+            if not (out == ref[idx]).all():
+                bad += 1
+            if on_ok is not None:
+                on_ok()
+        with lock:
+            latencies.extend(mine)
+            failures.extend(fail)
+            if bad:
+                mismatches.append(bad)
+
+    threads = [threading.Thread(target=client, args=(k,),
+                                name="soak-client-%d" % k)
+               for k in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return latencies, failures, mismatches
+
+
+def _pcts(latencies):
+    from veles_tpu.observe.metrics import percentiles
+    return {p: round(v * 1e3, 3)
+            for p, v in percentiles(latencies).items()}
+
+
+def _counters(names):
+    from veles_tpu.observe.metrics import registry
+    return {name: registry.counter(name).value for name in names}
+
+
+_COUNTERS = ("serve.fleet.requests", "serve.fleet.failed",
+             "serve.fleet.requeues", "serve.fleet.cascades",
+             "serve.hedge.fired", "serve.hedge.wins",
+             "serve.hedge.duplicates_dropped")
+
+
+def run_soak(seed=11, fast=False, out=None, p99_bound_s=2.0):
+    from veles_tpu import chaos
+    from veles_tpu.serve import FleetRouter
+
+    workdir = tempfile.mkdtemp(prefix="fleet_soak_")
+    engine, _ = _build_engine(seed)
+    rng = numpy.random.RandomState(seed + 1)
+    samples = rng.rand(64, *SAMPLE_SHAPE).astype(numpy.float32)
+    reference = {"samples": samples,
+                 "ref": engine.infer(samples)}
+
+    # ---- phase A: SIGKILL a host mid-stream -----------------------------
+    duration = 6.0 if fast else 20.0
+    clients = 4 if fast else 6
+    hosts = [_HostProc("h%d" % i, seed,
+                       os.path.join(workdir, "cache_h%d" % i))
+             for i in range(3)]
+    router = FleetRouter(hedge_factor=2.0, hedge_floor_s=0.05,
+                         hedge_tick_s=0.01).start()
+    for h in hosts:
+        router.add_host(address=("127.0.0.1", h.port),
+                        host_id=h.host_id)
+    before = _counters(_COUNTERS)
+    epoch_before = router.fleet.membership_epoch
+
+    # the kill/rejoin schedule is a SEEDED FaultPlan the driver fires
+    # once per completed request — deterministic in request count, like
+    # elastic_soak's driver-side slave.rejoin_after
+    kill_after = 40 if fast else 150
+    plan = (chaos.FaultPlan(seed=seed)
+            .add("serve.host.preempt", "kill", nth=kill_after)
+            .add("slave.rejoin_after", "", nth=1, param=1.0))
+    kill_state = {"killed_at": None, "rejoined": None,
+                  "rejoin_compiles": None, "thread": None}
+    lock = threading.Lock()
+
+    def on_ok():
+        with lock:
+            fault = plan.fire("serve.host.preempt")
+        if fault is not None:
+            # MID-STREAM means mid-stream: pull the trigger only once
+            # the victim observably holds in-flight work (closed-loop
+            # clients re-arm it within a millisecond), so the kill
+            # provably orphans requests for the requeue path to save
+            for _ in range(2000):
+                if router.snapshot()["hosts"].get(
+                        "h0", {}).get("inflight"):
+                    break
+                time.sleep(0.001)
+            kill_state["killed_at"] = time.perf_counter()
+            hosts[0].sigkill()
+
+            def rejoin():
+                delay = plan.fire("slave.rejoin_after")
+                time.sleep(delay.param if delay is not None else 1.0)
+                hosts[0] = respawned = _HostProc(
+                    "h0", seed, os.path.join(workdir, "cache_h0"))
+                router.add_host(address=("127.0.0.1", respawned.port),
+                                host_id="h0-rejoin")
+                kill_state["rejoined"] = time.perf_counter()
+                kill_state["rejoin_compiles"] = respawned.new_compiles
+            kill_state["thread"] = threading.Thread(target=rejoin,
+                                                   name="rejoin")
+            kill_state["thread"].start()
+
+    latencies, failures, mismatches = _closed_loop(
+        router, reference, clients, duration, on_ok=on_ok)
+    if kill_state["thread"] is not None:
+        # the respawn (subprocess + warm compile) may outlast a short
+        # closed loop: the rejoin must land BEFORE the membership /
+        # re-warm receipts are read (and before the router stops)
+        kill_state["thread"].join(timeout=180)
+    kill_counters = {
+        name: value - before[name]
+        for name, value in _counters(_COUNTERS).items()}
+    kill_snap = router.snapshot()
+    epochs_bumped = router.fleet.membership_epoch - epoch_before
+    router.stop()
+    for h in hosts:
+        h.stop()
+    p99_s = (sorted(latencies)[
+        max(0, int(len(latencies) * 0.99) - 1)] if latencies else None)
+    kill = {
+        "clients": clients,
+        "duration_s": duration,
+        "requests_ok": len(latencies),
+        "failed_requests": len(failures),
+        "failed_detail": failures[:5],
+        "bit_identical": not mismatches,
+        "host_killed": kill_state["killed_at"] is not None,
+        "rejoined": kill_state["rejoined"] is not None,
+        "rejoin_new_compiles": kill_state["rejoin_compiles"],
+        "membership_epochs_bumped": epochs_bumped,
+        "latency_ms": _pcts(latencies),
+        "p99_bound_s": p99_bound_s,
+        "p99_within_bound": (p99_s is not None and
+                             p99_s <= p99_bound_s),
+        "counters": kill_counters,
+        "fleet": kill_snap,
+    }
+
+    # ---- phase B: hedging A/B under induced stragglers ------------------
+    # random stalls on EVERY host (independent seeded streams): the
+    # tail-at-scale shape routing cannot dodge — hedging is the only
+    # tail cure, which is exactly what the A/B must isolate
+    leg_s = 4.0 if fast else 10.0
+    # stall 5% of each host's frames 150 ms: single-stall probability
+    # (~5%) dominates p99 in the OFF leg, while double-stall — the
+    # case hedging cannot rescue, original AND hedge both stalled —
+    # stays well under the 1% percentile boundary (~0.25%), so the ON
+    # leg's p99 is the hedge path, not the stall
+    stall = "seed=%d;serve.host.stall=stall:p0.05:0.15"
+    legs = {}
+    hedge_counts = {}
+    for name, hedge_on in (("off", False), ("on", True)):
+        # fresh hosts per leg: each chaos stream restarts at its seed,
+        # so both legs face the same per-host stall patterns
+        stallers = [
+            _HostProc("s%d" % i, seed,
+                      os.path.join(workdir, "cache_s%d" % i),
+                      chaos_spec=stall % (seed + 100 * (i + 1)))
+            for i in range(2)]
+        router = FleetRouter(hedge=hedge_on, hedge_factor=2.0,
+                             hedge_floor_s=0.03,
+                             hedge_tick_s=0.005).start()
+        for i, h in enumerate(stallers):
+            router.add_host(address=("127.0.0.1", h.port),
+                            host_id="s%d" % i)
+        before = _counters(_COUNTERS)
+        latencies, failures, mismatches = _closed_loop(
+            router, reference, 4, leg_s)
+        hedge_counts[name] = {
+            k: v - before[k] for k, v in _counters(_COUNTERS).items()}
+        router.stop()
+        for h in stallers:
+            h.stop()
+        legs[name] = {
+            "requests_ok": len(latencies),
+            "failed_requests": len(failures),
+            "bit_identical": not mismatches,
+            "latency_ms": _pcts(latencies),
+        }
+    p99_off = legs["off"]["latency_ms"].get("p99")
+    p99_on = legs["on"]["latency_ms"].get("p99")
+    cut = (round(100.0 * (p99_off - p99_on) / p99_off, 2)
+           if p99_off else None)
+    hedge_ab = {
+        "straggler_chaos": stall % seed +
+            " (per host, independent seed offsets)",
+        "off": legs["off"],
+        "on": legs["on"],
+        "hedges_fired": hedge_counts["on"]["serve.hedge.fired"],
+        "hedge_wins": hedge_counts["on"]["serve.hedge.wins"],
+        "duplicates_dropped":
+            hedge_counts["on"]["serve.hedge.duplicates_dropped"],
+        "p99_cut_pct": cut,
+    }
+
+    checks = {
+        "zero_failed_requests": kill["failed_requests"] == 0 and
+        legs["off"]["failed_requests"] == 0 and
+        legs["on"]["failed_requests"] == 0,
+        "bit_identical": kill["bit_identical"] and
+        legs["off"]["bit_identical"] and legs["on"]["bit_identical"],
+        "host_killed_mid_stream": kill["host_killed"],
+        "requeued_in_flight": kill_counters["serve.fleet.requeues"] > 0,
+        "membership_epochs_bumped": epochs_bumped >= 2,
+        "rejoin_rewarm_zero_compiles":
+            kill_state["rejoin_compiles"] == 0,
+        "p99_within_bound": kill["p99_within_bound"],
+        "hedging_cuts_p99": cut is not None and cut > 0,
+    }
+    receipt = {
+        "schema": 1,
+        "mode": "fast" if fast else "full",
+        "seed": seed,
+        "hosts": 3,
+        "ladder": list(LADDER),
+        "kill": kill,
+        "hedge_ab": hedge_ab,
+        "checks": checks,
+        "passed": all(checks.values()),
+    }
+    if out:
+        with open(out, "w") as fout:
+            json.dump(receipt, fout, indent=1, sort_keys=True)
+            fout.write("\n")
+    print("fleet soak %s: %d ok / %d failed (kill phase, p99 %.1fms), "
+          "requeues %d, rejoin compiles %s, hedge p99 cut %s%%"
+          % ("PASSED" if receipt["passed"] else "FAILED",
+             kill["requests_ok"], kill["failed_requests"],
+             kill["latency_ms"].get("p99", float("nan")),
+             kill_counters["serve.fleet.requeues"],
+             kill_state["rejoin_compiles"], cut))
+    return receipt
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--host", action="store_true",
+                        help="internal: run as a serve-host subprocess")
+    parser.add_argument("--host-id", default="host")
+    parser.add_argument("--cache-root", default="")
+    parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--fast", action="store_true",
+                        help="smoke profile (the slow-marked test)")
+    parser.add_argument("--p99-bound-s", type=float, default=2.0,
+                        help="absolute p99 bound for the kill phase "
+                        "(CPU-scale; the bound is about NOT hanging, "
+                        "the receipt records the measured value)")
+    parser.add_argument("--out", default="HEDGE.json")
+    args = parser.parse_args(argv)
+    if args.host:
+        return host_main(args)
+    receipt = run_soak(seed=args.seed, fast=args.fast, out=args.out,
+                       p99_bound_s=args.p99_bound_s)
+    return 0 if receipt["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

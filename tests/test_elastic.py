@@ -130,6 +130,56 @@ def test_fleet_view_epochs():
     assert fleet.shares(30) == {"b": 30}
 
 
+def test_fleet_view_throughput_ema_share_mode():
+    """The serve tier's share mode: weights are MEASURED throughput
+    EMAs, not static ratings — cold members read the neutral 1.0, the
+    first real sample seeds the EMA directly, later ones decay in."""
+    fleet = FleetView(throughput_alpha=0.5)
+    fleet.join("a", 1.0)
+    fleet.join("b", 1.0)
+    # cold start: neutral 1.0 everywhere -> equal split
+    assert fleet.throughput("a") == 1.0
+    assert fleet.throughputs() == [1.0, 1.0]
+    assert fleet.shares(10, by="throughput") == {"a": 5, "b": 5}
+    # the FIRST observation seeds the EMA directly (no 1.0 bias that
+    # would take dozens of samples to wash out of a rows/sec scale)
+    assert fleet.observe_throughput("a", 300.0) == 300.0
+    # decay: alpha=0.5 folds each new sample in halfway
+    assert fleet.observe_throughput("a", 100.0) == pytest.approx(200.0)
+    assert fleet.observe_throughput("a", 100.0) == pytest.approx(150.0)
+    fleet.observe_throughput("b", 50.0)
+    assert fleet.shares(100, by="throughput") == {"a": 75, "b": 25}
+    # the power mode is untouched by observations
+    assert fleet.shares(100) == {"a": 50, "b": 50}
+    # callers that can substitute a better prior detect cold members
+    assert fleet.throughput("ghost", default=None) is None
+
+
+def test_fleet_view_throughput_sick_samples_neutralized():
+    """A host reporting zero/negative/NaN/garbage throughput
+    neutralizes to 1.0 like effective_power — one corrupt report can
+    dent the EMA but never poison a fleet aggregate."""
+    for sick in (0.0, -5.0, float("nan"), float("inf"), None, "junk"):
+        cold = FleetView(throughput_alpha=0.5)
+        cold.join("a", 1.0)
+        assert cold.observe_throughput("a", sick) == 1.0
+    fleet = FleetView(throughput_alpha=0.5)
+    fleet.join("a", 1.0)
+    fleet.observe_throughput("a", 200.0)
+    ema = fleet.observe_throughput("a", float("nan"))
+    assert math.isfinite(ema) and ema == pytest.approx(100.5)
+
+
+def test_fleet_view_throughput_forgotten_on_leave():
+    fleet = FleetView()
+    fleet.join("a", 1.0)
+    fleet.observe_throughput("a", 500.0)
+    fleet.leave("a")
+    fleet.join("a", 1.0)
+    # a rejoin restarts cold: the pre-leave rate is stale evidence
+    assert fleet.throughput("a") == 1.0
+
+
 # -- server threshold math under degenerate stats -------------------------
 
 
@@ -292,8 +342,12 @@ class _StubMaster(object):
 
 class _StubSlave(object):
     """Client-side stub: returns each job payload as its result.
-    Jobs in ``slow_on`` straggle — for ``slow_s`` seconds, or until
-    ``gate`` is set when one is given (releasable wedge)."""
+    Jobs in ``slow_on`` straggle — until ``gate`` is set when one is
+    given (a PURE event wedge, no wall-clock cap: every gated test
+    releases it in its ``finally``, so the owner can never un-wedge
+    on its own under full-suite load and race the assertions — the
+    last PR-9-era timing window, closed like the PR 14 deflakes),
+    else for ``slow_s`` seconds."""
 
     checksum = "elastic-stub"
 
@@ -312,7 +366,7 @@ class _StubSlave(object):
     def do_job(self, data, update, callback):
         if data in self.slow_on:
             if self.gate is not None:
-                self.gate.wait(self.slow_s)
+                self.gate.wait()
             else:
                 time.sleep(self.slow_s)
         callback(("done", data))
@@ -496,7 +550,7 @@ def test_server_speculation_first_result_wins():
     master = _StubMaster(["seed", "slow"])
     server, _ = _stub_server(master, speculation_factor=1.0,
                              min_speculation_s=0.2)
-    wf_a = _StubSlave(slow_on=("slow",), slow_s=120.0, gate=wedge)
+    wf_a = _StubSlave(slow_on=("slow",), gate=wedge)
     wf_b = _StubSlave()
     ca = Client("127.0.0.1:%d" % server.port, wf_a)
     ta = ca.start_background()
@@ -546,7 +600,7 @@ def test_owner_drop_during_backup_apply_defers_requeue():
     master = _StubMaster(["seed", "slow"])
     server, _ = _stub_server(master, speculation_factor=1.0,
                              min_speculation_s=0.2)
-    wf_a = _StubSlave(slow_on=("slow",), slow_s=120.0, gate=wedge)
+    wf_a = _StubSlave(slow_on=("slow",), gate=wedge)
     wf_b = _StubSlave()
     ca = Client("127.0.0.1:%d" % server.port, wf_a)
     ta = ca.start_background()
@@ -604,7 +658,7 @@ def test_speculated_owner_request_parks_until_resolution():
     master = _StubMaster(["seed", "slow"])
     server, _ = _stub_server(master, speculation_factor=1.0,
                              min_speculation_s=0.2)
-    wf_a = _StubSlave(slow_on=("slow",), slow_s=120.0, gate=wedge)
+    wf_a = _StubSlave(slow_on=("slow",), gate=wedge)
     wf_b = _StubSlave()
     ca = Client("127.0.0.1:%d" % server.port, wf_a, async_slave=True)
     ta = ca.start_background()
@@ -733,7 +787,7 @@ def test_poisoned_backup_with_dropped_owner_not_reinstated(monkeypatch):
     master = _StubMaster(["seed", "slow"])
     server, _ = _stub_server(master, speculation_factor=1.0,
                              min_speculation_s=0.2)
-    wf_a = _StubSlave(slow_on=("slow",), slow_s=120.0, gate=wedge)
+    wf_a = _StubSlave(slow_on=("slow",), gate=wedge)
     wf_b = _PoisonSlave()
     ca = Client("127.0.0.1:%d" % server.port, wf_a)
     ta = ca.start_background()
@@ -799,7 +853,7 @@ def test_failed_apply_of_speculated_copy_does_not_orphan_job():
     master.apply_data_from_slave = flaky_apply
     server, _ = _stub_server(master, speculation_factor=1.0,
                              min_speculation_s=0.2)
-    wf_a = _StubSlave(slow_on=("slow",), slow_s=120.0, gate=wedge)
+    wf_a = _StubSlave(slow_on=("slow",), gate=wedge)
     wf_b = _StubSlave()
     ca = Client("127.0.0.1:%d" % server.port, wf_a)
     ta = ca.start_background()

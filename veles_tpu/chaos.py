@@ -81,6 +81,34 @@ point               module                     actions
                                                LATEST flip — stale
                                                pointer, burned
                                                ordinal)
+``serve.host.stall``  serve.transport          stall (this served
+                    (per served frame)         frame parks ``param``
+                                               seconds — the induced
+                                               straggler the fleet's
+                                               request hedging must
+                                               beat; a pipelined
+                                               stall parks only its
+                                               own request, never the
+                                               link)
+``serve.host.preempt``  serve.transport        kill (SIGKILL SELF —
+                    (per served frame)         real mid-stream host
+                                               death for the
+                                               fleet_soak subprocess
+                                               hosts; aK schedules
+                                               preempt after K clean
+                                               frames), sever (drop
+                                               the connection — the
+                                               in-process stand-in:
+                                               the router sees the
+                                               link die and requeues)
+``serve.hedge.lose_race``  serve.fleet         (any action: the
+                    (router, per hedge         router SKIPS the
+                    loser)                     loser's wire cancel,
+                                               so the losing copy
+                                               completes and its late
+                                               result exercises the
+                                               duplicate-rejection
+                                               fence deterministically)
 ==================  =========================  =========================
 
 (``snapshot.write`` also covers ``serve.freshness``'s

@@ -135,11 +135,27 @@ class FleetView(object):
     epoch E is *stale* with respect to every epoch > E: its work was
     requeued when it left, so the Server drops the duplicate instead
     of applying it (the exactly-once half of the elasticity contract).
+
+    Besides the static power ratings the handshake reports, the view
+    can track **measured throughput** per member as an EMA
+    (:meth:`observe_throughput`): the serve fleet weights its routing
+    and hedging by what hosts actually deliver, not what they claimed
+    at join time (``shares(..., by="throughput")`` is the matching
+    share mode).  Every observation is sanitized exactly like
+    :func:`effective_power` — a member reporting zero/negative/NaN
+    throughput contributes the neutral 1.0, never a sick aggregate —
+    and an unobserved (cold-start) member reads 1.0 until its first
+    real sample lands.
     """
 
-    def __init__(self):
+    def __init__(self, throughput_alpha=0.2):
         self.membership_epoch = 0
         self.members = {}  # sid -> reported power rating
+        #: EMA smoothing for measured throughput: weight of the NEWEST
+        #: observation (0 < alpha <= 1; 1 = no smoothing)
+        self.throughput_alpha = min(max(float(throughput_alpha),
+                                        1e-6), 1.0)
+        self._throughput = {}  # sid -> sanitized EMA
 
     def __len__(self):
         return len(self.members)
@@ -153,15 +169,56 @@ class FleetView(object):
     def leave(self, sid):
         """Retire ``sid``; returns the (possibly bumped) epoch.  An
         unknown sid does not bump — a double drop is not a membership
-        change."""
+        change.  The throughput EMA is forgotten with the member: a
+        rejoin restarts cold (its old rate is stale evidence)."""
         if sid in self.members:
             del self.members[sid]
+            self._throughput.pop(sid, None)
             self.membership_epoch += 1
         return self.membership_epoch
 
-    def shares(self, remaining):
-        """Power-weighted split of ``remaining`` work units across the
-        live fleet ({} when the remainder is unknown)."""
+    def observe_throughput(self, sid, rate):
+        """Fold one measured throughput sample (e.g. rows/second) into
+        ``sid``'s EMA; returns the new EMA.  The FIRST observation
+        seeds the EMA directly (no bias toward the neutral baseline);
+        each later one decays in with ``throughput_alpha``.  Sick
+        samples (zero/negative/NaN/garbage) are neutralized to 1.0
+        BEFORE the fold, mirroring :func:`effective_power`, so one
+        corrupt report can dent the EMA but never poison it."""
+        rate = effective_power(rate)
+        prev = self._throughput.get(sid)
+        if prev is None:
+            ema = rate
+        else:
+            alpha = self.throughput_alpha
+            ema = alpha * rate + (1.0 - alpha) * prev
+        self._throughput[sid] = ema
+        return ema
+
+    def throughput(self, sid, default=1.0):
+        """``sid``'s throughput EMA, or ``default`` before any
+        observation (cold start) / for unknown members.  The neutral
+        1.0 keeps aggregates safe; callers that can substitute a
+        better prior (the serve router uses the fleet mean so a cold
+        host competes for traffic instead of starving against
+        measured absolute rates) pass ``default=None`` and handle the
+        miss themselves."""
+        return self._throughput.get(sid, default)
+
+    def throughputs(self):
+        """Per-member throughput EMAs for the live fleet (cold members
+        at the neutral 1.0) — threshold/aggregate inputs."""
+        return [self.throughput(sid) for sid in self.members]
+
+    def shares(self, remaining, by="power"):
+        """Split of ``remaining`` work units across the live fleet
+        ({} when the remainder is unknown): ``by="power"`` weights by
+        the static reported ratings, ``by="throughput"`` by the
+        measured EMAs (the serve tier's mode)."""
+        if by == "throughput":
+            weights = {sid: self.throughput(sid)
+                       for sid in self.members}
+            return power_shares(remaining, weights)
         return power_shares(remaining, self.members)
 
     def powers(self):

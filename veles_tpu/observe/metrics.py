@@ -221,6 +221,17 @@ _HEALTH_KEYS = (
     ("serve.freshness.promotions", "freshness_promotions"),
     ("serve.freshness.rollbacks", "freshness_rollbacks"),
     ("serve.freshness.poisoned_rejected", "freshness_poisoned"),
+    # multi-host serve tier (veles_tpu/serve/fleet.py): host
+    # membership and the hedging/exactly-once accounting ride
+    # heartbeats so a post-mortem can line up a p99 cliff against the
+    # host loss (or the hedge storm) that caused it; the full
+    # per-host block is FleetRouter.snapshot() on the dashboard
+    ("serve.fleet.hosts_live", "fleet_hosts_live"),
+    ("serve.fleet.membership_epoch", "fleet_membership_epoch"),
+    ("serve.fleet.requeues", "fleet_requeues"),
+    ("serve.hedge.fired", "hedges_fired"),
+    ("serve.hedge.wins", "hedge_wins"),
+    ("serve.hedge.duplicates_dropped", "hedge_duplicates_dropped"),
     # XLA introspection (observe/xla_introspect.py): live achieved-MFU
     # and compile accounting ride the same health surface
     ("xla.mfu_pct", "mfu_pct"),

@@ -34,7 +34,15 @@ inference runtime — rebuilt TPU-idiomatically in three layers:
   (finite gate, background warm-up, mirrored canary judgment via
   :class:`CanaryComparator`) over the router's canary state machine —
   promote fleet-wide or auto-roll back to the last-good digest with
-  zero new compiles.
+  zero new compiles;
+- :mod:`veles_tpu.serve.fleet` — the multi-host tier:
+  :class:`FleetRouter` dispatches over many serve HOSTS (pipelined
+  binary links, membership epochs via ``elastic.FleetView``,
+  throughput-EMA weighted least-loaded routing with host-granular
+  overload cascade) and hedges stragglers — re-dispatch past the
+  power-corrected threshold, first result wins, loser cancelled over
+  the wire — with exactly-once completion under host loss (a SIGKILL
+  mid-stream costs bounded p99, never a failed request).
 
 ``python -m veles_tpu.serve --snapshot model.pickle`` serves a trained
 snapshot; ``scripts/serve_load.py`` is the closed-loop load generator
@@ -46,6 +54,8 @@ from veles_tpu.serve.batcher import (  # noqa: F401
 from veles_tpu.serve.engine import (  # noqa: F401
     AOTEngine, DEFAULT_LADDER, enable_persistent_cache, model_digest,
     value_digest)
+from veles_tpu.serve.fleet import (  # noqa: F401
+    FleetRequest, FleetRouter, HostLink)
 from veles_tpu.serve.freshness import (  # noqa: F401
     CanaryComparator, FreshnessController, SnapshotWatcher,
     export_model_spec)
@@ -59,10 +69,10 @@ from veles_tpu.serve.transport import (  # noqa: F401
 
 __all__ = ["AOTEngine", "BinaryTransportClient",
            "BinaryTransportServer", "CanaryComparator",
-           "CanaryCutover", "ContinuousBatcher",
-           "FreshnessController", "Replica", "ReplicaPool",
-           "ServeOverload", "ServeService", "SnapshotWatcher",
-           "DEFAULT_LADDER", "decode_tensor",
+           "CanaryCutover", "ContinuousBatcher", "FleetRequest",
+           "FleetRouter", "FreshnessController", "HostLink",
+           "Replica", "ReplicaPool", "ServeOverload", "ServeService",
+           "SnapshotWatcher", "DEFAULT_LADDER", "decode_tensor",
            "enable_persistent_cache", "encode_tensor",
            "export_model_spec", "format_result", "local_devices",
            "model_digest", "serve_snapshot", "value_digest"]

@@ -38,11 +38,36 @@ def build_parser():
         prog="python -m veles_tpu.serve",
         description="AOT-compiled, continuously-batched inference "
                     "service")
-    source = parser.add_mutually_exclusive_group(required=True)
+    source = parser.add_mutually_exclusive_group()
     source.add_argument("--snapshot", help="trained workflow snapshot "
                         "(snapshotter export) to serve")
     source.add_argument("--demo", action="store_true",
                         help="train a tiny demo MLP and serve it")
+    source.add_argument("--fleet", metavar="HOST:PORT,HOST:PORT,...",
+                        help="run the FRONT tier of a multi-host "
+                        "serve fleet over these serve hosts "
+                        "(docs/serving.md 'Multi-host tier'): no "
+                        "local model — hosts provide it; requests are "
+                        "routed least-loaded with hedged tails and "
+                        "exactly-once completion under host loss")
+    parser.add_argument("--fleet-host", action="store_true",
+                        help="run as a serve HOST of a multi-host "
+                        "fleet: the binary transport listener only "
+                        "(--transport-port), announced with "
+                        "--host-id; a front started with --fleet "
+                        "dials it")
+    parser.add_argument("--host-id", default=None,
+                        help="fleet host identity (--fleet-host; "
+                        "default: machine id + pid)")
+    parser.add_argument("--no-hedge", action="store_true",
+                        help="--fleet: disable request hedging (the "
+                        "straggler A/B's control leg)")
+    parser.add_argument("--hedge-factor", type=float, default=2.0,
+                        help="--fleet: hedge past factor x the mean "
+                        "completed latency (throughput-corrected)")
+    parser.add_argument("--hedge-floor-ms", type=float, default=50.0,
+                        help="--fleet: minimum straggler age before a "
+                        "hedge fires")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--path", default="/infer")
     parser.add_argument("--replicas", type=int, default=None,
@@ -180,8 +205,83 @@ def _demo_workflow():
     return sw
 
 
+def _fleet_front_main(args):
+    """--fleet: the front tier — no local model, route over hosts."""
+    from veles_tpu.serve import ServeService
+    from veles_tpu.serve.fleet import FleetRouter
+    router = FleetRouter(hedge=not args.no_hedge,
+                         hedge_factor=args.hedge_factor,
+                         hedge_floor_s=args.hedge_floor_ms / 1e3)
+    for address in args.fleet.split(","):
+        router.add_host(address=address.strip())
+    service = ServeService(router, port=args.port, path=args.path,
+                           transport_port=args.transport_port)
+    service.start_background()
+    snap = router.snapshot()
+    print("fleet front on http://127.0.0.1:%d%s over %d host(s) "
+          "(digest %s, hedging %s)"
+          % (service.port, args.path, snap["hosts_live"],
+             snap["digest"], "on" if router.hedge else "off"))
+    try:
+        if args.duration is not None:
+            time.sleep(args.duration)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.stop()
+    return 0
+
+
+def _fleet_host_main(args, pool, receipt, freshness=None):
+    """--fleet-host: the binary listener a --fleet front dials.  A
+    host is a full PR-12 serve process — ``--watch-dir`` runs the
+    freshness loop here too, so published snapshots keep canarying
+    and promoting on the host while the front routes to it."""
+    import os
+
+    from veles_tpu.network_common import machine_id
+    from veles_tpu.serve.transport import BinaryTransportServer
+    host_id = args.host_id or "%s-%d" % (machine_id(), os.getpid())
+    pool.start()
+    transport = BinaryTransportServer(
+        pool, port=args.transport_port or 0,
+        host_meta={"host_id": host_id})
+    transport.start_background()
+    # the READY line is the soak driver's handshake: parse, then dial
+    print("FLEET_HOST_READY port=%d host_id=%s digest=%s "
+          "new_compiles=%d" % (transport.port, host_id, pool.digest,
+                               receipt["new_compiles"]), flush=True)
+    try:
+        if args.duration is not None:
+            time.sleep(args.duration)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if freshness is not None:
+            freshness.stop()
+        transport.stop()
+        pool.stop()
+    return 0
+
+
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.fleet:
+        if args.fleet_host:
+            parser.error("--fleet (front) and --fleet-host (host) are "
+                         "different roles; pick one")
+        return _fleet_front_main(args)
+    if not (args.snapshot or args.demo):
+        parser.error("one of --snapshot / --demo / --fleet is required")
+    if args.fleet_host and args.transport_port is None:
+        args.transport_port = 0
     if args.demo:
         sw = _demo_workflow()
     else:
@@ -214,6 +314,8 @@ def main(argv=None):
             mirror_fraction=args.mirror_fraction,
             min_mirrors=args.min_mirrors,
             canary=not args.no_canary).start()
+    if args.fleet_host:
+        return _fleet_host_main(args, pool, receipt, freshness)
     loader = getattr(sw, "loader", None)
     service = ServeService(
         pool, port=args.port, path=args.path,

@@ -629,7 +629,18 @@ def serve_snapshot(reg=None):
                         ("serve.freshness.promotions", "promotions"),
                         ("serve.freshness.rollbacks", "rollbacks"),
                         ("serve.freshness.poisoned_rejected",
-                         "poisoned_rejected")):
+                         "poisoned_rejected"),
+                        # multi-host tier (docs/serving.md "Multi-host
+                        # tier"): the serve column shows fleet
+                        # membership + hedging next to load
+                        ("serve.fleet.hosts_live", "hosts_live"),
+                        ("serve.fleet.membership_epoch",
+                         "fleet_membership_epoch"),
+                        ("serve.fleet.requeues", "fleet_requeues"),
+                        ("serve.hedge.fired", "hedges_fired"),
+                        ("serve.hedge.wins", "hedge_wins"),
+                        ("serve.hedge.duplicates_dropped",
+                         "hedge_duplicates_dropped")):
         metric = reg.peek(name)
         if metric is not None and metric.value is not None:
             out[short] = metric.value

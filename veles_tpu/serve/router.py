@@ -33,6 +33,13 @@ device plus its own :class:`ContinuousBatcher` worker — and the
   digest AOT-warms a full new ladder per replica in the background and
   cuts over atomically between batches; either way the queue is never
   dropped.
+
+One pool scales across one host's chips.  The next rung up is
+:mod:`veles_tpu.serve.fleet`: a :class:`FleetRouter` front spanning
+many serve HOSTS — each one of these pools behind its binary
+transport — with the same least-loaded + cascade-then-503 semantics
+lifted to host granularity, plus membership epochs and request
+hedging (docs/serving.md "Multi-host tier").
 """
 
 import threading

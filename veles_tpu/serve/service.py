@@ -80,8 +80,14 @@ class ServeService(Logger):
                  transport_port=None, transport_secret=None,
                  freshness=None, **batcher_kwargs):
         super(ServeService, self).__init__()
+        from veles_tpu.serve.fleet import FleetRouter
         from veles_tpu.serve.router import ReplicaPool
-        if isinstance(engine, ReplicaPool):
+        self._is_fleet = isinstance(engine, FleetRouter)
+        if isinstance(engine, (ReplicaPool, FleetRouter)):
+            # a pool of local replicas or a FRONT over remote serve
+            # hosts (docs/serving.md "Multi-host tier") — both speak
+            # the batcher submit contract, so /infer and the binary
+            # transport drive them identically
             self.router = engine
             self._engine = None
             self._owns_batcher = True
@@ -271,7 +277,8 @@ class ServeService(Logger):
                     "serve": serve_snapshot(),
                 }
                 if svc.router is not None:
-                    health["replicas"] = svc.router.snapshot()
+                    health["fleet" if svc._is_fleet else
+                           "replicas"] = svc.router.snapshot()
                 if svc.transport_port is not None:
                     health["transport_port"] = svc.transport_port
                 if svc.last_reload is not None:

@@ -49,15 +49,38 @@ mid-connection falls back to inline payloads instead of failing the
 request; ``serve.transport.{socket,shm}_{rx,tx}_bytes`` counters
 receipt which road the bytes took (tests/test_transport.py asserts
 the bypass).
+
+Fleet links (docs/serving.md "Multi-host tier"): a hello carrying
+``"pipeline": true`` switches the connection into the router↔host
+mode :mod:`veles_tpu.serve.fleet` speaks — many ``infer`` frames in
+flight at once, each dispatched concurrently and answered by ``id``
+(out of order), plus a best-effort ``{"op": "cancel", "id"}`` frame
+that drops a hedged loser before (or instead of) its reply.  The
+pipelined mode never negotiates shm (the two-slot layout NEEDS the
+in-order discipline) and a cancelled request is answered with
+*nothing* — the router already forgot the copy; exactly-once is the
+router's accounting, the cancel only bounds wasted work.  When the
+server was built with ``host_meta`` (a serve HOST in a fleet), the
+hello reply carries a ``"host"`` block: the host id plus the pool's
+compile receipt summary — how a rejoining host proves it re-warmed
+from the persistent cache (``new_compiles == 0``) before re-entering
+rotation.  Chaos points ``serve.host.stall`` (this request parks
+``param`` seconds — the induced straggler the hedging A/B measures)
+and ``serve.host.preempt`` (``kill`` = SIGKILL self, the subprocess
+soak's mid-stream host death; any other action severs the
+connection) fire per served frame.
 """
 
 import asyncio
+import os
+import signal
 import socket as _socketmod
 import threading
 import time
 
 import numpy
 
+from veles_tpu import chaos
 from veles_tpu.logger import Logger
 from veles_tpu.network_common import (
     ProtocolError, ShmChannel, default_secret, get_codec, machine_id,
@@ -140,6 +163,51 @@ def decode_tensor(meta, raw):
     return numpy.frombuffer(raw, dtype).reshape(shape)
 
 
+class _CancelledByPeer(Exception):
+    """The peer cancelled this in-flight request (hedged loser): the
+    serving side drops it silently — no reply frame, the router
+    already retired the copy."""
+
+
+class _InflightScope(object):
+    """Cancellation bridge for ONE pipelined in-flight request: the
+    event-loop-side cancel handler and the executor-side dispatch race
+    through here.  ``add`` registers a batcher request under the scope
+    (raising immediately when the cancel already landed); ``cancel``
+    marks every registered request cancelled — the batcher worker
+    drops undispatched ones at collect time — and releases the waiting
+    executor thread with :class:`_CancelledByPeer` so it never waits
+    out its timeout computing for nobody."""
+
+    __slots__ = ("_lock", "_reqs", "cancelled")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reqs = []
+        self.cancelled = False
+
+    def add(self, req):
+        with self._lock:
+            if self.cancelled:
+                req.cancelled = True
+                raise _CancelledByPeer("cancelled by peer")
+            self._reqs.append(req)
+        return req
+
+    def cancel(self):
+        with self._lock:
+            self.cancelled = True
+            reqs, self._reqs = list(self._reqs), []
+        for req in reqs:
+            req.cancelled = True
+            if not req.done.is_set():
+                # racing the worker's result fill is benign: done is
+                # set either way and the reply is suppressed on the
+                # scope flag, not on which write landed last
+                req.error = _CancelledByPeer("cancelled by peer")
+                req.done.set()
+
+
 class BinaryTransportServer(Logger):
     """Persistent-connection binary listener over a batcher or pool.
 
@@ -154,12 +222,17 @@ class BinaryTransportServer(Logger):
     :meth:`serve_socket` and never bind a real port."""
 
     def __init__(self, pool, port=0, address="127.0.0.1", secret=None,
-                 executor_workers=32, timeout=30.0, **kwargs):
+                 executor_workers=32, timeout=30.0, host_meta=None,
+                 **kwargs):
         super(BinaryTransportServer, self).__init__(**kwargs)
         self.pool = pool
         self.address = address
         self.port = port
         self.timeout = float(timeout)
+        #: fleet-host identity ({"host_id": ...}) acked back in every
+        #: hello reply's "host" block together with the pool's compile
+        #: receipt summary; None = not a fleet host, no block
+        self.host_meta = dict(host_meta) if host_meta else None
         self._secret = default_secret() if secret is None \
             else (secret or None)
         self._executor_workers = int(executor_workers)
@@ -324,29 +397,49 @@ class BinaryTransportServer(Logger):
                                     % hello.get("op"))
             engine = self.pool.engine
             same_host = hello.get("mid") == machine_id()
+            pipelined = bool(hello.get("pipeline"))
             reply = {
                 "op": "hello", "mid": machine_id(),
                 "digest": engine.digest,
                 "dtype": engine.dtype.str,
                 "sample_shape": list(engine.sample_shape),
                 "max_batch": engine.max_batch,
+                "ladder": list(engine.ladder),
+                "pipeline": pipelined,
                 "shm_ok": False,
                 "shm_reply_ok": False,
             }
+            if self.host_meta is not None:
+                # fleet-host identity + the re-warm receipt: a
+                # rejoining host proves it deserialized its ladder
+                # from the shared digest-keyed cache (new_compiles 0)
+                # before the router puts it back in rotation
+                host = dict(self.host_meta)
+                receipt = getattr(self.pool, "compile_receipt", None) \
+                    or getattr(engine, "compile_receipt", None)
+                if receipt:
+                    host["new_compiles"] = receipt.get("new_compiles")
+                    host["cache_hits"] = receipt.get("cache_hits")
+                reply["host"] = host
             # the CLIENT creates both segments and owns their size and
             # lifetime; the server only ever ATTACHES (bounded below) —
             # so a hostile hello cannot make the server allocate, and
             # an attach failure is known HERE and acked back, never
             # discovered mid-request (each side uses only channels it
-            # verifiably has)
-            if same_host and hello.get("shm"):
+            # verifiably has).  Pipelined (fleet) links never get shm:
+            # the two-slot layout needs the in-order reply discipline
+            # this mode deliberately gives up.
+            if same_host and hello.get("shm") and not pipelined:
                 chan_in = self._attach_bounded(hello["shm"])
                 reply["shm_ok"] = chan_in is not None
-            if same_host and hello.get("shm_reply"):
+            if same_host and hello.get("shm_reply") and not pipelined:
                 chan_out = self._attach_bounded(hello["shm_reply"])
                 reply["shm_reply_ok"] = chan_out is not None
             write_frame(writer, reply, secret=self._secret)
             await writer.drain()
+            if pipelined:
+                await self._handle_pipelined(reader, writer)
+                return
             while True:
                 try:
                     msg, payload = await read_frame(
@@ -385,12 +478,123 @@ class BinaryTransportServer(Logger):
             except Exception:
                 pass
 
+    async def _handle_pipelined(self, reader, writer):
+        """The fleet-link loop: every ``infer`` frame becomes its own
+        task (replies out of order, matched by id), ``cancel`` frames
+        retire in-flight scopes, and frame WRITES are serialized by
+        one lock so concurrent replies never interleave bytes.  On
+        disconnect every in-flight scope is cancelled: a dead link's
+        requests must not keep executor threads waiting out their
+        timeouts for a peer that is gone."""
+        write_lock = asyncio.Lock()
+        inflight = {}
+        tasks = set()
+
+        async def one(msg, payload, scope):
+            try:
+                await self._serve_one(msg, payload, None, None, writer,
+                                      write_lock=write_lock,
+                                      scope=scope)
+            except (ConnectionError, OSError):
+                # chaos sever / peer gone: drop the whole connection
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            finally:
+                inflight.pop(msg.get("id"), None)
+
+        try:
+            while True:
+                try:
+                    msg, payload = await read_frame(
+                        reader, secret=self._secret,
+                        max_len=MAX_FRAME_BYTES)
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        OSError):
+                    break
+                op = msg.get("op")
+                if op == "bye":
+                    break
+                if op == "ping":
+                    async with write_lock:
+                        write_frame(writer,
+                                    {"op": "pong", "id": msg.get("id")},
+                                    secret=self._secret)
+                        await writer.drain()
+                    continue
+                if op == "cancel":
+                    scope = inflight.get(msg.get("id"))
+                    if scope is not None:
+                        scope.cancel()
+                    continue
+                if op != "infer":
+                    raise ProtocolError("unknown op %r" % op)
+                scope = inflight[msg.get("id")] = _InflightScope()
+                task = asyncio.ensure_future(one(msg, payload, scope))
+                tasks.add(task)
+                task.add_done_callback(tasks.discard)
+        finally:
+            for scope in list(inflight.values()):
+                scope.cancel()
+            for task in list(tasks):
+                task.cancel()
+
+    def _fire_host_chaos(self):
+        """The fleet-host fault surface (docs/health.md table), fired
+        per served frame: ``serve.host.stall`` parks this request
+        ``param`` seconds (the induced straggler request hedging must
+        beat), ``serve.host.preempt`` kills the host mid-stream
+        (``kill`` = SIGKILL self for subprocess soaks; anything else
+        severs the connection — the in-process stand-in).  Both points
+        also fire HOST-SCOPED (``point:host_id``, the network_common
+        peer-scope convention) so an in-process multi-host harness can
+        arm ONE straggler while its siblings stay healthy.  Returns
+        the stall seconds (awaited by the caller so a pipelined stall
+        parks only its own task, never the link)."""
+        stall = 0.0
+        if chaos.plan is None:
+            return stall
+        host_id = self.host_meta.get("host_id") \
+            if self.host_meta else None
+
+        def fire(point):
+            fault = chaos.plan.fire(point)
+            if fault is None and host_id is not None:
+                fault = chaos.plan.fire("%s:%s" % (point, host_id))
+            return fault
+
+        fault = fire("serve.host.stall")
+        if fault is not None:
+            stall = fault.param if fault.param else 0.05
+        fault = fire("serve.host.preempt")
+        if fault is not None:
+            if fault.action == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            raise ConnectionError("chaos: serve.host.preempt")
+        return stall
+
     async def _serve_one(self, msg, payload, chan_in, chan_out,
-                         writer):
+                         writer, write_lock=None, scope=None):
         start = time.perf_counter()
         rid = msg.get("id")
         self._m_requests.inc()
+
+        async def reply_frame(frame, raw=b""):
+            if write_lock is None:
+                write_frame(writer, frame, payload=raw,
+                            secret=self._secret)
+                await writer.drain()
+            else:
+                async with write_lock:
+                    write_frame(writer, frame, payload=raw,
+                                secret=self._secret)
+                    await writer.drain()
+
         try:
+            stall = self._fire_host_chaos()
+            if stall:
+                await asyncio.sleep(stall)
             if "shm" in msg:
                 if chan_in is None:
                     raise ProtocolError(
@@ -404,7 +608,9 @@ class BinaryTransportServer(Logger):
             arr = decode_tensor(msg, raw)
             loop = asyncio.get_event_loop()
             result = await loop.run_in_executor(
-                self._executor, self._infer, arr)
+                self._executor, self._infer, arr, scope)
+            if scope is not None and scope.cancelled:
+                return  # hedged loser: the peer forgot this copy
             meta, raw_out = encode_tensor(
                 result, codec=str(msg.get("codec", "none")))
             reply = {"op": "result", "id": rid}
@@ -421,32 +627,27 @@ class BinaryTransportServer(Logger):
                     raw_out = b""
             if raw_out:
                 self._m_sock_tx.inc(len(raw_out))
-            write_frame(writer, reply, payload=raw_out,
-                        secret=self._secret)
-            await writer.drain()
+            await reply_frame(reply, raw_out)
+        except _CancelledByPeer:
+            return  # no reply: cancelled requests answer with nothing
         except ServeOverload as exc:
             self._m_errors.inc()
-            write_frame(writer, {
+            await reply_frame({
                 "op": "error", "id": rid, "error": str(exc),
                 "transient": True,
                 "retry_after": round(exc.retry_after, 4),
-            }, secret=self._secret)
-            await writer.drain()
+            })
         except (ProtocolError, ValueError, TypeError) as exc:
             self._m_errors.inc()
-            write_frame(writer,
-                        {"op": "error", "id": rid, "error": str(exc)},
-                        secret=self._secret)
-            await writer.drain()
+            await reply_frame(
+                {"op": "error", "id": rid, "error": str(exc)})
         except (ConnectionError, OSError):
             raise
         except Exception as exc:
             self._m_errors.inc()
             self.exception("transport request failed")
-            write_frame(writer,
-                        {"op": "error", "id": rid, "error": str(exc)},
-                        secret=self._secret)
-            await writer.drain()
+            await reply_frame(
+                {"op": "error", "id": rid, "error": str(exc)})
         finally:
             elapsed = time.perf_counter() - start
             self._m_latency.observe(elapsed)
@@ -454,15 +655,18 @@ class BinaryTransportServer(Logger):
                 _tracer.complete("transport.request", start, elapsed,
                                  cat="serve")
 
-    def _infer(self, arr):
+    def _infer(self, arr, scope=None):
         """Blocking dispatch (executor thread): single samples ride
         :meth:`submit`, contiguous blocks ride :meth:`submit_block` —
         the zero-intermediate-copy path — chunked at the ladder top.
-        Always returns a 2-D block."""
+        Always returns a 2-D block.  ``scope`` (pipelined mode)
+        registers every batcher request so a wire cancel can retire
+        them mid-flight instead of computing for a departed peer."""
         engine = self.pool.engine
         shape = engine.sample_shape
+        track = scope.add if scope is not None else (lambda req: req)
         if arr.shape == shape:
-            requests = [self.pool.submit(arr)]
+            requests = [track(self.pool.submit(arr))]
             single = True
         elif arr.shape[1:] == shape and arr.ndim == len(shape) + 1 \
                 and arr.shape[0] >= 1:
@@ -470,8 +674,8 @@ class BinaryTransportServer(Logger):
             requests = []
             try:
                 for i in range(0, arr.shape[0], engine.max_batch):
-                    requests.append(self.pool.submit_block(
-                        arr[i:i + engine.max_batch]))
+                    requests.append(track(self.pool.submit_block(
+                        arr[i:i + engine.max_batch])))
             except Exception:
                 for req in requests:
                     req.cancelled = True
