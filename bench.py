@@ -89,6 +89,10 @@ SECTION_EST = {
     # + two warm legs of interleaved slopes; on CPU a tiny compile-
     # fitness GA + cache-hit receipt
     "tune_ab": 60.0,
+    # model-ranked vs compile-everything GA on the same search space:
+    # three forced GA legs (baseline, side spec, model-guided) of
+    # compile-only fitness on CPU; TPU swaps in measured fitness
+    "tune_model_ab": 60.0,
     # f32-vs-int8 quantized engine A/B: one PTQ pass + two small AOT
     # ladders; CPU = parity + receipts, TPU adds interleaved slopes
     "quant_ab": 50.0,
@@ -173,6 +177,9 @@ def _compact_record(value, small, extras):
     tune = extras.get("tune_ab") or {}
     if "speedup" in tune:
         rec["tune_ab_speedup"] = tune["speedup"]
+    tmodel = extras.get("tune_model_ab") or {}
+    if "evals_saved" in tmodel:
+        rec["tune_model_evals_saved"] = tmodel["evals_saved"]
     quant = extras.get("quant_ab") or {}
     if "speedup" in quant:
         rec["quant_ab_speedup"] = quant["speedup"]
@@ -1250,6 +1257,121 @@ def bench_tune_ab(small):
     return result
 
 
+def bench_tune_model_ab(small):
+    """Model-ranked vs compile-everything GA on the SAME search space
+    (docs/kernels.md "Autotuning", cost-model mode).
+
+    One matmul spec is force-tuned twice: leg A with every candidate
+    compiled+measured (the baseline discipline), leg B with
+    ``fitness="model"`` — the learned cost model ranks each
+    generation and only the top decile (floor 2) compiles.  Leg A's
+    measurements (plus a second spec's, so leave-one-spec-out
+    validation has held-out groups) ARE the model's training data:
+    the bench is the fleet story in miniature — one search's paid
+    compiles make the next search cheap.
+
+    Receipts: evals paid per leg (the ``tune.evals`` counter delta,
+    i.e. compiles actually paid), wall seconds per leg, the model's
+    self-reported validation error, and best-found-slope parity —
+    1.0 when both legs crown the same schedule, else a head-to-head
+    interleaved measurement of the two winners (never the two legs'
+    own fitness numbers, which ran at different cache temperatures).
+    The trust gate is opened wide here (``model_trust=2.0``) so the
+    receipt always shows the model-mode eval economics; the
+    validation error rides the receipt, and production keeps the
+    default gate."""
+    import jax
+
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.tune import cache as tune_cache
+    from veles_tpu.tune.autotune import ScheduleTuner
+    from veles_tpu.tune.measure import interleaved_slopes, rank
+    from veles_tpu.tune.spec import family_for, matmul_spec
+
+    on_tpu = jax.default_backend() == "tpu"
+    base = "measure" if on_tpu else "compile"
+    size = 2048 if on_tpu and not small else 1024
+    generations = 3 if small else 4
+    # population sized so the compile-everything leg pays well over
+    # 4x the model leg's floor (2 compiles/generation): the >=4x
+    # evals-saved receipt must hold even when the GA converges early
+    population = 20 if small else 24
+    repeats, rounds = (8, 3) if on_tpu else (2, 2)
+    spec = matmul_spec(size, size, size, "float32", 0)
+    side = matmul_spec(size // 2, size, size, "float32", 0)
+
+    result = {"device_kind": jax.devices()[0].device_kind,
+              "base_fitness": base, "size": size,
+              "generations": generations, "population": population,
+              "cache_path": tune_cache.cache_for().path}
+
+    start = time.monotonic()
+    row_a = ScheduleTuner(
+        spec, generations=generations, population=population,
+        fitness=base, repeats=repeats, rounds=rounds,
+        rng=RandomGenerator("bench-tune-model", seed=21)) \
+        .tune(force=True)
+    wall_a = time.monotonic() - start
+    # the side spec's triples give the model a second held-out group
+    ScheduleTuner(
+        side, generations=2, population=max(6, population // 2),
+        fitness=base, repeats=repeats, rounds=rounds,
+        rng=RandomGenerator("bench-tune-model", seed=22)) \
+        .tune(force=True)
+
+    start = time.monotonic()
+    row_b = ScheduleTuner(
+        spec, generations=generations, population=population,
+        fitness="model", model_base=base, model_min_triples=8,
+        model_trust=2.0, repeats=repeats, rounds=rounds,
+        rng=RandomGenerator("bench-tune-model", seed=21)) \
+        .tune(force=True)
+    wall_b = time.monotonic() - start
+
+    model_info = row_b.get("model") or {}
+    result.update(
+        evals_measured=row_a["evals"], evals_model=row_b["evals"],
+        genomes_measured=row_a["genomes"],
+        genomes_model=row_b["genomes"],
+        evals_saved=row_a["evals"] - row_b["evals"],
+        eval_ratio=round(row_b["evals"] / max(row_a["evals"], 1), 4),
+        wall_measured_s=round(wall_a, 3),
+        wall_model_s=round(wall_b, 3),
+        winner_measured=row_a.get("schedule"),
+        winner_model=row_b.get("schedule"),
+        model={k: model_info.get(k) for k in
+               ("triples", "error", "spearman", "groups", "trusted",
+                "fallback", "predicted")})
+
+    sched_a, sched_b = row_a.get("schedule"), row_b.get("schedule")
+    if sched_a is None or sched_b is None:
+        result["note"] = ("a leg produced no rankable winner; parity "
+                          "skipped")
+    elif sched_a == sched_b:
+        result["parity"] = 1.0
+        result["parity_method"] = "identical-winner"
+    else:
+        # head-to-head under ONE interleaved discipline: same chip
+        # temperature for both winners, unlike the legs' own fitness
+        family = family_for("matmul")
+        runners = {}
+        for leg, sched in (("measured", sched_a), ("model", sched_b)):
+            warm, run = family.build_runner(spec, sched)
+            warm()
+            runners[leg] = run
+        meds = rank(interleaved_slopes(runners, 1, repeats + 1,
+                                       rounds=max(rounds, 3)))
+        if meds.get("measured") and meds.get("model"):
+            result["parity"] = round(
+                meds["model"] / meds["measured"], 4)
+            result["parity_method"] = "head-to-head"
+        else:
+            result["note"] = ("jitter-rejected head-to-head leg; no "
+                              "honest parity this round")
+    result["tune_counters"] = tune_cache.tune_counters()
+    return result
+
+
 def bench_quant_ab(small):
     """f32 vs int8 quantized engine A/B (docs/serving.md "Quantized
     ladder").
@@ -1809,6 +1931,14 @@ def main():
     tune_res = section("tune_ab", lambda: bench_tune_ab(small))
     if tune_res is not None:
         extras["tune_ab"] = tune_res
+
+    # cost-model autotuner A/B (docs/kernels.md "Autotuning"): model-
+    # ranked top-decile compiles vs the compile-everything GA on the
+    # SAME search space — evals paid, wall clock, winner parity
+    tune_model_res = section("tune_model_ab",
+                             lambda: bench_tune_model_ab(small))
+    if tune_model_res is not None:
+        extras["tune_model_ab"] = tune_model_res
 
     # quantized-inference A/B (docs/serving.md "Quantized ladder"):
     # f32 vs int8 engine in one process; CPU = parity + bit-exactness

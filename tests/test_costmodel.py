@@ -1,0 +1,441 @@
+"""Learned cost model + measurement sidecar + fleet schedule bank
+(veles_tpu/tune/costmodel.py, tune/cache.py; docs/kernels.md
+"Autotuning").
+
+Everything here is pure numpy/JSON — NO jax compile anywhere — so the
+``costmodel`` marker doubles as the fast CI tier:
+``python -m pytest -m costmodel``.
+
+Every test sees a PRIVATE empty schedule cache + sidecar (the conftest
+autouse fixture redirects ``VELES_SCHEDULE_CACHE`` to tmp; the
+measurement log lives beside ``schedules.json``, so the same redirect
+isolates it)."""
+
+import json
+import os
+
+import numpy
+import pytest
+
+pytestmark = pytest.mark.costmodel
+
+
+def _matmul_rows(shapes, schedules, slope_fn, mode="measure"):
+    """Synthetic measurement-log rows keyed EXACTLY like the tuner
+    writes them (schedule_key payload + digest), so the current-version
+    staleness filters accept them."""
+    from veles_tpu.ops.matmul import MATMUL_KERNEL_VERSION
+    from veles_tpu.tune.cache import device_kind, schedule_key
+    rows = []
+    for shape in shapes:
+        digest, payload = schedule_key(
+            "matmul", shape, "float32", 0, device_kind(),
+            {"kernel_version": MATMUL_KERNEL_VERSION})
+        for schedule in schedules:
+            rows.append({"digest": digest, "payload": payload,
+                         "schedule": dict(schedule),
+                         "slope": slope_fn(shape, schedule),
+                         "mode": mode})
+    return rows
+
+
+#: matmul schedules spanning the gene space (MXU-legal: bm%8, bn/bk%128)
+_SCHEDULES = [{"blocks": [bm, bn, bk]}
+              for bm in (8, 64, 256)
+              for bn in (128, 512)
+              for bk in (128, 256)]
+
+_SHAPES = [(512, 512, 512), (1024, 1024, 1024), (512, 1024, 2048),
+           (2048, 512, 1024)]
+
+
+def _grid_slope(shape, schedule):
+    """A learnable synthetic cost: grid steps times a per-step cost
+    that rewards big bm tiles (monotone in the features)."""
+    m, k, n = shape
+    bm, bn, bk = schedule["blocks"]
+    grid = (-(-m // bm)) * (-(-n // bn)) * (-(-k // bk))
+    return grid * (1.0 + 64.0 / bm) * 1e-6
+
+
+# -- featurize / spearman -----------------------------------------------------
+
+
+def test_featurize_fixed_length_and_deterministic():
+    from veles_tpu.tune.costmodel import featurize
+    from veles_tpu.tune.spec import matmul_spec
+    spec = matmul_spec(512, 512, 512, "float32", 0)
+    a = featurize(spec, {"blocks": [64, 128, 128]})
+    b = featurize(spec, {"blocks": [64, 128, 128]})
+    c = featurize(spec, {"blocks": [256, 512, 128]})
+    # 3 shape dims + 3 tile dims + 5 derived features
+    assert a.shape == (11,) and c.shape == (11,)
+    numpy.testing.assert_array_equal(a, b)
+    assert not numpy.array_equal(a, c)
+
+
+def test_featurize_attention_family():
+    """The attention family featurizes through the same path (its
+    footprint/grid formulas, not matmul's)."""
+    from veles_tpu.tune.costmodel import featurize
+    from veles_tpu.tune.spec import attention_spec
+    spec = attention_spec(4, 256, 64, "float32", 0)
+    a = featurize(spec, {"blocks": [128, 128]})
+    b = featurize(spec, {"blocks": [256, 256]})
+    # 4 shape dims + 2 tile dims + 5 derived
+    assert a.shape == (11,) and not numpy.array_equal(a, b)
+
+
+def test_spearman_sanity():
+    from veles_tpu.tune.costmodel import spearman
+    assert spearman([1, 2, 3, 4], [10, 20, 30, 40]) == pytest.approx(1.0)
+    assert spearman([1, 2, 3, 4], [40, 30, 20, 10]) == pytest.approx(-1.0)
+    # monotone transform changes nothing (rank correlation)
+    assert spearman([1, 2, 3, 4], [1, 100, 10000, 1e6]) \
+        == pytest.approx(1.0)
+    # no rank variance on either side reads 0, not NaN
+    assert spearman([1, 1, 1], [1, 2, 3]) == 0.0
+
+
+# -- fit / predict ------------------------------------------------------------
+
+
+def test_fit_is_deterministic():
+    """Same triples in -> same stumps, same base, same ranking out —
+    the fleet-wide reproducibility contract (no RNG anywhere)."""
+    from veles_tpu.tune.costmodel import CostModel
+    from veles_tpu.tune.spec import matmul_spec
+    rows = _matmul_rows(_SHAPES, _SCHEDULES, _grid_slope)
+    m1 = CostModel("matmul").fit(rows)
+    m2 = CostModel("matmul").fit(list(rows))
+    assert m1.base == m2.base
+    assert m1.stumps == m2.stumps
+    spec = matmul_spec(768, 768, 768, "float32", 0)
+    assert m1.predict_rank(spec, _SCHEDULES) \
+        == m2.predict_rank(spec, _SCHEDULES)
+
+
+def test_model_recovers_synthetic_ordering():
+    """Trained on a learnable synthetic cost, the held-out-shape
+    ranking must correlate strongly with the true ordering."""
+    from veles_tpu.tune.costmodel import CostModel, spearman
+    from veles_tpu.tune.spec import matmul_spec
+    rows = _matmul_rows(_SHAPES, _SCHEDULES, _grid_slope)
+    model = CostModel("matmul").fit(rows)
+    spec = matmul_spec(1536, 1536, 1536, "float32", 0)
+    pred = model.predict_seconds(spec, _SCHEDULES)
+    actual = [_grid_slope((1536, 1536, 1536), s) for s in _SCHEDULES]
+    assert spearman(pred, actual) > 0.8
+    val = model.validate()
+    assert val["groups"] >= 3
+    assert val["error"] is not None and val["error"] < 0.5
+
+
+def test_predict_rank_ties_break_on_lower_index():
+    """A constant model (no variance in y) must produce the identity
+    ranking, not an arbitrary one."""
+    from veles_tpu.tune.costmodel import CostModel
+    from veles_tpu.tune.spec import matmul_spec
+    rows = _matmul_rows(_SHAPES[:2], _SCHEDULES[:4],
+                        lambda shape, s: 1e-3)
+    model = CostModel("matmul").fit(rows)
+    spec = matmul_spec(512, 512, 512, "float32", 0)
+    assert model.predict_rank(spec, _SCHEDULES[:4]) == [0, 1, 2, 3]
+
+
+def test_fit_empty_rows_raises():
+    from veles_tpu.tune.costmodel import CostModel
+    with pytest.raises(ValueError):
+        CostModel("matmul").fit([])
+
+
+# -- trust gates --------------------------------------------------------------
+
+
+def test_train_for_thin_data_fallback():
+    """Below min_triples the model is not even trained."""
+    from veles_tpu.tune import cache as tune_cache
+    from veles_tpu.tune.costmodel import train_for
+    log = tune_cache.measurement_log()
+    for row in _matmul_rows(_SHAPES[:1], _SCHEDULES[:3], _grid_slope):
+        log.append(row["digest"], row["payload"], row["schedule"],
+                   row["slope"], mode=row["mode"])
+    model, info = train_for("matmul", mode="measure")
+    assert model is None
+    assert info["fallback"] == "thin-data"
+    assert info["triples"] == 3 and not info["trusted"]
+
+
+def test_train_for_untrusted_on_noise():
+    """Feature-independent slopes: held-out Spearman ~0, validation
+    error above the gate -> (None, 'untrusted')."""
+    from veles_tpu.tune import cache as tune_cache
+    from veles_tpu.tune.costmodel import train_for
+    rng = numpy.random.RandomState(7)
+    noise = {}
+
+    def random_slope(shape, schedule):
+        key = (tuple(shape), json.dumps(schedule, sort_keys=True))
+        if key not in noise:
+            noise[key] = float(rng.uniform(1e-6, 1e-3))
+        return noise[key]
+
+    log = tune_cache.measurement_log()
+    for row in _matmul_rows(_SHAPES, _SCHEDULES, random_slope):
+        log.append(row["digest"], row["payload"], row["schedule"],
+                   row["slope"], mode=row["mode"])
+    model, info = train_for("matmul", mode="measure")
+    assert model is None
+    assert info["fallback"] == "untrusted"
+    assert info["error"] is not None and info["error"] > 0.5
+
+
+def test_train_for_unvalidatable_reads_untrusted():
+    """Plenty of rows but no spec group with 3+ distinct schedules:
+    validation has nothing to score, and an UNVALIDATABLE model must
+    read as untrusted, not as perfect."""
+    from veles_tpu.tune import cache as tune_cache
+    from veles_tpu.tune.costmodel import train_for
+    shapes = [(8 * i, 128 * i, 128 * i) for i in range(1, 20)]
+    log = tune_cache.measurement_log()
+    for row in _matmul_rows(shapes, _SCHEDULES[:2], _grid_slope):
+        log.append(row["digest"], row["payload"], row["schedule"],
+                   row["slope"], mode=row["mode"])
+    model, info = train_for("matmul", mode="measure")
+    assert model is None
+    assert info["fallback"] == "untrusted"
+    assert info["error"] is None and info["groups"] == 0
+
+
+def test_train_for_trusts_learnable_data():
+    from veles_tpu.tune import cache as tune_cache
+    from veles_tpu.tune.costmodel import train_for
+    log = tune_cache.measurement_log()
+    for row in _matmul_rows(_SHAPES, _SCHEDULES, _grid_slope):
+        log.append(row["digest"], row["payload"], row["schedule"],
+                   row["slope"], mode=row["mode"])
+    model, info = train_for("matmul", mode="measure")
+    assert model is not None
+    assert info["trusted"] and info["fallback"] is None
+    assert info["error"] < 0.5 and info["groups"] >= 3
+
+
+# -- the measurement sidecar --------------------------------------------------
+
+
+def test_measurement_log_roundtrip_and_filters(tmp_path):
+    from veles_tpu.tune.cache import MeasurementLog
+    log = MeasurementLog(str(tmp_path / "m.jsonl"))
+    rows = _matmul_rows(_SHAPES[:2], _SCHEDULES[:2], _grid_slope)
+    for row in rows:
+        log.append(row["digest"], row["payload"], row["schedule"],
+                   row["slope"], mode=row["mode"])
+    log.append(rows[0]["digest"], rows[0]["payload"],
+               rows[0]["schedule"], 2e-3, mode="compile")
+    got = log.rows(op="matmul", mode="measure")
+    assert len(got) == 4
+    assert all(r["mode"] == "measure" for r in got)
+    assert log.rows(mode="compile")[0]["slope"] == 2e-3
+    counts = log.count_by_family()
+    assert counts == {"matmul": 5}
+
+
+def test_measurement_log_strands_stale_rows(tmp_path):
+    """The staleness contract: rows from another jax version, another
+    device kind, an old kernel version, or with a digest that no
+    longer recomputes are filtered from training data — exactly like
+    stale cache entries MISS."""
+    from veles_tpu.tune.cache import MeasurementLog
+    log = MeasurementLog(str(tmp_path / "m.jsonl"))
+    good = _matmul_rows(_SHAPES[:1], _SCHEDULES[:1], _grid_slope)[0]
+    log.append(good["digest"], good["payload"], good["schedule"],
+               good["slope"])
+    # (a) foreign jax version; (b) foreign device kind; (c) kernel
+    # version bump — each with its digest left UNFIXED, and (d) a
+    # tampered payload under the original digest
+    for mutate in ({"jax": "0.0.0"}, {"device_kind": "TPU v9"},
+                   {"kernel_version": -1}, {"shape": [8, 128, 128]}):
+        payload = dict(good["payload"])
+        payload.update(mutate)
+        log.append(good["digest"], payload, good["schedule"], 1e-3)
+    assert len(log.rows()) == 1
+    assert len(log.rows(current_only=False)) == 5
+
+
+def test_measurement_log_recomputed_digest_gate(tmp_path):
+    """A consistent-looking row whose digest does not recompute from
+    its payload (hand-edited/corrupted sidecar) is stranded."""
+    from veles_tpu.tune.cache import MeasurementLog
+    log = MeasurementLog(str(tmp_path / "m.jsonl"))
+    good = _matmul_rows(_SHAPES[:1], _SCHEDULES[:1], _grid_slope)[0]
+    log.append("deadbeef" * 8, good["payload"], good["schedule"], 1e-3)
+    assert log.rows() == []
+    assert len(log.rows(current_only=False)) == 1
+
+
+def test_measurement_log_skips_garbage_lines(tmp_path, caplog):
+    from veles_tpu.tune.cache import MeasurementLog
+    path = tmp_path / "m.jsonl"
+    good = _matmul_rows(_SHAPES[:1], _SCHEDULES[:1], _grid_slope)[0]
+    log = MeasurementLog(str(path))
+    log.append(good["digest"], good["payload"], good["schedule"], 1e-3)
+    with open(str(path), "a") as fout:
+        fout.write("not json\n")
+        fout.write(json.dumps({"digest": "x"}) + "\n")
+    with caplog.at_level("WARNING"):
+        assert len(log.rows()) == 1
+    assert any("unparseable" in r.message for r in caplog.records)
+
+
+def test_measurement_log_compaction_bound(tmp_path, monkeypatch):
+    """An append past the size cap compacts to the newest KEEP rows —
+    the sidecar is bounded, not append-forever."""
+    from veles_tpu.tune import cache as tune_cache
+    monkeypatch.setattr(tune_cache, "_MEASUREMENTS_MAX_BYTES", 2048)
+    monkeypatch.setattr(tune_cache, "_MEASUREMENTS_KEEP", 5)
+    log = tune_cache.MeasurementLog(str(tmp_path / "m.jsonl"))
+    good = _matmul_rows(_SHAPES[:1], _SCHEDULES[:1], _grid_slope)[0]
+    for i in range(40):
+        log.append(good["digest"], good["payload"], good["schedule"],
+                   1e-6 * (i + 1))
+    rows = log.rows()
+    # steady state oscillates between KEEP and the next compaction
+    # trigger — bounded well below the 40 appended rows either way
+    assert len(rows) <= 8
+    # newest rows survive (the tail of the append order)
+    assert rows[-1]["slope"] == pytest.approx(1e-6 * 40)
+    assert os.path.getsize(str(tmp_path / "m.jsonl")) <= 4096
+
+
+def test_record_measurement_never_raises(monkeypatch, caplog):
+    from veles_tpu.tune import cache as tune_cache
+
+    def boom(*args, **kwargs):
+        raise OSError("read-only cache dir")
+
+    monkeypatch.setattr(tune_cache.MeasurementLog, "append", boom)
+    with caplog.at_level("WARNING"):
+        tune_cache.record_measurement("d", {"op": "matmul"},
+                                      {"blocks": [8, 128, 128]}, 1e-3)
+    assert any("triple dropped" in r.message for r in caplog.records)
+
+
+# -- the fleet schedule bank --------------------------------------------------
+
+
+def _planted_cache(tmp_path, name, fitness=-1e-3):
+    """A cache with one REAL keyed matmul entry (digest recomputes)."""
+    from veles_tpu.ops.matmul import MATMUL_KERNEL_VERSION
+    from veles_tpu.tune.cache import (ScheduleCache, device_kind,
+                                      schedule_key)
+    digest, payload = schedule_key(
+        "matmul", (512, 512, 512), "float32", 0, device_kind(),
+        {"kernel_version": MATMUL_KERNEL_VERSION})
+    cache = ScheduleCache(str(tmp_path / name))
+    cache.put(digest, payload, {"blocks": [64, 512, 512]},
+              fitness=fitness, evals=4, source="ga")
+    return cache, digest
+
+
+def test_bank_export_merge_roundtrip(tmp_path):
+    from veles_tpu.tune.cache import ScheduleCache, load_bank
+    ours, digest = _planted_cache(tmp_path, "a.json")
+    bank_path = str(tmp_path / "bank.json")
+    assert ours.export_bank(bank_path) == 1
+    bank = load_bank(bank_path)
+    assert bank["kind"] == "schedule_bank"
+    assert bank["entries"][digest]["host"]  # provenance stamped
+    theirs = ScheduleCache(str(tmp_path / "b.json"))
+    counts = theirs.merge_bank(bank_path)
+    assert counts == {"adopted": 1, "kept": 0, "stale": 0,
+                      "invalid": 0, "total": 1}
+    got = theirs.get(digest)
+    assert got["schedule"] == {"blocks": [64, 512, 512]}
+    assert got["fitness"] == -1e-3
+    # idempotent: a re-merge of the same bank adopts nothing
+    assert theirs.merge_bank(bank_path)["adopted"] == 0
+
+
+def test_bank_merge_conflict_resolution(tmp_path):
+    """Disk wins except on strictly-better measured fitness; an
+    unmeasured challenger never displaces; an unmeasured incumbent
+    yields to any measured challenger."""
+    from veles_tpu.tune.cache import ScheduleCache
+    ours, digest = _planted_cache(tmp_path, "a.json", fitness=-2e-3)
+
+    def bank_with(fitness, blocks):
+        donor, _ = _planted_cache(tmp_path, "donor.json",
+                                  fitness=fitness)
+        donor.put(digest, {k: v for k, v in
+                           donor.entries()[digest].items()
+                           if k not in ("schedule", "source", "fitness",
+                                        "evals", "host")},
+                  {"blocks": blocks}, fitness=fitness, source="ga")
+        path = str(tmp_path / "bank.json")
+        donor.export_bank(path)
+        os.remove(str(tmp_path / "donor.json"))
+        return path
+
+    # worse fitness: local entry kept
+    counts = ours.merge_bank(bank_with(-5e-3, [8, 128, 128]))
+    assert counts["kept"] == 1 and counts["adopted"] == 0
+    assert ours.get(digest)["schedule"] == {"blocks": [64, 512, 512]}
+    # strictly better fitness: adopted
+    counts = ours.merge_bank(bank_with(-1e-3, [256, 512, 512]))
+    assert counts["adopted"] == 1
+    assert ours.get(digest)["schedule"] == {"blocks": [256, 512, 512]}
+    # unmeasured challenger (fitness None) never displaces
+    counts = ours.merge_bank(bank_with(None, [8, 128, 128]))
+    assert counts["kept"] == 1
+    assert ours.get(digest)["schedule"] == {"blocks": [256, 512, 512]}
+
+
+def test_bank_merge_rejects_stale_digest_and_invalid(tmp_path):
+    """A bank entry whose digest does not recompute from its key
+    coordinates (another jax/kernel version, a tampered entry) is
+    rejected as stale; a structurally-invalid schedule as invalid."""
+    from veles_tpu.tune.cache import (SCHEDULE_CACHE_SCHEMA,
+                                      ScheduleCache)
+    ours, digest = _planted_cache(tmp_path, "a.json")
+    entry = dict(ours.entries()[digest])
+    bank = {"schema": SCHEDULE_CACHE_SCHEMA, "kind": "schedule_bank",
+            "host": "donor", "jax": "x",
+            "entries": {
+                # digest that does not recompute
+                "deadbeef" * 8: dict(entry),
+                # good digest, MXU-illegal schedule.  NOT the blocks
+                # test_tune's malformed-entry test plants: the consult
+                # warning dedupes on (op, schedule) PROCESS-wide, so
+                # sharing its value here would swallow that test's
+                # warning when both run in one session
+                digest: dict(entry, schedule={"blocks": [9, 130, 2]}),
+            }}
+    fresh = ScheduleCache(str(tmp_path / "b.json"))
+    counts = fresh.merge_bank(bank)
+    assert counts["stale"] == 1 and counts["invalid"] == 1
+    assert counts["adopted"] == 0 and len(fresh) == 0
+
+
+def test_load_bank_rejects_non_banks(tmp_path):
+    from veles_tpu.tune.cache import load_bank
+    path = str(tmp_path / "junk.json")
+    with open(path, "w") as fout:
+        json.dump({"schema": 1, "entries": {}}, fout)
+    with pytest.raises(ValueError):
+        load_bank(path)
+
+
+def test_bank_counters_tick(tmp_path):
+    from veles_tpu.observe.metrics import registry
+    from veles_tpu.tune.cache import ScheduleCache, tune_counters
+    ours, _ = _planted_cache(tmp_path, "a.json")
+    bank_path = str(tmp_path / "bank.json")
+    ours.export_bank(bank_path)
+    before = (tune_counters().get("bank_merged", 0),
+              tune_counters().get("bank_entries", 0))
+    fresh = ScheduleCache(str(tmp_path / "b.json"))
+    fresh.merge_bank(bank_path)
+    after = tune_counters()
+    assert after.get("bank_merged", 0) == before[0] + 1
+    assert after.get("bank_entries", 0) == before[1] + 1
+    assert registry.peek("tune.bank_merged") is not None

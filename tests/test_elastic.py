@@ -384,7 +384,13 @@ class _PowerClient(Client):
         return self._fixed_power
 
 
-def _wait_for(predicate, timeout=10.0, what="condition"):
+def _wait_for(predicate, timeout=30.0, what="condition"):
+    # every caller waits on a DETERMINISTIC handoff (a push the server
+    # already scheduled, a flag another thread must set), so a wide
+    # default costs nothing when healthy; the old 10 s bound was the
+    # PR 11/12 reshard-race flake — reshard pushes ride an executor
+    # hop + the event loop, and full-suite load on a small host
+    # stretched that past 10 s while solo runs land in ~0.1 s
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if predicate():
@@ -451,7 +457,12 @@ def test_drop_requeues_reshards_and_replays(cpu_device):
     server_ref, _ = _start_server(master_ref)
     client_ref = Client("127.0.0.1:%d" % server_ref.port, slave_ref)
     client_ref.run()
-    assert server_ref._done.wait(10)
+    # wide deterministic windows (the soak smoke's discipline): both
+    # runs end by event; the fault-free 10 s bound was the OTHER half
+    # of the PR 11/12 reshard-race flake — the die/rejoin backoff plus
+    # the rejoin's reshard push stretch under full-suite load while
+    # solo runs finish in ~2 s
+    assert server_ref._done.wait(60)
     ref_weights = _weights(master_ref)
 
     master = _build("master", "elastic_drop_m", cpu_device)
@@ -463,7 +474,7 @@ def test_drop_requeues_reshards_and_replays(cpu_device):
         client.run()
     finally:
         chaos.uninstall()
-    assert server._done.wait(10)
+    assert server._done.wait(90)
     assert plan.fired("client.job") == 1
     assert client.sessions_established == 2
     # join, leave, rejoin: three membership changes, three reshards
@@ -493,7 +504,9 @@ def test_drop_during_apply_defers_requeue_never_doubles():
     thread = client.start_background()
     try:
         # the update for j1 arrives and its apply BLOCKS mid-flight
-        assert master.apply_started.wait(10)
+        # (deterministic handoff; wide window, same discipline as the
+        # apply gate above)
+        assert master.apply_started.wait(60)
         conn = list(server.slaves.values())[0]
         # the slave is dropped while the apply is still on the executor
         server._loop.call_soon_threadsafe(server._drop, conn, "test")

@@ -1,8 +1,10 @@
 """Schedule autotuner (veles_tpu/tune/, docs/kernels.md "Autotuning"):
 cache key semantics, corrupt/stale fallback, planted-entry consults in
-all three kernel families, tuned-vs-static bit-equality through the
+all four kernel families, tuned-vs-static bit-equality through the
 Pallas interpreter, the GA fitness memo, quantization/feasibility
-gates, the fused-step walk and the CLI round trip.
+gates, the learned-cost-model fitness mode end to end, the fleet
+schedule bank (export/merge/publish/watcher pickup), the fused-step
+walk and the CLI round trip.
 
 Every test sees a PRIVATE empty schedule cache (the conftest autouse
 fixture redirects ``VELES_SCHEDULE_CACHE`` to tmp) — tests that want
@@ -605,3 +607,309 @@ def test_cli_tune_receipt_and_second_run_hits(tmp_path, capsys):
     assert second["counts"] == {"cache": len(second["specs"])}
     assert second["evals"] == 0
     capsys.readouterr()  # swallow the CLI's progress prints
+
+
+# -- the attention family -----------------------------------------------------
+
+
+def test_attention_family_space_quantize_feasibility():
+    """The attention gene box tracks the padded grid (bq rides the
+    sublane quantum, bk the lane quantum), quantization lands on legal
+    multiples inside the caps, and the feasibility gate uses the
+    kernel's own VMEM footprint."""
+    from veles_tpu.tune.spec import (FAMILIES, TUNE_VMEM_BUDGET_BYTES,
+                                     attention_spec)
+    fam = FAMILIES["attention"]
+    spec = attention_spec(2, 192, 32, "float32", 0)
+    # shape = (B, ceil8(T), ceil128(T), ceil128(dh)) — grid coords
+    assert spec["shape"] == [2, 192, 256, 128]
+    space = fam.space(spec)
+    assert (space["bq"].min, space["bq"].max) == (8, 192)
+    assert (space["bk"].min, space["bk"].max) == (128, 256)
+    sched = fam.quantize(spec, {"bq": 61.7, "bk": 200.0})
+    assert sched["blocks"][0] % 8 == 0 and sched["blocks"][1] % 128 == 0
+    assert sched["blocks"][0] <= 192 and sched["blocks"][1] <= 256
+    assert fam.feasible(spec, sched)
+    assert fam.footprint(spec, {"blocks": [8, 128]}) <= \
+        TUNE_VMEM_BUDGET_BYTES
+    # validate mirrors the consult: MXU-illegal or malformed -> None
+    assert fam.validate({"blocks": [64, 256]}) == {"blocks": [64, 256]}
+    assert fam.validate({"blocks": [60, 256]}) is None
+    assert fam.validate({"blocks": [64, 200]}) is None
+    assert fam.validate({"blocks": [64]}) is None
+    assert fam.genes_of({"blocks": [64, 256]}) == {"bq": 64, "bk": 256}
+
+
+def test_planted_entry_serves_attention_bit_equal(monkeypatch):
+    """flash_attention() demonstrably loads tuned (bq, bk) from a
+    planted cache entry: the consult run is BIT-identical to passing
+    the planted blocks explicitly (same program, so the cache changed
+    nothing but the schedule), and stays within the single-k-tile ULP
+    contract of the default-blocks run (a bq-only change repartitions
+    q rows; XLA's vectorized transcendentals may round the same row
+    differently across tile layouts — test_transformer's bound)."""
+    from veles_tpu.ops import attention as att_mod
+
+    rng = numpy.random.RandomState(7)
+    q = _ints(rng, (2, 192, 32))
+    k = _ints(rng, (2, 192, 32))
+    v = _ints(rng, (2, 192, 32))
+
+    seen = []
+    real = att_mod._flash_fn
+
+    def spy(scale, level, blocks):
+        seen.append(blocks)
+        return real(scale, level, blocks)
+
+    monkeypatch.setattr(att_mod, "_flash_fn", spy)
+    base = numpy.asarray(att_mod.flash_attention(q, k, v))
+    assert seen == [att_mod._DEFAULT_BLOCKS]  # empty cache -> static
+    explicit = numpy.asarray(
+        att_mod.flash_attention(q, k, v, blocks=(64, 256)))
+
+    from veles_tpu.tune.spec import attention_spec
+    _plant(attention_spec(2, 192, 32, "float32", 0),
+           {"blocks": [64, 256]})
+    seen.clear()
+    tuned = numpy.asarray(att_mod.flash_attention(q, k, v))
+    assert seen == [(64, 256)]
+    numpy.testing.assert_array_equal(tuned, explicit)
+    assert float(numpy.abs(tuned - base).max()) < 1e-5
+
+
+def test_attention_tuner_ga_then_cache_hit():
+    """Attention joins the tune-once contract: the first tune runs the
+    GA (compile fitness over the full fwd+bwd custom_vjp step) and
+    persists; the SECOND run of the same spec is all cache hits with
+    ZERO evaluations."""
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.tune.autotune import ScheduleTuner
+    from veles_tpu.tune.spec import attention_spec
+
+    spec = attention_spec(2, 64, 16, "float32", 0)
+
+    def tuner():
+        return ScheduleTuner(spec, generations=1, population=3,
+                             fitness="compile",
+                             rng=RandomGenerator("att", seed=5))
+
+    first = tuner().tune()
+    assert first["source"] == "ga" and first["evals"] >= 1
+    blocks = first["schedule"]["blocks"]
+    assert blocks[0] % 8 == 0 and blocks[1] % 128 == 0
+    second = tuner().tune()
+    assert second["source"] == "cache" and second["evals"] == 0
+    assert second["schedule"] == first["schedule"]
+
+
+# -- fitness="model" ----------------------------------------------------------
+
+
+def test_model_fitness_thin_data_falls_back_to_base():
+    """fitness='model' with an empty measurement sidecar degrades to
+    the base mode and SAYS SO: the receipt row carries the fallback
+    reason, and the tune still lands a valid persisted winner."""
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.tune.autotune import ScheduleTuner
+    from veles_tpu.tune.spec import matmul_spec
+
+    spec = matmul_spec(16, 32, 48, "float32", 0)
+    row = ScheduleTuner(spec, generations=1, population=4,
+                        fitness="model", model_base="compile",
+                        rng=RandomGenerator("mf", seed=9)).tune()
+    assert row["source"] == "ga" and row["evals"] >= 1
+    assert row["model"]["fallback"] == "thin-data"
+    assert row["model"]["predicted"] == 0
+    assert row["schedule"]["blocks"][0] % 8 == 0
+
+
+def test_model_fitness_pool_run_degrades_to_base(caplog):
+    """Model ranking is in-process only: asking for workers (or farm
+    slaves) degrades fitness='model' to the base mode up front instead
+    of mis-ranking across children that share no model."""
+    from veles_tpu.tune.autotune import ScheduleTuner
+    from veles_tpu.tune.spec import matmul_spec
+
+    with caplog.at_level(logging.WARNING):
+        tuner = ScheduleTuner(matmul_spec(16, 32, 48, "float32", 0),
+                              fitness="model", model_base="compile",
+                              workers=2)
+    assert tuner.fitness_mode == "compile"
+    assert any("in-process only" in r.message for r in caplog.records)
+
+
+def test_model_fitness_e2e_tunes_with_fewer_compiles_and_serves():
+    """The headline loop end to end on real compiles: a measured base
+    leg builds the sidecar, then a fitness='model' re-tune trains the
+    stump model, compiles only the top-ranked slice (predicted >= 1,
+    evals below the base leg's), and its MEASURED winner both persists
+    and serves the actual matmul consult bit-identically."""
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.tune.autotune import ScheduleTuner
+    from veles_tpu.tune.spec import matmul_spec
+
+    rng = numpy.random.RandomState(11)
+    a, b = _ints(rng, (64, 512)), _ints(rng, (512, 512))
+    base_out = numpy.asarray(matmul_mod.matmul(a, b))  # static tiles
+
+    # base leg: compile-fitness GA over two specs -> measurement
+    # triples in >= 2 spec groups (leave-one-spec-out needs a held-out
+    # group to validate against).  population 14 so the seeded initial
+    # generation carries enough DISTINCT schedules for the model leg's
+    # top-decile cut to actually skip some (floor is 2 per generation)
+    spec = matmul_spec(64, 512, 512, "float32", 0)
+    side = matmul_spec(128, 512, 512, "float32", 0)
+    base_row = ScheduleTuner(spec, generations=2, population=14,
+                             fitness="compile",
+                             rng=RandomGenerator("mb", seed=13)).tune()
+    ScheduleTuner(side, generations=1, population=10,
+                  fitness="compile",
+                  rng=RandomGenerator("ms", seed=14)).tune()
+    assert base_row["source"] == "ga" and base_row["evals"] >= 3
+
+    model_row = ScheduleTuner(
+        spec, generations=2, population=14, fitness="model",
+        model_base="compile", model_min_triples=6, model_trust=10.0,
+        rng=RandomGenerator("mb", seed=13)).tune(force=True)
+    info = model_row["model"]
+    assert info["fallback"] is None and info["trusted"]
+    assert info["triples"] >= 6 and info["groups"] >= 2
+    # the receipt: predictions replaced compiles
+    assert info["predicted"] >= 1
+    assert model_row["evals"] < base_row["evals"]
+    # the winner is a real MEASUREMENT, never a prediction
+    assert model_row["source"] == "ga"
+    assert model_row["fitness"] is not None
+    winner = model_row["schedule"]["blocks"]
+
+    seen = []
+    real = matmul_mod._matmul_jit
+
+    def spy(a_, b_, pl, blocks, od, interp):
+        seen.append(blocks)
+        return real(a_, b_, pl, blocks, od, interp)
+
+    import pytest as _pytest
+    mp = _pytest.MonkeyPatch()
+    try:
+        mp.setattr(matmul_mod, "_matmul_jit", spy)
+        tuned_out = numpy.asarray(matmul_mod.matmul(a, b))
+    finally:
+        mp.undo()
+    assert seen == [tuple(winner)]
+    numpy.testing.assert_array_equal(tuned_out, base_out)
+
+
+# -- the fleet schedule bank --------------------------------------------------
+
+
+def test_bank_merge_into_fresh_cache_serves_with_zero_local_evals(
+        monkeypatch):
+    """The fleet contract: host A tunes and exports; host B (a FRESH
+    empty cache) merges the bank and immediately serves the identical
+    schedule — consult bit-equal, re-tune all cache hits, ZERO local
+    evaluations paid."""
+    from veles_tpu.prng import RandomGenerator
+    from veles_tpu.tune.autotune import ScheduleTuner
+    from veles_tpu.tune.cache import cache_for
+    from veles_tpu.tune.spec import matmul_spec
+
+    rng = numpy.random.RandomState(17)
+    a, b = _ints(rng, (16, 32)), _ints(rng, (32, 48))
+    base_out = numpy.asarray(matmul_mod.matmul(a, b))
+
+    spec = matmul_spec(16, 32, 48, "float32", 0)
+    first = ScheduleTuner(spec, generations=2, population=4,
+                          fitness="compile",
+                          rng=RandomGenerator("bk", seed=3)).tune()
+    assert first["source"] == "ga"
+
+    import tempfile
+    bank_path = os.path.join(tempfile.mkdtemp(prefix="veles_bank"),
+                             "bank.json")
+    assert cache_for().export_bank(bank_path) == 1
+
+    # host B: point the env at a fresh directory — cache_for() is
+    # path-keyed, so this is a brand-new empty cache
+    fresh_dir = tempfile.mkdtemp(prefix="veles_fresh")
+    monkeypatch.setenv("VELES_SCHEDULE_CACHE",
+                       os.path.join(fresh_dir, "schedule_cache"))
+    assert len(cache_for()) == 0
+    counts = cache_for().merge_bank(bank_path)
+    assert counts["adopted"] == 1 and counts["total"] == 1
+
+    seen = []
+    real = matmul_mod._matmul_jit
+
+    def spy(a_, b_, pl, blocks, od, interp):
+        seen.append(blocks)
+        return real(a_, b_, pl, blocks, od, interp)
+
+    monkeypatch.setattr(matmul_mod, "_matmul_jit", spy)
+    merged_out = numpy.asarray(matmul_mod.matmul(a, b))
+    assert seen == [tuple(first["schedule"]["blocks"])]
+    numpy.testing.assert_array_equal(merged_out, base_out)
+
+    retune = ScheduleTuner(spec, generations=2, population=4,
+                           fitness="compile",
+                           rng=RandomGenerator("bk", seed=3)).tune()
+    assert retune["source"] == "cache" and retune["evals"] == 0
+    assert retune["schedule"] == first["schedule"]
+
+
+def test_publish_schedule_bank_channel_and_watcher_pickup(tmp_path):
+    """The publish channel end to end: publish_schedule_bank writes a
+    manifest-verified schedule_bank.json beside the snapshots; the
+    serve watcher's _maybe_merge_bank adopts it into the LOCAL cache,
+    consumes the (mtime, size) stamp, and a mid-replace corruption is
+    retried (stamp NOT consumed) instead of half-merged."""
+    from veles_tpu.serve.freshness import SnapshotWatcher
+    from veles_tpu.snapshotter import publish_schedule_bank
+    from veles_tpu.tune.cache import (BANK_FILE_NAME, ScheduleCache,
+                                      cache_for, device_kind,
+                                      schedule_key)
+
+    pub = str(tmp_path / "pub")
+    # nothing to share is not an error
+    empty = ScheduleCache(str(tmp_path / "empty" / "schedules.json"))
+    assert publish_schedule_bank(pub, cache=empty) is None
+
+    # the trainer-side cache with one real keyed winner
+    from veles_tpu.ops.matmul import MATMUL_KERNEL_VERSION
+    producer = ScheduleCache(str(tmp_path / "prod" / "schedules.json"))
+    digest, payload = schedule_key(
+        "matmul", [16, 128, 128], "float32", 0, device_kind(),
+        {"kernel_version": MATMUL_KERNEL_VERSION})
+    producer.put(digest, payload, {"blocks": [8, 128, 128]},
+                 fitness=-1e-3, evals=4)
+    res = publish_schedule_bank(pub, cache=producer)
+    assert res["entries"] == 1
+    assert os.path.basename(res["bank"]) == BANK_FILE_NAME
+
+    watcher = SnapshotWatcher(pub, poll_s=30.0)
+    counts = watcher._maybe_merge_bank()
+    assert counts["adopted"] == 1 and counts["total"] == 1
+    entry = cache_for().get(digest)  # the conftest-private local cache
+    assert entry["schedule"]["blocks"] == [8, 128, 128]
+    assert entry["host"]  # provenance survives the trip
+    # stamp consumed: the unchanged bank is not re-merged every poll
+    assert watcher._maybe_merge_bank() is None
+
+    # publisher mid-replace: bank bytes no longer match the manifest —
+    # skip WITHOUT consuming the stamp so the next poll retries
+    bank_file = os.path.join(pub, BANK_FILE_NAME)
+    stamp_before = watcher._bank_stamp
+    with open(bank_file, "a") as fout:
+        fout.write("\n")
+    assert watcher._maybe_merge_bank() is None
+    assert watcher._bank_stamp == stamp_before
+
+    # the publisher finishes its replace: the retry adopts the update
+    producer.put(digest, payload, {"blocks": [16, 128, 128]},
+                 fitness=-5e-4, evals=4)
+    publish_schedule_bank(pub, cache=producer)
+    counts = watcher._maybe_merge_bank()
+    assert counts["adopted"] == 1
+    assert cache_for().get(digest)["schedule"]["blocks"] == \
+        [16, 128, 128]

@@ -248,6 +248,11 @@ _HEALTH_KEYS = (
     ("tune.cache_hits", "tune_cache_hits"),
     ("tune.cache_misses", "tune_cache_misses"),
     ("tune.evals", "tune_evals"),
+    # fleet schedule bank receipts: publishes (trainer), merges picked
+    # up (serve/CLI), entries adopted across all merges
+    ("tune.bank_published", "tune_bank_published"),
+    ("tune.bank_merged", "tune_bank_merged"),
+    ("tune.bank_entries", "tune_bank_entries"),
     # int8 quantized serving (veles_tpu/quant/, docs/serving.md
     # "Quantized ladder"): whether this process serves a quantized
     # engine, and the calibration clip fraction — a clip fraction

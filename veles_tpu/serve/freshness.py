@@ -55,6 +55,7 @@ from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.snapshotter import (
     SnapshotterBase, read_latest)
+from veles_tpu.tune.cache import BANK_FILE_NAME as _BANK_FILE_NAME
 
 __all__ = ["CanaryComparator", "FreshnessController", "ModelCandidate",
            "SnapshotWatcher", "export_model_spec"]
@@ -140,6 +141,8 @@ class SnapshotWatcher(Logger):
         self._rejected = set()
         self._pending = None  # {"ordinal", "first_bad", "backoff",
         #                        "next_try"}: the skip-and-retry state
+        self._bank_stamp = None  # (mtime_ns, size) of the last
+        #                          merged/handled schedule bank
         self._thread = None
         self._stop_ = False
         self._wake = threading.Event()
@@ -190,6 +193,7 @@ class SnapshotWatcher(Logger):
         """One pickup attempt; returns the accepted
         :class:`ModelCandidate` or None.  Public so push handlers and
         tests can drive the watcher synchronously."""
+        self._maybe_merge_bank()
         latest = read_latest(self.watch_dir)
         if latest is None:
             return None
@@ -262,6 +266,49 @@ class SnapshotWatcher(Logger):
                     restored, self.default_sample_shape)
         return ModelCandidate(ordinal, path, latest.get("sha256"),
                               plans, params, shape)
+
+    def _maybe_merge_bank(self):
+        """Merge the trainer-published fleet schedule bank
+        (``schedule_bank.json`` beside the snapshots) into the local
+        schedule cache whenever its bytes change — one host's tuning
+        pays for every serve replica.  Verified against its manifest
+        BEFORE parsing, same as snapshots; a mid-replace mismatch is
+        silently retried next poll.  Returns the merge counts dict or
+        None."""
+        bank_path = os.path.join(self.watch_dir, _BANK_FILE_NAME)
+        try:
+            stat = os.stat(bank_path)
+        except OSError:
+            return None
+        stamp = (stat.st_mtime_ns, stat.st_size)
+        if stamp == self._bank_stamp:
+            return None
+        ok, detail = SnapshotterBase.verify_snapshot(bank_path)
+        if ok is not True:
+            # publisher mid-replace (manifest flipped, bank not yet) —
+            # normal; leave the stamp unset so the next poll retries
+            self.debug("schedule bank not (yet) valid (%s); retrying",
+                       detail)
+            return None
+        from veles_tpu.tune.cache import cache_for
+        try:
+            counts = cache_for().merge_bank(bank_path)
+        except Exception as exc:
+            # consume the stamp: a structurally broken bank must not
+            # warn-spam every poll; the next publish supersedes it
+            self._bank_stamp = stamp
+            self.warning(
+                "schedule bank merge from %s failed (%s: %s); serving "
+                "continues on current schedules", bank_path,
+                type(exc).__name__, exc)
+            return None
+        self._bank_stamp = stamp
+        self.info(
+            "schedule bank merged from %s: %d adopted, %d kept, "
+            "%d stale, %d invalid of %d", bank_path,
+            counts["adopted"], counts["kept"], counts["stale"],
+            counts["invalid"], counts["total"])
+        return counts
 
     def _note_invalid(self, ordinal, path, detail, escalate=True):
         """Record a failed pickup and arm the retry backoff.

@@ -47,7 +47,7 @@ from veles_tpu.units import Unit
 
 __all__ = ["SnapshotterBase", "Snapshotter", "SnapshotError",
            "RollbackExhausted", "MANIFEST_SUFFIX", "LATEST_NAME",
-           "publish_snapshot", "read_latest"]
+           "publish_snapshot", "publish_schedule_bank", "read_latest"]
 
 #: sidecar manifest filename suffix (next to the snapshot it describes)
 MANIFEST_SUFFIX = ".manifest"
@@ -328,6 +328,36 @@ def publish_snapshot(path, publish_dir, keep=8):
                     ordinal=ordinal, snapshot=name)
     return {"ordinal": ordinal, "snapshot": dest,
             "sha256": latest["sha256"]}
+
+
+def publish_schedule_bank(publish_dir, cache=None):
+    """Publish the local schedule cache as a manifest-verified fleet
+    bank beside the snapshots (``schedule_bank.json`` — docs/
+    kernels.md "Autotuning"): one host's tuning pays for the fleet.
+
+    Same channel discipline as :func:`publish_snapshot`: the manifest
+    lands FIRST, so from the instant the bank bytes flip a watcher can
+    verify them; during the (manifest-new, bank-old) replace window
+    verification fails and the watcher just retries next poll.
+    Returns ``{"bank", "entries"}``, or None when the cache is empty
+    (nothing to share is not an error)."""
+    from veles_tpu.tune.cache import cache_for
+    from veles_tpu.tune.cache import BANK_FILE_NAME
+    cache = cache_for() if cache is None else cache
+    count = len(cache)
+    if count == 0:
+        return None
+    os.makedirs(publish_dir, exist_ok=True)
+    dest = os.path.join(publish_dir, BANK_FILE_NAME)
+    tmp = dest + ".export"
+    count = cache.export_bank(tmp)
+    SnapshotterBase.write_manifest(tmp, workflow_name="schedule_bank")
+    os.replace(tmp + MANIFEST_SUFFIX, dest + MANIFEST_SUFFIX)
+    os.replace(tmp, dest)
+    _fsync_dir(publish_dir)
+    _registry.counter("tune.bank_published").inc()
+    _tracer.instant("tune.bank_publish", cat="tune", entries=count)
+    return {"bank": dest, "entries": count}
 
 
 class SnapshotterBase(Unit):
@@ -892,6 +922,16 @@ class Snapshotter(SnapshotterBase):
             return
         self.info("published snapshot #%d -> %s", receipt["ordinal"],
                   receipt["snapshot"])
+        try:
+            bank = publish_schedule_bank(self.publish_dir)
+        except Exception as exc:
+            self.warning("schedule bank publish to %s failed (%s: "
+                         "%s); the fleet keeps its current schedules",
+                         self.publish_dir, type(exc).__name__, exc)
+            return
+        if bank is not None:
+            self.info("published schedule bank (%d entries) -> %s",
+                      bank["entries"], bank["bank"])
 
     def _write_atomic(self, destination, payload):
         """tmp -> fsync -> os.replace -> directory fsync.  A crash at

@@ -11,22 +11,31 @@ The pieces (docs/kernels.md, "Autotuning"):
 - ``tune.measure`` — the ONE timing discipline (pass filtering,
   positive-majority ranking, interleaved round-robin sampling) shared
   with bench.py and ``autotune_matmul``;
-- ``tune.autotune`` — the GA driver (:class:`ScheduleTuner`) and the
-  plain curated sweep (:func:`sweep_candidates`);
+- ``tune.costmodel`` — the deterministic learned cost model (boosted
+  stumps over hand-built features, pure numpy) trained on the
+  ``measurements.jsonl`` sidecar, with its leave-one-spec-out trust
+  gate;
+- ``tune.autotune`` — the GA driver (:class:`ScheduleTuner`, incl.
+  the model-ranked ``fitness="model"`` mode) and the plain curated
+  sweep (:func:`sweep_candidates`);
 - ``tune.walk`` — spec harvesting from a fused step's lowering;
 - ``python -m veles_tpu.tune`` — tune the shapes a zoo model actually
-  uses and commit a ``TUNE.json`` receipt.
+  uses and commit a ``TUNE.json`` receipt; ``--merge-bank`` folds a
+  fleet schedule bank into the local cache, ``--report`` audits the
+  training data/bank provenance.
 """
 
 from veles_tpu.tune.cache import (  # noqa: F401
-    ScheduleCache, cache_for, default_cache_dir, provenance,
-    record_specs, schedule_for, schedule_key, tune_counters)
+    MeasurementLog, ScheduleCache, cache_for, default_cache_dir,
+    load_bank, measurement_log, provenance, record_specs,
+    schedule_for, schedule_key, tune_counters)
 from veles_tpu.tune.measure import filter_passes  # noqa: F401
 from veles_tpu.tune.spec import (  # noqa: F401
     FAMILIES, conv_vjp_spec, family_for, matmul_int8_spec,
     matmul_spec, pool_bwd_spec, valid_schedule)
 
-__all__ = ["ScheduleCache", "cache_for", "default_cache_dir",
+__all__ = ["ScheduleCache", "MeasurementLog", "cache_for",
+           "measurement_log", "load_bank", "default_cache_dir",
            "provenance", "record_specs", "schedule_for",
            "schedule_key", "tune_counters", "filter_passes",
            "FAMILIES", "family_for", "matmul_spec",

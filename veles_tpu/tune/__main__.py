@@ -19,6 +19,16 @@ master with ``--farm-slaves N --farm-address host:port``.
     # CI smoke: compile-only fitness, tiny GA
     python -m veles_tpu.tune --model mlp --fitness compile \
         --generations 1 --population 4 --ops matmul --max-specs 2
+
+    # model-guided search: rank candidates with the learned cost
+    # model, compile only the top decile (falls back to --model-base
+    # when training data is thin or the model fails its trust gate)
+    python -m veles_tpu.tune --model mlp --fitness model
+
+    # fleet schedule bank: fold another host's tuning into this cache
+    python -m veles_tpu.tune --merge-bank /nfs/pod/schedule_bank.json
+    # audit the training data, model trust and cache provenance
+    python -m veles_tpu.tune --report
 """
 
 import argparse
@@ -75,10 +85,16 @@ def _parser():
                         help="mlp hidden width")
     parser.add_argument("--generations", type=int, default=4)
     parser.add_argument("--population", type=int, default=8)
-    parser.add_argument("--fitness", choices=("measure", "compile"),
+    parser.add_argument("--fitness",
+                        choices=("measure", "compile", "model"),
                         default="measure",
                         help="measure = interleaved timing; compile = "
-                        "compile-only (CI smoke)")
+                        "compile-only (CI smoke); model = cost-model "
+                        "ranked, only the top decile compiles")
+    parser.add_argument("--model-base", choices=("measure", "compile"),
+                        default="measure",
+                        help="measurement mode for --fitness model's "
+                        "top slice (and its fallback)")
     parser.add_argument("--repeats", type=int, default=8,
                         help="chain length per timing slope")
     parser.add_argument("--rounds", type=int, default=3,
@@ -110,11 +126,96 @@ def _parser():
     parser.add_argument("--force", action="store_true",
                         help="retune even on cache hits")
     parser.add_argument("--seed", type=int, default=13)
+    parser.add_argument("--merge-bank", metavar="PATH",
+                        help="merge a fleet schedule bank into the "
+                        "local cache and exit (no tuning)")
+    parser.add_argument("--export-bank", metavar="PATH",
+                        help="export the local cache as a fleet bank "
+                        "and exit (no tuning)")
+    parser.add_argument("--report", action="store_true",
+                        help="print cost-model validation, per-family "
+                        "triple counts and bank provenance; exit")
     return parser
+
+
+def _merge_bank(path):
+    from veles_tpu.tune import cache as tune_cache
+    cache = tune_cache.cache_for()
+    counts = cache.merge_bank(path)
+    print("bank merge: %d adopted, %d kept (local wins), %d stale "
+          "digests rejected, %d invalid of %d (cache now %d entries)"
+          % (counts["adopted"], counts["kept"], counts["stale"],
+             counts["invalid"], counts["total"], len(cache)),
+          flush=True)
+    return 0
+
+
+def _export_bank(path):
+    from veles_tpu.tune import cache as tune_cache
+    cache = tune_cache.cache_for()
+    count = cache.export_bank(path)
+    print("bank export: %d entries -> %s" % (count, path), flush=True)
+    return 0
+
+
+def _report(mode):
+    """The operator audit: what would the cost model train on, how
+    much does it trust itself, and who contributed the cache."""
+    from veles_tpu.tune import cache as tune_cache
+    from veles_tpu.tune import costmodel
+    from veles_tpu.tune.spec import FAMILIES
+    log = tune_cache.measurement_log()
+    print("measurement sidecar: %s" % log.path)
+    counts = log.count_by_family(mode=mode)
+    stale = len(log.rows(mode=mode, current_only=False)) \
+        - sum(counts.values())
+    print("  %d current triple(s) (mode=%s), %d stale/foreign"
+          % (sum(counts.values()), mode, stale))
+    for op in sorted(FAMILIES):
+        n = counts.get(op, 0)
+        if not n:
+            print("  %-12s %5d triples (thin: no model)" % (op, n))
+            continue
+        model, info = costmodel.train_for(op, mode=mode)
+        if info["fallback"] == "thin-data":
+            print("  %-12s %5d triples (thin: < %d, no model)"
+                  % (op, n, info["min_triples"]))
+        elif info["error"] is None:
+            print("  %-12s %5d triples (unvalidatable: no spec group "
+                  "with %d+ schedules; untrusted)" % (op, n, 3))
+        else:
+            print("  %-12s %5d triples  val error %.3f (spearman "
+                  "%.3f over %d held-out specs) -> %s"
+                  % (op, n, info["error"], info["spearman"],
+                     info["groups"],
+                     "TRUSTED" if info["trusted"] else "untrusted"))
+    cache = tune_cache.cache_for()
+    entries = cache.entries()
+    print("schedule cache: %s (%d entries)" % (cache.path,
+                                               len(entries)))
+    for digest in sorted(entries):
+        entry = entries[digest]
+        print("  %s  %-9s %-22s %-8s host=%s fitness=%s"
+              % (digest[:12], entry.get("op"),
+                 tuple(entry.get("shape", ())), entry.get("source"),
+                 entry.get("host", "local"), entry.get("fitness")))
+    print("tune counters: %s" % tune_cache.tune_counters(),
+          flush=True)
+    return 0
 
 
 def main(argv=None):
     args = _parser().parse_args(argv)
+
+    if args.cache:
+        os.environ["VELES_SCHEDULE_CACHE"] = args.cache
+    if args.merge_bank:
+        return _merge_bank(args.merge_bank)
+    if args.export_bank:
+        return _export_bank(args.export_bank)
+    if args.report:
+        return _report(args.model_base if args.fitness == "model"
+                       else args.fitness)
 
     if args.worker:
         from veles_tpu.jobfarm import JobFarm
@@ -130,8 +231,6 @@ def main(argv=None):
     from veles_tpu.tune.autotune import ScheduleTuner
     from veles_tpu.tune.walk import collect_specs
 
-    if args.cache:
-        os.environ["VELES_SCHEDULE_CACHE"] = args.cache
     if args.precision_level is None:
         from veles_tpu.config import root
         args.precision_level = int(root.common.engine.get(
@@ -159,6 +258,7 @@ def main(argv=None):
             farm_slaves=args.farm_slaves,
             farm_address=args.farm_address, fitness=args.fitness,
             repeats=args.repeats, rounds=args.rounds,
+            model_base=args.model_base,
             rng=RandomGenerator("tune", seed=args.seed))
         row = tuner.tune(force=args.force)
         rows.append(row)

@@ -30,6 +30,20 @@ interleaving.
 (fitness = negative wall seconds of the warm-up) — the CI mode: it
 exercises every moving part on CPU interpret kernels in seconds and
 still rejects uncompilable candidates.
+
+``fitness="model"`` is the learned-cost-model mode (``tune/
+costmodel.py``): every generation's distinct feasible schedules are
+ranked by the model and only the top decile (floor: 2) compiles and
+measures under the base discipline (``model_base``: "measure", or
+"compile" for CI); the rest inherit their PREDICTED fitness for
+selection purposes only.  Every measured slope — in every mode — is
+appended to the ``measurements.jsonl`` sidecar, which is where the
+model's training data comes from in the first place.  The persisted
+winner is always the best MEASURED schedule; a predicted fitness can
+steer the GA but can never reach the cache.  When the family's
+training data is thin or the model fails its leave-one-spec-out trust
+gate the tuner silently degrades to the base mode (the receipt row
+says why).
 """
 
 import json
@@ -79,6 +93,21 @@ def _timed_fitness(run, repeats, rounds):
     return PENALTY if med is None else -med
 
 
+def _record_triple(spec, schedule, slope, mode):
+    """Append one measured (spec, schedule, slope) triple to the
+    ``measurements.jsonl`` sidecar — the cost model's training data;
+    never raises."""
+    try:
+        digest, payload = _cache.schedule_key(
+            spec["op"], spec["shape"], spec["dtype"],
+            spec["precision_level"], _cache.device_kind(),
+            spec.get("extra"))
+        _cache.record_measurement(digest, payload, schedule, slope,
+                                  mode=mode)
+    except Exception:
+        pass
+
+
 def evaluate_candidate(candidate):
     """Per-candidate fitness — module-level and self-contained so the
     process-pool and control-plane farm evaluators can pickle/quote it.
@@ -94,9 +123,13 @@ def evaluate_candidate(candidate):
         return PENALTY
     _registry.counter("tune.evals").inc()
     if candidate.get("fitness_mode") == "compile":
+        _record_triple(spec, schedule, compile_s, "compile")
         return -compile_s
-    return _timed_fitness(run, candidate.get("repeats", 8),
-                          candidate.get("rounds", 3))
+    fitness = _timed_fitness(run, candidate.get("repeats", 8),
+                             candidate.get("rounds", 3))
+    if fitness > PENALTY:
+        _record_triple(spec, schedule, -fitness, "measure")
+    return fitness
 
 
 class _TunerGA(GeneticsOptimizer):
@@ -142,7 +175,8 @@ class ScheduleTuner(Logger):
     def __init__(self, spec, cache=None, generations=4, population=8,
                  workers=0, farm_slaves=0, farm_address="127.0.0.1:0",
                  fitness="measure", repeats=8, rounds=3, rng=None,
-                 device_kind=None, **kwargs):
+                 device_kind=None, model_base="measure",
+                 model_min_triples=None, model_trust=None, **kwargs):
         super(ScheduleTuner, self).__init__(**kwargs)
         self.spec = dict(spec)
         self.family = family_for(self.spec["op"])
@@ -157,7 +191,29 @@ class ScheduleTuner(Logger):
         self.rounds = rounds
         self.rng = rng
         self.device_kind = device_kind or _cache.device_kind()
+        self.model_base = model_base
+        self.model_min_triples = model_min_triples
+        self.model_trust = model_trust
+        if fitness == "model" and (workers or farm_slaves):
+            # model ranking needs the in-process batch evaluator (the
+            # pool/farm children score candidates independently);
+            # degrade to the base mode rather than mis-rank
+            self.warning("tune: fitness='model' is in-process only; "
+                         "using fitness=%r for the pool/farm run",
+                         model_base)
+            self.fitness_mode = model_base
+        self._model = None
+        self._model_info = None
+        self._best_measured = (PENALTY, None)
         self._sched_memo = {}
+
+    @property
+    def _measure_mode(self):
+        """The mode actual measurements run under: the base mode in
+        (and under fallback from) fitness='model'."""
+        if self.fitness_mode == "model":
+            return self.model_base
+        return self.fitness_mode
 
     # -- cache key -----------------------------------------------------------
 
@@ -183,8 +239,32 @@ class ScheduleTuner(Logger):
                 entry = to_measure.setdefault(key, (schedule, []))
                 entry[1].append(i)
 
+        measure_keys = list(to_measure)
+        if self._model is not None and len(measure_keys) > 2:
+            # model mode: rank the generation's distinct feasible
+            # schedules, compile+measure only the top decile (floor 2);
+            # the rest carry their PREDICTED fitness — selection
+            # pressure only, never persisted, never a tune.eval
+            schedules = [to_measure[key][0] for key in measure_keys]
+            predicted = self._model.predict_seconds(self.spec,
+                                                    schedules)
+            order = sorted(range(len(measure_keys)),
+                           key=lambda i: (float(predicted[i]), i))
+            top = max(2, -(-len(measure_keys) // 10))
+            for rank_i in order[top:]:
+                key = measure_keys[rank_i]
+                fitness = -float(predicted[rank_i])
+                self._sched_memo[key] = fitness
+                self._model_info["predicted"] += 1
+                for i in to_measure[key][1]:
+                    fits[i] = fitness
+            measure_keys = [measure_keys[rank_i]
+                            for rank_i in order[:top]]
+
+        mode = self._measure_mode
         runners, compile_s = {}, {}
-        for key, (schedule, indices) in to_measure.items():
+        for key in measure_keys:
+            schedule, indices = to_measure[key]
             run, seconds = _compile_runner(self.family, self.spec,
                                            schedule)
             if run is None:
@@ -196,7 +276,7 @@ class ScheduleTuner(Logger):
             runners[key] = run
             compile_s[key] = seconds
 
-        if self.fitness_mode == "compile":
+        if mode == "compile":
             ranked = {key: compile_s[key] for key in runners}
         else:
             # ONE sample of every candidate per pass: congestion drift
@@ -209,9 +289,42 @@ class ScheduleTuner(Logger):
             med = ranked.get(key)
             fitness = PENALTY if med is None else -med
             self._sched_memo[key] = fitness
+            if med is not None:
+                schedule = to_measure[key][0]
+                _record_triple(self.spec, schedule, med, mode)
+                if fitness > self._best_measured[0]:
+                    self._best_measured = (fitness, schedule)
             for i in to_measure[key][1]:
                 fits[i] = fitness
         return fits
+
+    # -- the cost model ------------------------------------------------------
+
+    def _setup_model(self):
+        """Train-and-trust-gate the family's cost model from the
+        measurement sidecar; on thin data or a failed validation gate
+        ``self._model`` stays None and the run degrades to the base
+        mode (the receipt row's ``model.fallback`` says why)."""
+        from veles_tpu.tune import costmodel
+        kwargs = {}
+        if self.model_min_triples is not None:
+            kwargs["min_triples"] = self.model_min_triples
+        if self.model_trust is not None:
+            kwargs["trust_error"] = self.model_trust
+        try:
+            model, info = costmodel.train_for(
+                self.family.name, mode=self.model_base, **kwargs)
+        except Exception as exc:
+            model, info = None, {"family": self.family.name,
+                                 "fallback": "train-error: %s" % exc}
+        self._model = model
+        info["predicted"] = 0
+        self._model_info = info
+        if model is None:
+            self.warning(
+                "tune: cost model unavailable for %s (%s); measuring "
+                "every candidate (fitness=%r)", self.family.name,
+                info.get("fallback"), self.model_base)
 
     # -- the GA run ----------------------------------------------------------
 
@@ -220,7 +333,10 @@ class ScheduleTuner(Logger):
             "family": self.family.name,
             "spec": {k: v for k, v in self.spec.items()},
             "genes": space,
-            "fitness_mode": self.fitness_mode,
+            # the pool/farm children measure every candidate they get
+            # (model ranking is in-process only), so they are told the
+            # base mode, never "model"
+            "fitness_mode": self._measure_mode,
             "repeats": self.repeats,
             "rounds": self.rounds,
         }
@@ -295,6 +411,10 @@ class ScheduleTuner(Logger):
             row.update(schedule=None, source="untunable")
             return row
 
+        if self.fitness_mode == "model":
+            self._setup_model()
+            row["model"] = self._model_info
+
         batch = None if (self.workers or self.farm_slaves) \
             else self._batch_fitness
         opt = _TunerGA(
@@ -320,6 +440,26 @@ class ScheduleTuner(Logger):
             evals = opt.dispatched
         row["evals"] = evals
         row["genomes"] = opt.dispatched
+
+        if self._model is not None:
+            # the GA's champion may carry a PREDICTED fitness; only a
+            # measured winner may be persisted or reported — swap in
+            # the best measured schedule (every generation measured
+            # its top slice, so one exists whenever anything ranked)
+            best_fitness, best_schedule = self._best_measured
+            if best_fitness > PENALTY:
+                self.cache.put(digest, payload, best_schedule,
+                               fitness=best_fitness, source="ga",
+                               evals=evals)
+                row.update(schedule=best_schedule,
+                           fitness=best_fitness, source="ga")
+                self.info(
+                    "tune: %s %s -> %s (model-ranked; fitness %.3g, "
+                    "%d evals / %d genomes, %d predicted-only)",
+                    self.spec["op"], tuple(self.spec["shape"]),
+                    best_schedule, best_fitness, evals,
+                    opt.dispatched, self._model_info["predicted"])
+                return row
 
         if best_fitness <= PENALTY:
             # every candidate was infeasible or measured only jitter:
@@ -378,6 +518,9 @@ def sweep_candidates(spec, candidates, repeats=24, rounds=5,
         samples = _measure.interleaved_slopes(
             runners, 1, repeats + 1, rounds=rounds)
         ranking = _measure.rank(samples)
+    for key, med in ranking.items():
+        if med is not None:
+            _record_triple(spec, distinct[key], med, fitness)
     best_key, best_time = None, float("inf")
     for key, med in ranking.items():
         if med is not None and med < best_time:

@@ -35,7 +35,9 @@ import threading
 
 __all__ = ["ScheduleCache", "schedule_key", "schedule_for",
            "provenance", "cache_for", "default_cache_dir",
-           "record_specs", "tune_counters", "SCHEDULE_CACHE_SCHEMA"]
+           "record_specs", "tune_counters", "SCHEDULE_CACHE_SCHEMA",
+           "MeasurementLog", "measurement_log", "record_measurement",
+           "load_bank", "BANK_FILE_NAME"]
 
 logger = logging.getLogger("veles_tpu.tune")
 
@@ -44,6 +46,19 @@ logger = logging.getLogger("veles_tpu.tune")
 SCHEDULE_CACHE_SCHEMA = 1
 
 _FILE_NAME = "schedules.json"
+
+#: the measured-triple sidecar beside ``schedules.json`` — the cost
+#: model's training data (docs/kernels.md, "Autotuning")
+_MEASUREMENTS_NAME = "measurements.jsonl"
+
+#: rewrite threshold: when the sidecar exceeds this byte size an append
+#: compacts it to the newest ``_MEASUREMENTS_KEEP`` rows (append-only
+#: in the common case, bounded in the limit)
+_MEASUREMENTS_MAX_BYTES = 8 * 2 ** 20
+_MEASUREMENTS_KEEP = 10000
+
+#: the portable fleet-bank file name used by the publish channel
+BANK_FILE_NAME = "schedule_bank.json"
 
 
 def default_cache_dir():
@@ -205,6 +220,234 @@ class ScheduleCache(object):
         with self._lock:
             return len(self._load())
 
+    # -- fleet bank ----------------------------------------------------------
+
+    def export_bank(self, path):
+        """Write the whole table as one portable bank file (atomic
+        write): entries verbatim plus per-entry ``host`` provenance so
+        a merged fleet bank can still say which host tuned what.
+        Returns the entry count."""
+        import socket
+        host = socket.gethostname()
+        with self._lock:
+            entries = self._read_disk()
+            self._entries = entries
+        exported = {}
+        for digest, entry in sorted(entries.items()):
+            entry = dict(entry)
+            entry.setdefault("host", host)
+            exported[digest] = entry
+        bank = {"schema": SCHEDULE_CACHE_SCHEMA,
+                "kind": "schedule_bank", "host": host,
+                "jax": _jax_version(), "entries": exported}
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fout:
+            json.dump(bank, fout, indent=1, sort_keys=True)
+            fout.flush()
+        os.replace(tmp, path)
+        return len(exported)
+
+    def merge_bank(self, bank):
+        """Merge a fleet bank (dict or path) into the table under the
+        same re-read-before-write discipline as :meth:`put`.
+
+        Per-digest policy is **disk wins except newer fitness**: a bank
+        entry is adopted only when the digest is absent locally or the
+        bank's measured fitness is strictly better (fitness = negative
+        seconds, so higher wins).  Entries whose digest does not match
+        a recompute over their own key coordinates are STALE (tampered,
+        or written by a different schedule_key discipline) and
+        rejected; structurally invalid schedules are rejected the same
+        way the kernels' consult would reject them.  Returns the count
+        dict ``{"adopted", "kept", "stale", "invalid", "total"}``."""
+        from veles_tpu.tune.spec import valid_schedule
+        if not isinstance(bank, dict):
+            bank = load_bank(bank)
+        entries = bank.get("entries") or {}
+        counts = {"adopted": 0, "kept": 0, "stale": 0, "invalid": 0,
+                  "total": len(entries)}
+        adoptable = {}
+        for digest, entry in entries.items():
+            if not isinstance(entry, dict):
+                counts["invalid"] += 1
+                continue
+            payload = {k: v for k, v in entry.items()
+                       if k not in _NON_KEY_FIELDS}
+            if _digest(json.dumps(payload, sort_keys=True)) != digest:
+                counts["stale"] += 1
+                continue
+            if valid_schedule(entry.get("op"),
+                              entry.get("schedule")) is None:
+                counts["invalid"] += 1
+                continue
+            adoptable[digest] = entry
+        with self._lock:
+            merged = self._read_disk()
+            for digest, entry in adoptable.items():
+                local = merged.get(digest)
+                if local is not None and not _fitter(entry, local):
+                    counts["kept"] += 1
+                    continue
+                merged[digest] = dict(entry)
+                counts["adopted"] += 1
+            self._entries = merged
+            if counts["adopted"]:
+                self._save()
+        reg = _counters()
+        reg.counter("tune.bank_merged").inc()
+        if counts["adopted"]:
+            reg.counter("tune.bank_entries").inc(counts["adopted"])
+        return counts
+
+
+#: entry fields that ride ALONGSIDE the key payload (everything else
+#: in an entry is a schedule_key coordinate, so a digest recompute over
+#: the remainder must reproduce the entry's own digest)
+_NON_KEY_FIELDS = frozenset(
+    ("schedule", "source", "fitness", "evals", "host"))
+
+
+def _fitter(challenger, incumbent):
+    """True when the challenger's measured fitness strictly beats the
+    incumbent's (an unmeasured challenger never displaces anything; an
+    unmeasured incumbent yields to any measured challenger)."""
+    cf = challenger.get("fitness")
+    if cf is None:
+        return False
+    inf = incumbent.get("fitness")
+    return inf is None or float(cf) > float(inf)
+
+
+def load_bank(path):
+    """Read + structurally verify one bank file; raises ValueError on
+    anything that is not a schedule bank of the current schema."""
+    with open(path) as fin:
+        bank = json.load(fin)
+    if (not isinstance(bank, dict)
+            or bank.get("kind") != "schedule_bank"
+            or bank.get("schema") != SCHEDULE_CACHE_SCHEMA
+            or not isinstance(bank.get("entries"), dict)):
+        raise ValueError("%s is not a schedule bank (schema %s)"
+                         % (path, SCHEDULE_CACHE_SCHEMA))
+    return bank
+
+
+class MeasurementLog(object):
+    """The ``measurements.jsonl`` sidecar: every measured
+    (spec, schedule, slope) triple the tuner ever ranks, one JSON row
+    per line — the cost model's training set.
+
+    Append-only in the common case; an append that finds the file past
+    ``_MEASUREMENTS_MAX_BYTES`` compacts it to the newest
+    ``_MEASUREMENTS_KEEP`` rows (atomic replace).  Rows carry the full
+    digest payload, so loads can filter to the CURRENT jax version /
+    device kind / kernel version — a version bump strands old rows
+    exactly like it strands old cache entries."""
+
+    def __init__(self, path=None):
+        self.path = path or os.path.join(default_cache_dir(),
+                                         _MEASUREMENTS_NAME)
+        self._lock = threading.Lock()
+        self._warned = False
+
+    def append(self, digest, payload, schedule, slope, mode="measure"):
+        row = {"digest": str(digest), "payload": dict(payload),
+               "schedule": dict(schedule), "slope": float(slope),
+               "mode": str(mode)}
+        line = json.dumps(row, sort_keys=True) + "\n"
+        with self._lock:
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                        exist_ok=True)
+            with open(self.path, "a") as fout:
+                fout.write(line)
+            try:
+                oversized = (os.path.getsize(self.path)
+                             > _MEASUREMENTS_MAX_BYTES)
+            except OSError:
+                oversized = False
+            if oversized:
+                self._compact()
+
+    def _compact(self):
+        with open(self.path) as fin:
+            lines = fin.readlines()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fout:
+            fout.writelines(lines[-_MEASUREMENTS_KEEP:])
+            fout.flush()
+        os.replace(tmp, self.path)
+
+    def rows(self, op=None, mode=None, current_only=True):
+        """The parsed rows, newest last.  ``current_only`` keeps only
+        rows whose payload matches the CURRENT jax version and device
+        kind AND whose digest recompute matches (a jax/kernel-version
+        bump invalidates training data like it invalidates cache
+        entries).  Unparseable lines are skipped (one warning)."""
+        try:
+            with open(self.path) as fin:
+                lines = fin.readlines()
+        except FileNotFoundError:
+            return []
+        except OSError as exc:
+            self._warn("measurement log %s unreadable (%s)"
+                       % (self.path, exc))
+            return []
+        jax_now = _jax_version() if current_only else None
+        kind_now = device_kind() if current_only else None
+        kernel_now = {}
+        if current_only:
+            from veles_tpu.tune.spec import current_kernel_version
+        out = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+                payload = row["payload"]
+                digest = row["digest"]
+                float(row["slope"])
+                row["schedule"], row["mode"]
+            except (ValueError, KeyError, TypeError):
+                self._warn("measurement log %s has unparseable rows; "
+                           "skipping them" % self.path)
+                continue
+            if op is not None and payload.get("op") != op:
+                continue
+            if mode is not None and row.get("mode") != mode:
+                continue
+            if current_only:
+                if (payload.get("jax") != jax_now
+                        or payload.get("device_kind") != kind_now):
+                    continue
+                row_op = payload.get("op")
+                if row_op not in kernel_now:
+                    kernel_now[row_op] = current_kernel_version(row_op)
+                if (kernel_now[row_op] is not None
+                        and payload.get("kernel_version")
+                        != kernel_now[row_op]):
+                    continue
+                recomputed = _digest(json.dumps(payload,
+                                                sort_keys=True))
+                if recomputed != digest:
+                    continue
+            out.append(row)
+        return out
+
+    def count_by_family(self, mode=None, current_only=True):
+        counts = {}
+        for row in self.rows(mode=mode, current_only=current_only):
+            op = row["payload"].get("op", "?")
+            counts[op] = counts.get(op, 0) + 1
+        return counts
+
+    def _warn(self, message):
+        if not self._warned:
+            self._warned = True
+            logger.warning(message)
+
 
 # -- process-wide consult hook ----------------------------------------------
 
@@ -222,6 +465,34 @@ def cache_for(path=None):
         if inst is None:
             inst = _instances[resolved] = ScheduleCache(resolved)
         return inst
+
+
+_log_instances = {}
+
+
+def measurement_log(path=None):
+    """The MeasurementLog singleton for ``path`` — same resolved-path
+    keying as :func:`cache_for`, so the conftest tmp-redirect that
+    isolates ``schedules.json`` isolates the sidecar too."""
+    resolved = path or os.path.join(default_cache_dir(),
+                                    _MEASUREMENTS_NAME)
+    with _instances_lock:
+        inst = _log_instances.get(resolved)
+        if inst is None:
+            inst = _log_instances[resolved] = MeasurementLog(resolved)
+        return inst
+
+
+def record_measurement(digest, payload, schedule, slope,
+                       mode="measure"):
+    """Append one measured triple to the sidecar; never raises (a
+    read-only cache dir must not break a tune run)."""
+    try:
+        measurement_log().append(digest, payload, schedule, slope,
+                                 mode=mode)
+    except Exception as exc:
+        logger.warning("measurement log append failed (%s); triple "
+                       "dropped", exc)
 
 
 #: active recording sink (tune/walk.py) — a plain list; consults append
@@ -315,7 +586,9 @@ def tune_counters():
     (the serve engine's compile receipt, the CLI's TUNE.json)."""
     reg = _counters()
     out = {}
-    for name in ("tune.cache_hits", "tune.cache_misses", "tune.evals"):
+    for name in ("tune.cache_hits", "tune.cache_misses", "tune.evals",
+                 "tune.bank_published", "tune.bank_merged",
+                 "tune.bank_entries"):
         metric = reg.peek(name)
         if metric is not None:
             out[name.split(".", 1)[1]] = metric.value

@@ -37,7 +37,7 @@ from veles_tpu.genetics.config import Tune
 __all__ = ["FAMILIES", "family_for", "matmul_spec", "matmul_int8_spec",
            "conv_vjp_spec", "pool_bwd_spec", "attention_spec",
            "valid_schedule", "matmul_seed_candidates",
-           "TUNE_VMEM_BUDGET_BYTES"]
+           "current_kernel_version", "TUNE_VMEM_BUDGET_BYTES"]
 
 logger = logging.getLogger("veles_tpu.tune")
 
@@ -116,13 +116,15 @@ class MatmulFamily(object):
             _quant(genes["bk"], 128, 128, min(2048, kp)),
         ]}
 
-    def feasible(self, spec, schedule):
+    def footprint(self, spec, schedule):
         bm, bn, bk = schedule["blocks"]
         isz = _itemsize(spec["dtype"])
-        footprint = (bm * bk * isz + bk * bn * isz   # a + b blocks
-                     + 2 * bm * bn * 4               # f32 acc + comp
-                     + bm * bn * isz)                # out block
-        return footprint <= TUNE_VMEM_BUDGET_BYTES
+        return (bm * bk * isz + bk * bn * isz   # a + b blocks
+                + 2 * bm * bn * 4               # f32 acc + comp
+                + bm * bn * isz)                # out block
+
+    def feasible(self, spec, schedule):
+        return self.footprint(spec, schedule) <= TUNE_VMEM_BUDGET_BYTES
 
     def seeds(self, spec):
         # the GA seeds at most `population` chromosomes, so the
@@ -224,13 +226,15 @@ class MatmulInt8Family(object):
             _quant(genes["bk"], 128, 128, min(2048, kp)),
         ]}
 
-    def feasible(self, spec, schedule):
+    def footprint(self, spec, schedule):
         bm, bn, bk = schedule["blocks"]
-        footprint = (bm * bk + bk * bn     # int8 a + b blocks (1 B)
-                     + bm * bn * 4         # int32 accumulator
-                     + bm * bn * 4         # f32 out block
-                     + 2 * bn * 4)         # scale + bias rows
-        return footprint <= TUNE_VMEM_BUDGET_BYTES
+        return (bm * bk + bk * bn     # int8 a + b blocks (1 B)
+                + bm * bn * 4         # int32 accumulator
+                + bm * bn * 4         # f32 out block
+                + 2 * bn * 4)         # scale + bias rows
+
+    def feasible(self, spec, schedule):
+        return self.footprint(spec, schedule) <= TUNE_VMEM_BUDGET_BYTES
 
     def seeds(self, spec):
         return [{"blocks": list(c)} for c in
@@ -305,16 +309,18 @@ class ConvVjpFamily(object):
             _quant(genes["bk"], 8, 8, min(2048, pp)),
         ]}
 
-    def feasible(self, spec, schedule):
+    def footprint(self, spec, schedule):
         bi, bj, bk = schedule["blocks"]
         isz = _itemsize(spec["dtype"])
-        footprint = (bk * bi * isz          # tap-stack block
-                     + 2 * bk * bj * isz    # y + dy blocks
-                     + bk * bj * isz        # err out block
-                     + bi * bj * 4          # gw out block (f32)
-                     + 2 * bi * bj * 4      # acc + comp scratch
-                     + 8 * bj * 4)          # bias scratch
-        return footprint <= TUNE_VMEM_BUDGET_BYTES
+        return (bk * bi * isz          # tap-stack block
+                + 2 * bk * bj * isz    # y + dy blocks
+                + bk * bj * isz        # err out block
+                + bi * bj * 4          # gw out block (f32)
+                + 2 * bi * bj * 4      # acc + comp scratch
+                + 8 * bj * 4)          # bias scratch
+
+    def feasible(self, spec, schedule):
+        return self.footprint(spec, schedule) <= TUNE_VMEM_BUDGET_BYTES
 
     def seeds(self, spec):
         return [{"blocks": list(c)} for c in
@@ -399,18 +405,20 @@ class AttentionFamily(object):
             _quant(genes["bk"], 128, 128, min(2048, tk)),
         ]}
 
-    def feasible(self, spec, schedule):
+    def footprint(self, spec, schedule):
         bq, bk = schedule["blocks"]
         dhp = spec["shape"][3]
         isz = _itemsize(spec["dtype"])
-        footprint = (bq * dhp * isz          # q block
-                     + 2 * bk * dhp * isz    # k + v blocks
-                     + bq * dhp * isz        # out block
-                     + bq * dhp * 4          # f32 acc scratch
-                     + 2 * bq * 128 * 4      # m + l scratch
-                     + bq * 128 * 4          # lse block
-                     + 2 * bq * bk * 4)      # score + prob tiles
-        return footprint <= TUNE_VMEM_BUDGET_BYTES
+        return (bq * dhp * isz          # q block
+                + 2 * bk * dhp * isz    # k + v blocks
+                + bq * dhp * isz        # out block
+                + bq * dhp * 4          # f32 acc scratch
+                + 2 * bq * 128 * 4      # m + l scratch
+                + bq * 128 * 4          # lse block
+                + 2 * bq * bk * 4)      # score + prob tiles
+
+    def feasible(self, spec, schedule):
+        return self.footprint(spec, schedule) <= TUNE_VMEM_BUDGET_BYTES
 
     def seeds(self, spec):
         return [{"blocks": list(c)} for c in
@@ -488,16 +496,19 @@ class PoolBwdFamily(object):
         owb = int(round(float(genes["owb"])))
         return {"owb": max(1, min(ow, owb))}
 
-    def feasible(self, spec, schedule):
+    def footprint(self, spec, schedule):
         # the kernel planner's OWN footprint formula — shared, so the
         # feasibility gate can never drift from what Mosaic gets
-        from veles_tpu.ops.pool_bwd import (POOL_VMEM_BUDGET_BYTES,
-                                            pool_block_footprint)
+        from veles_tpu.ops.pool_bwd import pool_block_footprint
         n, h, w_sp, c, oh, ow, ky, kx, sy, sx = spec["shape"]
-        footprint = pool_block_footprint(
+        return pool_block_footprint(
             h, c, oh, schedule["owb"], (ky, kx), (sx, sy),
             _itemsize(spec["dtype"]))
-        return footprint <= POOL_VMEM_BUDGET_BYTES
+
+    def feasible(self, spec, schedule):
+        from veles_tpu.ops.pool_bwd import POOL_VMEM_BUDGET_BYTES
+        return (self.footprint(spec, schedule)
+                <= POOL_VMEM_BUDGET_BYTES)
 
     def seeds(self, spec):
         ow = spec["shape"][5]
@@ -566,6 +577,29 @@ def family_for(op):
         raise KeyError("unknown kernel family %r (have %s)" %
                        (op, sorted(FAMILIES)))
     return family
+
+
+def current_kernel_version(op):
+    """The family's CURRENT kernel algorithm version (the value its
+    ``*_spec`` builder rides in ``extra``) or None for families without
+    one — the measurement log's staleness coordinate: triples measured
+    on an old algorithm must not train the cost model for a new one."""
+    if op in ("matmul",):
+        from veles_tpu.ops.matmul import MATMUL_KERNEL_VERSION
+        return MATMUL_KERNEL_VERSION
+    if op == "matmul_int8":
+        from veles_tpu.ops.matmul_int8 import MATMUL_INT8_KERNEL_VERSION
+        return MATMUL_INT8_KERNEL_VERSION
+    if op == "conv_vjp":
+        from veles_tpu.ops.conv_vjp import CONV_VJP_KERNEL_VERSION
+        return CONV_VJP_KERNEL_VERSION
+    if op == "attention":
+        from veles_tpu.ops.attention import ATTENTION_KERNEL_VERSION
+        return ATTENTION_KERNEL_VERSION
+    if op == "pool_bwd":
+        from veles_tpu.ops.pool_bwd import POOL_BWD_KERNEL_VERSION
+        return POOL_BWD_KERNEL_VERSION
+    return None
 
 
 def valid_schedule(op, schedule):
