@@ -104,6 +104,10 @@ SECTION_EST = {
     # two small in-process serve hosts + ~2 s of closed-loop
     # measurement per leg, interleaved off/on passes
     "hedge_ab": 40.0,
+    # multi-tenant QoS A/B (docs/serving.md "Multi-tenant QoS"): one
+    # in-process batcher, interleaved flood legs with class-ordered
+    # shedding off/on + the quiet anchor leg
+    "qos_ab": 30.0,
 }
 
 # a section whose dominant cost (the one-time server compile) loosely
@@ -191,6 +195,10 @@ def _compact_record(value, small, extras):
     hedge = extras.get("hedge_ab") or {}
     if hedge.get("hedge_p99_cut_pct") is not None:
         rec["hedge_p99_cut"] = hedge["hedge_p99_cut_pct"]
+    qos = extras.get("qos_ab") or {}
+    if qos.get("qos_interactive_p99_guard") is not None:
+        rec["qos_interactive_p99_guard"] = \
+            qos["qos_interactive_p99_guard"]
     if "wall_s" in extras:
         rec["wall_s"] = extras["wall_s"]
     if extras.get("shed"):
@@ -1766,6 +1774,135 @@ def bench_hedge_ab(small):
     }
 
 
+def bench_qos_ab(small):
+    """Multi-tenant QoS A/B (docs/serving.md "Multi-tenant QoS"):
+    closed-loop interactive p50/p99 through one in-process batcher
+    while a best-effort tenant floods the queue, class-ordered
+    shedding OFF vs ON — the noisy-neighbor shape the QoS layer
+    exists for.  OFF labels the flood like everything else (the
+    un-classed system's behavior: FIFO equality, interactive waits
+    behind the storm); ON labels it ``best_effort`` so interactive
+    admissions evict flood rows.  Passes are interleaved and the
+    published guard is the median per-pass p99 ratio off/on; the
+    quiet leg (no flood) anchors what p99 costs when nobody floods.
+    The subprocess-host soak is scripts/qos_soak.py -> QOS.json."""
+    import threading as _threading
+
+    from veles_tpu.backends import Device
+    from veles_tpu.compiler import LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.observe.metrics import percentiles as _percentiles
+    from veles_tpu.observe.metrics import registry as _reg
+    from veles_tpu.serve import (
+        AOTEngine, ContinuousBatcher, ServeOverload)
+
+    fan_in, hidden, classes = 16, 24, 4
+    rng = numpy.random.RandomState(0)
+    plans = [LayerPlan(All2AllTanh), LayerPlan(All2AllSoftmax)]
+    params = [
+        {"weights": rng.rand(fan_in, hidden).astype(numpy.float32),
+         "bias": rng.rand(hidden).astype(numpy.float32)},
+        {"weights": rng.rand(hidden, classes).astype(numpy.float32),
+         "bias": rng.rand(classes).astype(numpy.float32)},
+    ]
+    engine = AOTEngine(plans, params, (fan_in,), ladder=(8, 32),
+                       device=Device())
+    engine.compile()
+    # a small bound so the flood actually saturates it: the A/B is
+    # about WHO gets the queue, not how big the queue is
+    batcher = ContinuousBatcher(engine, max_delay_s=0.001,
+                                max_queue=64).start()
+    samples = rng.rand(64, fan_in).astype(numpy.float32)
+    duration = 0.8 if small else 1.5
+    passes = 3
+
+    def leg(flood_class, flood=True):
+        latencies, lock = [], _threading.Lock()
+        shed_int0 = _reg.counter(
+            "serve.tenant.interactive.shed").value
+        stop_at = time.perf_counter() + duration
+
+        def flooder(k):
+            n = 0
+            while time.perf_counter() < stop_at:
+                try:
+                    batcher.submit(samples[(k * 17 + n) % 64],
+                                   slo_class=flood_class)
+                except ServeOverload:
+                    pass  # the storm being shed is the point
+                n += 1
+                if n % 64 == 0:
+                    time.sleep(0.001)  # ~flood pace, not a spin
+
+        def client(k):
+            mine, n, sheds = [], 0, 0
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    req = batcher.submit(samples[(k * 31 + n) % 64],
+                                         slo_class="interactive")
+                    req.done.wait(30.0)
+                    if req.error is not None:
+                        raise req.error
+                except ServeOverload:
+                    sheds += 1
+                    continue
+                finally:
+                    n += 1
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                latencies.extend(mine)
+
+        threads = [_threading.Thread(target=client, args=(k,))
+                   for k in range(2)]
+        if flood:
+            threads += [_threading.Thread(target=flooder, args=(k,))
+                        for k in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ps = _percentiles(latencies)
+        return {"requests": len(latencies),
+                "interactive_sheds": _reg.counter(
+                    "serve.tenant.interactive.shed").value - shed_int0,
+                **{p: round(v * 1e3, 3) for p, v in ps.items()}}
+
+    try:
+        quiet = leg("interactive", flood=False)
+        rows = {"off": [], "on": []}
+        ratios = []
+        for _ in range(passes):
+            # OFF: the flood is indistinguishable from everyone else
+            # (the pre-QoS world) -> interactive queues behind it.
+            # ON: the flood is labelled best_effort -> interactive
+            # admissions evict it (SHED_ORDER contract)
+            off = leg("interactive")
+            on = leg("best_effort")
+            rows["off"].append(off)
+            rows["on"].append(on)
+            if on["p99"]:
+                ratios.append(off["p99"] / on["p99"])
+    finally:
+        batcher.stop()
+    guard = (round(float(numpy.median(ratios)), 2)
+             if ratios else None)
+    return {
+        "clients": 2,
+        "flooders": 3,
+        "passes": passes,
+        "max_queue": 64,
+        "quiet": quiet,
+        "off": rows["off"],
+        "on": rows["on"],
+        # >1 means class-ordered shedding cut the flooded interactive
+        # p99 by that factor vs the unlabelled-flood world
+        "qos_interactive_p99_guard": guard,
+        "on_interactive_sheds": sum(
+            r["interactive_sheds"] for r in rows["on"]),
+    }
+
+
 def _build_native():
     from veles_tpu import native
     native.build_native()
@@ -1962,6 +2099,13 @@ def main():
     hedge_res = section("hedge_ab", lambda: bench_hedge_ab(small))
     if hedge_res is not None:
         extras["hedge_ab"] = hedge_res
+
+    # multi-tenant QoS A/B (docs/serving.md "Multi-tenant QoS"):
+    # flooded interactive p99 with class-ordered shedding off vs on,
+    # plus the quiet anchor leg
+    qos_res = section("qos_ab", lambda: bench_qos_ab(small))
+    if qos_res is not None:
+        extras["qos_ab"] = qos_res
 
     # AlexNet rows, one program (= one ~60-200 s server compile) each.
     # Batch 256 bf16 = the throughput/MFU sweet spot and the only

@@ -30,11 +30,17 @@ Usage::
 
     python scripts/fleet_soak.py --out HEDGE.json          # full
     python scripts/fleet_soak.py --fast --out /tmp/H.json  # smoke
+    python scripts/fleet_soak.py --tenants --out QOS.json  # QoS soak
 
-The fast profile is the slow-marked test in
-tests/test_serve_fleet.py; the full profile is the committed
-HEDGE.json receipt.  (``--host`` is the internal serve-host
-subprocess entry the driver spawns.)
+``--tenants`` reuses the same subprocess-host harness for the
+multi-tenant QoS receipt (:func:`run_tenant_soak` -> QOS.json; see
+scripts/qos_soak.py for the dedicated entry): a best-effort flood
+plus seeded stalls against interactive SLO clients, then the fleet
+canary promote/poison-rollback cycle.  The fast profile is the
+slow-marked test in tests/test_serve_fleet.py (tests/test_qos.py for
+``--tenants``); the full profile is the committed HEDGE.json /
+QOS.json receipt.  (``--host`` is the internal serve-host subprocess
+entry the driver spawns.)
 """
 
 import argparse
@@ -412,6 +418,291 @@ def run_soak(seed=11, fast=False, out=None, p99_bound_s=2.0):
     return receipt
 
 
+_QOS_COUNTERS = ("serve.fleet.shed",
+                 "serve.tenant.interactive.shed",
+                 "serve.tenant.batch.shed",
+                 "serve.tenant.best_effort.shed",
+                 "serve.hedge.fired",
+                 "serve.hedge.budget_exhausted",
+                 "serve.fleet.canary.mirrors",
+                 "serve.fleet.canary.promotions",
+                 "serve.fleet.canary.rollbacks")
+
+
+def run_tenant_soak(seed=11, fast=False, out=None, slo_p99_s=2.0):
+    """`--tenants` mode -> QOS.json (docs/serving.md "Multi-tenant
+    QoS"): the same subprocess-host harness as the kill/hedge soak,
+    pointed at the QoS contracts.
+
+    - **flood**: a 3x best-effort tenant flood plus seeded per-host
+      ``serve.host.stall`` stragglers against steady interactive
+      clients through a ``--max-inflight``-bounded fleet front:
+      interactive p99 must stay within the SLO budget, with **0
+      interactive sheds** — every shed the flood causes attributed to
+      best_effort/batch (the class-ordered eviction contract).
+    - **canary**: :class:`FleetCanaryController` promotes a good
+      snapshot host-by-host and auto-rolls back a class-permuted
+      poison on real mirrored evidence — 0 failed interactive
+      requests, 0 new compiles either way.  This phase runs the hosts
+      in-process (socketpair adoption): ``LocalHostControl`` stages
+      params straight into a host's engines, which is the driver-side
+      stand-in for what a production host's freshness watcher does on
+      its own machine.
+    """
+    from veles_tpu import chaos  # noqa: F401  (parity with run_soak)
+    from veles_tpu.serve import FleetRouter, HedgeBudget, ServeOverload
+
+    workdir = tempfile.mkdtemp(prefix="qos_soak_")
+    engine, _ = _build_engine(seed)
+    rng = numpy.random.RandomState(seed + 1)
+    samples = rng.rand(64, *SAMPLE_SHAPE).astype(numpy.float32)
+    reference = {"samples": samples, "ref": engine.infer(samples)}
+
+    # ---- phase A: best-effort flood + stalls vs interactive SLO ---------
+    duration = 6.0 if fast else 20.0
+    clients = 3 if fast else 4
+    flooders = 3  # the "3x" flood: 3 flooder threads per client pool
+    stall = "seed=%d;serve.host.stall=stall:p0.05:0.15"
+    hosts = [_HostProc("q%d" % i, seed,
+                       os.path.join(workdir, "cache_q%d" % i),
+                       chaos_spec=stall % (seed + 100 * (i + 1)))
+             for i in range(2)]
+    # the front bound is what the flood saturates: small enough that
+    # eviction provably happens, large enough that the interactive
+    # pool (clients << bound) never saturates it with its own class
+    max_inflight = 32
+    router = FleetRouter(hedge_factor=2.0, hedge_floor_s=0.03,
+                         hedge_tick_s=0.01,
+                         hedge_budget=HedgeBudget(),
+                         max_inflight=max_inflight).start()
+    for h in hosts:
+        router.add_host(address=("127.0.0.1", h.port),
+                        host_id=h.host_id)
+    before = _counters(_QOS_COUNTERS)
+    stop_at = time.perf_counter() + duration
+    lock = threading.Lock()
+    stats = {"latencies": [], "failures": [], "mismatches": 0,
+             "interactive_sheds": 0, "flood_submitted": 0,
+             "flood_shed": 0}
+
+    def interactive_client(k):
+        mine, fail, bad, sheds = [], [], 0, 0
+        n = 0
+        while time.perf_counter() < stop_at:
+            idx = (k * 131 + n) % len(samples)
+            n += 1
+            t0 = time.perf_counter()
+            try:
+                out = router.infer(samples[idx], timeout=30.0,
+                                   slo_class="interactive")
+            except ServeOverload as exc:
+                sheds += 1
+                fail.append("ServeOverload: %s" % exc)
+                continue
+            except Exception as exc:
+                fail.append("%s: %s" % (type(exc).__name__, exc))
+                continue
+            mine.append(time.perf_counter() - t0)
+            if not (out == reference["ref"][idx]).all():
+                bad += 1
+        with lock:
+            stats["latencies"].extend(mine)
+            stats["failures"].extend(fail)
+            stats["mismatches"] += bad
+            stats["interactive_sheds"] += sheds
+
+    def flooder(k):
+        n, shed = 0, 0
+        while time.perf_counter() < stop_at:
+            try:
+                # fire-and-forget: the storm wants the queue, not the
+                # answers — exactly the noisy-neighbor shape
+                router.submit(samples[(k * 17 + n) % 64],
+                              slo_class="best_effort")
+            except ServeOverload:
+                shed += 1
+            n += 1
+            if n % 16 == 0:
+                time.sleep(0.002)
+        with lock:
+            stats["flood_submitted"] += n
+            stats["flood_shed"] += shed
+
+    threads = [threading.Thread(target=interactive_client, args=(k,),
+                                name="qos-int-%d" % k)
+               for k in range(clients)]
+    threads += [threading.Thread(target=flooder, args=(k,),
+                                 name="qos-flood-%d" % k)
+                for k in range(flooders)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # drain the storm's stragglers before reading counters/stopping
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and \
+            sum(router.snapshot()["unresolved"].values()):
+        time.sleep(0.05)
+    flood_counters = {name: value - before[name]
+                      for name, value in _counters(_QOS_COUNTERS).items()}
+    router.stop()
+    for h in hosts:
+        h.stop()
+    flood = {
+        "clients": clients,
+        "flooders": flooders,
+        "duration_s": duration,
+        "max_inflight": max_inflight,
+        "straggler_chaos": stall % seed +
+            " (per host, independent seed offsets)",
+        "interactive_ok": len(stats["latencies"]),
+        "interactive_failed": len(stats["failures"]),
+        "failed_detail": stats["failures"][:5],
+        "interactive_sheds": stats["interactive_sheds"],
+        "bit_identical": stats["mismatches"] == 0,
+        "flood_submitted": stats["flood_submitted"],
+        "flood_shed_client_side": stats["flood_shed"],
+        "interactive_latency_ms": _pcts(stats["latencies"]),
+        "slo_p99_bound_s": slo_p99_s,
+        "counters": flood_counters,
+    }
+    p99 = (flood["interactive_latency_ms"] or {}).get("p99")
+
+    # ---- phase B: fleet canary promote + poison rollback ----------------
+    # in-process hosts: LocalHostControl needs engine access (see
+    # docstring) — the router/mirror/judge path is the same code the
+    # socketpair fleet tests and a remote fleet run
+    import socket as _socket
+    from veles_tpu.backends import Device
+    from veles_tpu.serve import (
+        AOTEngine, BinaryTransportServer, ContinuousBatcher)
+    from veles_tpu.serve.freshness import (
+        FleetCanaryController, LocalHostControl)
+
+    plans, good = _mlp_spec(seed)
+    poison = [dict(p) for p in good]
+    poison[1] = dict(poison[1],
+                     weights=numpy.ascontiguousarray(
+                         good[1]["weights"][:, ::-1]),
+                     bias=numpy.ascontiguousarray(good[1]["bias"][::-1]))
+    entries = []
+    for i in range(2):
+        eng = AOTEngine(plans, good, SAMPLE_SHAPE, ladder=LADDER,
+                        device=Device(backend="cpu"))
+        eng.compile()
+        batcher = ContinuousBatcher(eng, max_delay_s=0.002).start()
+        server = BinaryTransportServer(
+            batcher, port=None, host_meta={"host_id": "c%d" % i})
+        server.start_background()
+        entries.append((eng, batcher, server))
+    router = FleetRouter(hedge=False).start()
+    for _, _, server in entries:
+        ours, theirs = _socket.socketpair()
+        server.serve_socket(ours)
+        router.add_host(sock=theirs)
+    host_ids = sorted(router.snapshot()["hosts"])
+    controls = {hid: LocalHostControl(entries[i][1])
+                for i, hid in enumerate(host_ids)}
+    controller = FleetCanaryController(
+        router, controls, mirror_fraction=1.0, min_mirrors=8,
+        divergence_limit=1e-4, breach_budget=2,
+        verdict_timeout_s=60.0, seed=seed)
+    canary_stats = {"failures": 0, "mismatches": 0, "served": 0}
+    canary_stop = threading.Event()
+
+    def canary_traffic():
+        n = 0
+        while not canary_stop.is_set():
+            idx = n % len(samples)
+            n += 1
+            try:
+                out = router.infer(samples[idx], timeout=30.0,
+                                   slo_class="interactive")
+            except Exception:
+                canary_stats["failures"] += 1
+                continue
+            canary_stats["served"] += 1
+            if not (out == reference["ref"][idx]).all():
+                canary_stats["mismatches"] += 1
+
+    traffic = threading.Thread(target=canary_traffic,
+                               name="qos-canary-traffic")
+    traffic.start()
+    try:
+        promote_receipt = controller.run(good, host_ids[0])
+        rollback_receipt = controller.run(poison, host_ids[0])
+    finally:
+        canary_stop.set()
+        traffic.join(timeout=30)
+    # post-rollback: the fleet still answers with the good weights
+    post_ok = all(
+        (router.infer(samples[i], timeout=30.0)
+         == reference["ref"][i]).all() for i in range(8))
+    router.stop()
+    for _, batcher, server in entries:
+        server.stop()
+        batcher.stop()
+    canary = {
+        "hosts": "2 in-process (socketpair adoption; see docstring)",
+        "promote": promote_receipt,
+        "rollback": rollback_receipt,
+        "interactive_served": canary_stats["served"],
+        "interactive_failed": canary_stats["failures"],
+        "bit_identical": canary_stats["mismatches"] == 0,
+        "post_rollback_bit_identical": post_ok,
+    }
+
+    checks = {
+        "interactive_p99_within_slo": (p99 is not None and
+                                       p99 / 1e3 <= slo_p99_s),
+        "zero_interactive_sheds":
+            stats["interactive_sheds"] == 0 and
+            flood_counters["serve.tenant.interactive.shed"] == 0,
+        "zero_interactive_failures": flood["interactive_failed"] == 0,
+        "sheds_attributed_to_lower_classes":
+            flood_counters["serve.tenant.best_effort.shed"] > 0,
+        "flood_bit_identical": flood["bit_identical"],
+        "canary_promoted":
+            promote_receipt.get("verdict") == "promote",
+        "canary_rolled_back":
+            rollback_receipt.get("verdict") == "rolled_back",
+        "canary_zero_new_compiles":
+            promote_receipt.get("new_compiles") == 0 and
+            rollback_receipt.get("new_compiles") == 0,
+        "canary_zero_failed_interactive":
+            canary["interactive_failed"] == 0,
+        "canary_bit_identical": canary["bit_identical"] and
+            canary["post_rollback_bit_identical"],
+    }
+    receipt = {
+        "schema": 1,
+        "mode": "fast" if fast else "full",
+        "seed": seed,
+        "ladder": list(LADDER),
+        "flood": flood,
+        "canary": canary,
+        "checks": checks,
+        "passed": all(checks.values()),
+    }
+    if out:
+        with open(out, "w") as fout:
+            json.dump(receipt, fout, indent=1, sort_keys=True)
+            fout.write("\n")
+    print("qos soak %s: interactive %d ok / %d failed / %d shed "
+          "(p99 %.1fms), best_effort sheds %d, canary %s/%s "
+          "(compiles %s/%s)"
+          % ("PASSED" if receipt["passed"] else "FAILED",
+             flood["interactive_ok"], flood["interactive_failed"],
+             flood["interactive_sheds"],
+             (p99 if p99 is not None else float("nan")),
+             flood_counters["serve.tenant.best_effort.shed"],
+             promote_receipt.get("verdict"),
+             rollback_receipt.get("verdict"),
+             promote_receipt.get("new_compiles"),
+             rollback_receipt.get("new_compiles")))
+    return receipt
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--host", action="store_true",
@@ -421,16 +712,29 @@ def main(argv=None):
     parser.add_argument("--seed", type=int, default=11)
     parser.add_argument("--fast", action="store_true",
                         help="smoke profile (the slow-marked test)")
+    parser.add_argument("--tenants", action="store_true",
+                        help="multi-tenant QoS soak -> QOS.json "
+                        "(flood + fleet canary) instead of the "
+                        "kill/hedge phases")
     parser.add_argument("--p99-bound-s", type=float, default=2.0,
                         help="absolute p99 bound for the kill phase "
                         "(CPU-scale; the bound is about NOT hanging, "
                         "the receipt records the measured value)")
-    parser.add_argument("--out", default="HEDGE.json")
+    parser.add_argument("--slo-p99-s", type=float, default=2.0,
+                        help="interactive p99 SLO budget for the "
+                        "--tenants flood phase (CPU-scale)")
+    parser.add_argument("--out", default=None)
     args = parser.parse_args(argv)
     if args.host:
         return host_main(args)
-    receipt = run_soak(seed=args.seed, fast=args.fast, out=args.out,
-                       p99_bound_s=args.p99_bound_s)
+    if args.tenants:
+        receipt = run_tenant_soak(seed=args.seed, fast=args.fast,
+                                  out=args.out or "QOS.json",
+                                  slo_p99_s=args.slo_p99_s)
+    else:
+        receipt = run_soak(seed=args.seed, fast=args.fast,
+                           out=args.out or "HEDGE.json",
+                           p99_bound_s=args.p99_bound_s)
     return 0 if receipt["passed"] else 1
 
 

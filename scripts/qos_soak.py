@@ -1,0 +1,41 @@
+"""Multi-tenant QoS soak -> QOS.json receipt.
+
+The acceptance proof of the QoS layer (docs/serving.md "Multi-tenant
+QoS", ISSUE 17), run on the SAME subprocess-host soak machinery as
+scripts/fleet_soak.py (this entry is ``fleet_soak.py --tenants`` with
+QoS defaults):
+
+- **flood**: a 3x best-effort tenant flood plus seeded per-host
+  ``serve.host.stall`` stragglers against steady interactive clients
+  through a bounded fleet front — interactive p99 within the SLO
+  budget, **0 interactive sheds**, every shed attributed to
+  best_effort/batch by the class-ordered eviction contract, every
+  interactive answer bit-identical to the sequential reference.
+- **canary**: :class:`FleetCanaryController` promotes a good snapshot
+  host-by-host and auto-rolls back a class-permuted poison judged on
+  real mirrored evidence — **0 failed interactive requests, 0 new
+  compiles** either way.
+
+Usage::
+
+    python scripts/qos_soak.py --out QOS.json          # full
+    python scripts/qos_soak.py --fast --out /tmp/Q.json  # smoke
+
+The fast profile is the slow-marked test in tests/test_qos.py; the
+full profile is the committed QOS.json receipt.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+if __name__ == "__main__":
+    from scripts import fleet_soak
+    argv = list(sys.argv[1:])
+    if "--host" not in argv:
+        argv.insert(0, "--tenants")
+    sys.exit(fleet_soak.main(argv))

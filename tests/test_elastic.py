@@ -545,6 +545,47 @@ def test_drop_during_apply_defers_requeue_never_doubles():
         thread.join(10)
 
 
+def test_stop_during_apply_drains_bookkeeping_before_done():
+    """Regression for the server-side lost-update race behind the
+    kill-during-reshard flake: a workflow that completes INSIDE
+    check_and_apply (the decision latching ``complete`` on the
+    executor thread) schedules the stop via call_soon_threadsafe
+    BEFORE the executor future's own continuation, so _main could
+    return — and asyncio.run cancel the _apply_update coroutine —
+    after the weights mutated but before the bookkeeping
+    (updates_applied, the ack, deferred drops) ran.  The teardown now
+    drains ``_applying`` first: _done must not fire while an apply is
+    mid-executor, and the counter must reflect every update the
+    workflow actually absorbed."""
+    master = _StubMaster(["j1"])
+    master.apply_gate = threading.Event()
+    server, thread = _stub_server(master)
+    wf = _StubSlave()
+    client = Client("127.0.0.1:%d" % server.port, wf)
+    cthread = client.start_background()
+    try:
+        # j1's update arrives and its apply wedges on the executor
+        assert master.apply_started.wait(60)
+        # the stop lands while the apply is still in flight — the
+        # exact scheduling the completion-inside-apply race produces
+        server.stop()
+        assert not server._done.wait(0.5), \
+            "teardown must drain the in-flight apply, not bail"
+        assert server.updates_applied == 0
+        master.apply_gate.set()
+        assert server._done.wait(30)
+        assert master.applied and master.applied[0][0] == "j1", \
+            "the wedged apply must have reached the workflow"
+        assert server.updates_applied == 1, \
+            "an apply that mutated the workflow must be counted"
+    finally:
+        master.apply_gate.set()
+        server.stop()
+        server._done.wait(10)
+        thread.join(10)
+        cthread.join(10)
+
+
 # -- speculative backup dispatch (lifted from the jobfarm) ----------------
 
 
@@ -821,8 +862,18 @@ def test_poisoned_backup_with_dropped_owner_not_reinstated(monkeypatch):
                   what="poisoned apply in flight")
         server._loop.call_soon_threadsafe(server._drop, a_conn,
                                           "owner-timeout")
+        # wait for the COUNTER, not the dropped flag: _drop sets
+        # conn.dropped several statements (including a log call)
+        # before it bumps drops_deferred, all on the loop thread —
+        # under full-suite load the test thread can observe the flag
+        # and read the counter inside that window.  The deferral
+        # itself is guaranteed (the apply is wedged on poison_gate),
+        # so waiting loses no strictness: an immediate drop would
+        # never bump the counter and still fails here.
         _wait_for(lambda: a_conn.dropped, timeout=30,
                   what="owner drop flag")
+        _wait_for(lambda: server.drops_deferred == 1, timeout=30,
+                  what="deferred-drop counter")
         assert server.drops_deferred == 1
         poison_gate.set()
         _wait_for(lambda: a_sid in master.drops, timeout=30,

@@ -1,0 +1,628 @@
+"""Multi-tenant QoS (veles_tpu/serve/qos.py, docs/serving.md
+"Multi-tenant QoS"): token-bucket quota math including burst/refill
+edges, class-ordered shedding under a full queue with the
+interactive-starves-last invariant, deterministic seeded per-class
+``retry_after`` jitter, per-class hedge-budget exhaustion that routes
+normally (never fails a request), wire-level tenant/class labels with
+per-tenant quota rejection at the binary transport, tenant metrics in
+``serve_snapshot``, and the fleet canary promote/auto-rollback e2e
+over in-process socketpair hosts with the 0-new-compiles swap receipt
+and mirrored traffic excluded from the served counters."""
+
+import socket
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu import chaos
+from veles_tpu.backends import Device
+from veles_tpu.observe.metrics import registry
+from veles_tpu.serve import (
+    AOTEngine, BinaryTransportClient, BinaryTransportServer,
+    ContinuousBatcher, FleetRouter, HedgeBudget, RetryJitter,
+    ServeOverload, TenantQuota, normalize_class, parse_quota_spec,
+    serve_snapshot)
+from veles_tpu.serve.freshness import (
+    FleetCanaryController, LocalHostControl)
+from veles_tpu.serve.qos import TokenBucket, class_rank
+from tests.test_serve import _mlp_spec
+from tests.test_serve_fleet import _Hosts
+
+pytestmark = [pytest.mark.serve, pytest.mark.qos]
+
+
+def _counter(name):
+    return registry.counter(name).value
+
+
+class _Clock(object):
+    """Injectable deterministic clock for the bucket math."""
+
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+# -- token-bucket quota math --------------------------------------------------
+
+
+def test_token_bucket_burst_and_refill_edges():
+    clock = _Clock()
+    bucket = TokenBucket(rate=2.0, burst=4.0, clock=clock)
+    # starts full at burst
+    assert bucket.tokens == 4.0
+    for _ in range(4):
+        assert bucket.try_take()
+    assert not bucket.try_take(), "empty bucket must reject"
+    # refill accrues at rate, capped at burst
+    clock.now += 1.0
+    assert bucket.tokens == pytest.approx(2.0)
+    clock.now += 100.0
+    assert bucket.tokens == pytest.approx(4.0), "refill must cap at burst"
+    # time_until: deficit / rate, 0 when available, inf when impossible
+    assert bucket.time_until(3.0) == 0.0
+    assert bucket.try_take(4.0)
+    assert bucket.time_until(3.0) == pytest.approx(1.5)
+    assert bucket.time_until(100.0) == float("inf"), \
+        "a demand above burst can never be granted"
+
+
+def test_token_bucket_zero_rate_never_refills():
+    clock = _Clock()
+    bucket = TokenBucket(rate=0.0, burst=2.0, clock=clock)
+    assert bucket.try_take() and bucket.try_take()
+    clock.now += 1e6
+    assert not bucket.try_take(), "rate<=0 must never refill"
+    assert bucket.time_until() == float("inf")
+
+
+def test_parse_quota_spec_and_tenant_quota():
+    quotas = parse_quota_spec("acme=100:200,free_tier=5,*=50")
+    assert quotas == {"acme": (100.0, 200.0), "free_tier": (5.0, None),
+                      "*": (50.0, None)}
+    with pytest.raises(ValueError):
+        parse_quota_spec("missing_equals")
+    clock = _Clock()
+    quota = TenantQuota({"tiny": (0.0, 2.0)}, clock=clock)
+    # unlisted tenants without a '*' default are UNLIMITED: quota is
+    # opt-in, legacy traffic is never rejected by nobody's config
+    for _ in range(100):
+        assert quota.admit("anyone") is None
+        assert quota.admit(None) is None
+    # the listed tenant gets exactly its burst, then a wait hint
+    assert quota.admit("tiny") is None
+    assert quota.admit("tiny") is None
+    wait = quota.admit("tiny")
+    assert wait is not None and wait > 0
+
+
+def test_tenant_quota_default_and_anonymous_bucket():
+    clock = _Clock()
+    quota = TenantQuota({"*": (0.0, 1.0)}, clock=clock)
+    # each tenant gets its OWN default bucket...
+    assert quota.admit("a") is None
+    assert quota.admit("b") is None
+    assert quota.admit("a") is not None
+    # ...while all anonymous traffic shares ONE bucket
+    assert quota.admit(None) is None
+    assert quota.admit(None) is not None
+
+
+def test_normalize_class_and_rank():
+    assert normalize_class(None) == "batch"
+    assert normalize_class("INTERACTIVE") == "interactive"
+    assert normalize_class("best-effort") == "best_effort"
+    assert normalize_class("no_such_class") == "batch"
+    assert class_rank("best_effort") < class_rank("batch") < \
+        class_rank("interactive")
+
+
+def test_retry_jitter_distinct_and_deterministic():
+    jitter = RetryJitter(seed=7, spread=0.5)
+    a = jitter.apply(1.0, "interactive")
+    b = jitter.apply(1.0, "interactive")
+    # two clients shed with the same rejection must not re-stampede at
+    # the same instant (the satellite contract)
+    assert a != b
+    for v in (a, b):
+        assert 1.0 <= v <= 1.5
+    # per-class counters are independent streams
+    c = jitter.apply(1.0, "batch")
+    assert c != a
+    # same seed + same rejection sequence = same jitters (replayable)
+    replay = RetryJitter(seed=7, spread=0.5)
+    assert replay.apply(1.0, "interactive") == a
+    assert replay.apply(1.0, "interactive") == b
+
+
+# -- class-ordered shedding under a full queue --------------------------------
+
+
+class _GateDevice(object):
+    def put(self, x):
+        return numpy.asarray(x)
+
+
+class _GateEngine(object):
+    """Duck engine whose run() blocks on a gate Event: deterministic
+    queue pressure, no jax in the loop."""
+
+    dtype = numpy.float32
+    sample_shape = (4,)
+    max_batch = 1
+    digest = "gate"
+
+    def __init__(self):
+        self.device = _GateDevice()
+        self.gate = threading.Event()
+
+    def rung_for(self, n, cap=None):
+        return 1
+
+    def run(self, x_dev, rung):
+        assert self.gate.wait(30.0), "test gate never opened"
+        return numpy.asarray(x_dev) * 2.0
+
+
+def _occupy_worker(batcher):
+    """Park the worker inside run() so the queue holds what we put."""
+    head = batcher.submit(numpy.zeros(4, numpy.float32),
+                          slo_class="best_effort")
+    deadline = time.monotonic() + 10.0
+    while batcher._q.qsize() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert batcher._q.qsize() == 0, "worker never picked up the head"
+    return head
+
+
+def test_class_ordered_shedding_interactive_starves_last():
+    engine = _GateEngine()
+    batcher = ContinuousBatcher(engine, max_delay_s=0.0, max_queue=4,
+                                retry_jitter=RetryJitter(seed=3))
+    batcher.start()
+    shed_be = _counter("serve.tenant.best_effort.shed")
+    shed_batch = _counter("serve.tenant.batch.shed")
+    shed_int = _counter("serve.tenant.interactive.shed")
+    try:
+        head = _occupy_worker(batcher)
+        x = numpy.arange(4, dtype=numpy.float32)
+        b1 = batcher.submit(x, slo_class="best_effort")
+        b2 = batcher.submit(x, slo_class="best_effort")
+        n1 = batcher.submit(x, slo_class="batch")
+        n2 = batcher.submit(x)  # un-labelled legacy = batch
+        # queue is at max_queue=4: interactive admissions evict
+        # best_effort first, then batch — in that order
+        i1 = batcher.submit(x, slo_class="interactive")
+        assert b1.cancelled and isinstance(b1.error, ServeOverload)
+        assert "eviction" in str(b1.error)
+        i2 = batcher.submit(x, slo_class="interactive")
+        assert b2.cancelled and isinstance(b2.error, ServeOverload)
+        # victims of the same class get DISTINCT jittered retry_after
+        assert b1.error.retry_after != b2.error.retry_after
+        assert not n1.cancelled and not n2.cancelled
+        i3 = batcher.submit(x, slo_class="interactive")
+        assert n1.cancelled, "with best_effort drained, batch is next"
+        # an incoming batch request finds nothing STRICTLY lower
+        # pending: it is shed itself, the queued batch one survives
+        with pytest.raises(ServeOverload):
+            batcher.submit(x, slo_class="batch")
+        assert not n2.cancelled
+        with pytest.raises(ServeOverload):
+            batcher.submit(x, slo_class="best_effort")
+        # interactive starves LAST: nothing below it remains, so an
+        # interactive admission into interactive saturation sheds the
+        # INCOMING interactive request — never a queued one
+        n2.cancelled = True  # leave only interactive work pending
+        with pytest.raises(ServeOverload):
+            batcher.submit(x, slo_class="interactive")
+        for req in (i1, i2, i3):
+            assert not req.cancelled
+        # open the gate: every surviving request is served intact
+        engine.gate.set()
+        for req in (head, i1, i2, i3):
+            assert req.done.wait(10.0)
+            assert req.error is None
+            assert (req.result == req.sample * 2.0).all()
+    finally:
+        engine.gate.set()
+        batcher.stop()
+    assert _counter("serve.tenant.best_effort.shed") - shed_be == 3
+    assert _counter("serve.tenant.batch.shed") - shed_batch == 2
+    assert _counter("serve.tenant.interactive.shed") - shed_int == 1
+
+
+@pytest.mark.chaos
+def test_tenant_flood_chaos_is_shed_as_best_effort():
+    """``serve.tenant.flood`` storms the queue with synthetic
+    best_effort load; an interactive admission evicts flood rows, and
+    every shed the storm causes lands on best_effort."""
+    engine = _GateEngine()
+    batcher = ContinuousBatcher(engine, max_delay_s=0.0, max_queue=4)
+    batcher.start()
+    shed_int = _counter("serve.tenant.interactive.shed")
+    try:
+        head = _occupy_worker(batcher)
+        chaos.install(chaos.FaultPlan(seed=5).add(
+            "serve.tenant.flood", "storm", nth=1, param=8))
+        x = numpy.ones(4, numpy.float32)
+        req = batcher.submit(x, slo_class="interactive")
+        assert not req.cancelled
+        engine.gate.set()
+        assert req.done.wait(10.0) and req.error is None
+        assert head.done.wait(10.0)
+    finally:
+        chaos.uninstall()
+        engine.gate.set()
+        batcher.stop()
+    assert _counter("serve.tenant.best_effort.shed") > 0
+    assert _counter("serve.tenant.interactive.shed") == shed_int
+
+
+# -- tenant metrics in serve_snapshot ----------------------------------------
+
+
+def test_tenant_metrics_in_serve_snapshot_exclude_shadow():
+    plans, params = _mlp_spec(seed=9)
+    engine = AOTEngine(plans, params, (16,), ladder=(8, 32),
+                       device=Device(backend="cpu"))
+    engine.compile()
+    batcher = ContinuousBatcher(engine, max_delay_s=0.001).start()
+    served_int = _counter("serve.tenant.interactive.requests")
+    served_batch = _counter("serve.tenant.batch.requests")
+    try:
+        rng = numpy.random.RandomState(0)
+        x = rng.rand(16).astype(numpy.float32)
+        ref = engine.infer(x[None])[0]
+        assert (batcher.infer(x) == ref).all()  # legacy -> batch
+        out = batcher.submit(x, slo_class="interactive")
+        assert out.done.wait(10.0) and (out.result == ref).all()
+        # shadow/mirror traffic NEVER lands in the tenant counters
+        shadow = batcher.submit_shadow(x)
+        assert shadow.done.wait(10.0)
+        assert (shadow.result == ref).all()
+    finally:
+        batcher.stop()
+    assert _counter("serve.tenant.interactive.requests") \
+        - served_int == 1
+    assert _counter("serve.tenant.batch.requests") - served_batch == 1
+    block = serve_snapshot()
+    tenants = block["tenants"]
+    for cls in ("interactive", "batch"):
+        assert tenants[cls]["requests"] >= 1
+        assert tenants[cls]["latency_ms"]["p99"] >= \
+            tenants[cls]["latency_ms"]["p50"] >= 0
+
+
+# -- wire-level labels + quota at the binary transport ------------------------
+
+
+@pytest.mark.transport
+def test_transport_tenant_quota_and_class_labels():
+    plans, params = _mlp_spec(seed=2)
+    engine = AOTEngine(plans, params, (16,), ladder=(8, 32),
+                       device=Device(backend="cpu"))
+    engine.compile()
+    batcher = ContinuousBatcher(engine, max_delay_s=0.001).start()
+    quota = TenantQuota({"metered": (0.0, 2.0)})
+    server = BinaryTransportServer(batcher, port=None, quota=quota,
+                                   retry_jitter=RetryJitter(seed=1))
+    server.start_background()
+    clients = []
+
+    def connect(**kwargs):
+        ours, theirs = socket.socketpair()
+        server.serve_socket(ours)
+        client = BinaryTransportClient(sock=theirs, shm=False, **kwargs)
+        clients.append(client)
+        return client
+
+    try:
+        rng = numpy.random.RandomState(1)
+        x = rng.rand(16).astype(numpy.float32)
+        ref = engine.infer(x[None])
+        # un-labelled legacy client: served unchanged (class batch)
+        legacy = connect()
+        assert (legacy.infer(x) == ref).all()
+        # hello-labelled connection; burst of 2, then 503 + jittered
+        # retry_after — distinct across consecutive rejections
+        metered = connect(tenant="metered", slo_class="interactive")
+        assert (metered.infer(x) == ref).all()
+        assert (metered.infer(x) == ref).all()
+        with pytest.raises(ServeOverload) as exc1:
+            metered.infer(x)
+        with pytest.raises(ServeOverload) as exc2:
+            metered.infer(x)
+        assert exc1.value.retry_after > 0
+        assert exc1.value.retry_after != exc2.value.retry_after
+        # per-frame tenant override rides one frame only: the legacy
+        # connection charged as "metered" is rejected too...
+        with pytest.raises(ServeOverload):
+            legacy.infer(x, tenant="metered")
+        # ...and reverts to its (unlimited) connection default after
+        assert (legacy.infer(x) == ref).all()
+    finally:
+        for client in clients:
+            client.close()
+        server.stop()
+        batcher.stop()
+
+
+# -- per-class hedge budgets in the fleet router ------------------------------
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_hedge_budget_exhaustion_routes_normally_never_fails():
+    plans, params = _mlp_spec(seed=3)
+    hosts = _Hosts(2, plans, params)
+    budget = HedgeBudget({cls: (0.0, 0.0) for cls in
+                          ("interactive", "batch", "best_effort")})
+    router = FleetRouter(hedge_factor=1.2, hedge_floor_s=0.01,
+                         hedge_tick_s=0.01, hedge_warmup=2,
+                         hedge_budget=budget).start()
+    for i in range(2):
+        hosts.connect(router, i)
+    try:
+        rng = numpy.random.RandomState(4)
+        x = rng.rand(4, 16).astype(numpy.float32)
+        ref = hosts.entries[0][0].infer(x)
+        for i in range(router.hedge_warmup):  # arm the watchdog
+            router.infer(x[i % 4], timeout=15.0)
+        fired = _counter("serve.hedge.fired")
+        exhausted = _counter("serve.hedge.budget_exhausted")
+        chaos.install(chaos.FaultPlan(seed=1).add(
+            "serve.host.stall", "stall", times=2, param=0.4))
+        try:
+            # stalled requests age past the hedge threshold; the
+            # zero-token budget denies every hedge — the request rides
+            # out the stall on its primary copy and still completes
+            for i in range(4):
+                out = router.infer(x[i], timeout=15.0,
+                                   slo_class="interactive")
+                assert (out == ref[i]).all()
+        finally:
+            chaos.uninstall()
+        assert _counter("serve.hedge.fired") == fired, \
+            "an exhausted budget must suppress the hedge entirely"
+        assert _counter("serve.hedge.budget_exhausted") > exhausted
+    finally:
+        router.stop()
+        hosts.stop()
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_fleet_front_class_aware_inflight_bound():
+    """Past ``max_inflight`` the fleet front evicts a STRICTLY lower
+    class (shed on the victim), so the interactive request proceeds."""
+    plans, params = _mlp_spec(seed=3)
+    hosts = _Hosts(2, plans, params)
+    router = FleetRouter(hedge=False, max_inflight=2).start()
+    for i in range(2):
+        hosts.connect(router, i)
+    try:
+        rng = numpy.random.RandomState(6)
+        x = rng.rand(16).astype(numpy.float32)
+        ref = hosts.entries[0][0].infer(x[None])[0]
+        shed_be = _counter("serve.tenant.best_effort.shed")
+        chaos.install(chaos.FaultPlan(seed=2).add(
+            "serve.host.stall", "stall", times=2, param=0.6))
+        try:
+            victims = [router.submit(x, slo_class="best_effort")
+                       for _ in range(2)]
+            out = router.infer(x, timeout=15.0,
+                               slo_class="interactive")
+            assert (out == ref).all()
+        finally:
+            chaos.uninstall()
+        evicted = [v for v in victims
+                   if isinstance(v.error, ServeOverload)]
+        assert len(evicted) == 1, \
+            "exactly one lower-class victim makes room"
+        assert _counter("serve.tenant.best_effort.shed") - shed_be == 1
+        # the surviving best_effort entry still completes
+        survivor = [v for v in victims if v not in evicted][0]
+        assert survivor.done.wait(15.0)
+        if survivor.error is None:
+            assert (survivor.result == ref).all()
+    finally:
+        router.stop()
+        hosts.stop()
+
+
+# -- fleet canary: promote / auto-rollback e2e --------------------------------
+
+
+class _Traffic(object):
+    """Closed-loop interactive client thread driving the fleet front;
+    counts failures and checks bit-identity against the reference."""
+
+    def __init__(self, router, samples, reference):
+        self.router = router
+        self.samples = samples
+        self.reference = reference
+        self.served = 0
+        self.failed = 0
+        self.mismatched = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="qos-traffic")
+
+    def _loop(self):
+        i = 0
+        while not self._stop.is_set():
+            k = i % len(self.samples)
+            i += 1
+            try:
+                out = self.router.infer(self.samples[k], timeout=15.0,
+                                        slo_class="interactive")
+            except Exception:
+                self.failed += 1
+                continue
+            self.served += 1
+            if not (out == self.reference[k]).all():
+                self.mismatched += 1
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+
+@pytest.mark.fleet
+@pytest.mark.freshness
+def test_fleet_canary_promotes_good_and_rolls_back_poison():
+    plans, good = _mlp_spec(seed=3)
+    # the poison: same shapes/digest (it MUST pass the structural swap
+    # gate — the canary exists for what static checks cannot see), but
+    # the output classes permuted, so mirrored evidence diverges
+    poison = [dict(p) for p in good]
+    poison[1] = dict(poison[1],
+                     weights=numpy.ascontiguousarray(
+                         good[1]["weights"][:, ::-1]),
+                     bias=numpy.ascontiguousarray(good[1]["bias"][::-1]))
+    hosts = _Hosts(2, plans, good)
+    router = FleetRouter(hedge=False).start()
+    for i in range(2):
+        hosts.connect(router, i)
+    host_ids = sorted(router.snapshot()["hosts"])
+    controls = {hid: LocalHostControl(hosts.entries[i][1])
+                for i, hid in enumerate(host_ids)}
+    controller = FleetCanaryController(
+        router, controls, mirror_fraction=1.0, min_mirrors=4,
+        divergence_limit=1e-4, breach_budget=2, verdict_timeout_s=30.0,
+        seed=7)
+    rng = numpy.random.RandomState(8)
+    x = rng.rand(6, 16).astype(numpy.float32)
+    reference = hosts.entries[0][0].infer(x)
+    canary_host = host_ids[0]
+    mirrors = _counter("serve.fleet.canary.mirrors")
+    try:
+        # -- promote: a good candidate (same values -> divergence 0)
+        with _Traffic(router, x, reference) as traffic:
+            receipt = controller.run(good, canary_host)
+        assert receipt["verdict"] == "promote"
+        assert receipt["new_compiles"] == 0, \
+            "canary staging is swap-only: 0 new compiles"
+        assert receipt["mirrors"] >= 4
+        assert receipt["max_divergence"] == 0.0
+        assert traffic.failed == 0, \
+            "0 failed interactive requests through a promote cycle"
+        assert traffic.mismatched == 0 and traffic.served > 0
+        assert _counter("serve.fleet.canary.mirrors") > mirrors
+        # -- rollback: the class-permuted poison diverges on real
+        # mirrored evidence and the whole fleet auto-rolls back
+        with _Traffic(router, x, reference) as traffic:
+            receipt = controller.run(poison, canary_host)
+        assert receipt["verdict"] == "rolled_back"
+        assert receipt["new_compiles"] == 0
+        assert "divergence" in receipt["reason"]
+        assert traffic.failed == 0, \
+            "0 failed interactive requests through a rollback cycle"
+        assert traffic.mismatched == 0, \
+            "the poison must never answer a primary request"
+        # the fleet is whole again and still serves the good model
+        snap = router.snapshot()
+        assert snap["hosts_live"] == 2 and snap["canary"] is None
+        for i in range(6):
+            assert (router.infer(x[i], timeout=15.0)
+                    == reference[i]).all()
+        assert _counter("serve.fleet.canary.promotions") >= 1
+        assert _counter("serve.fleet.canary.rollbacks") >= 1
+    finally:
+        router.stop()
+        hosts.stop()
+
+
+@pytest.mark.fleet
+def test_canary_poison_never_served_and_mirrors_not_counted():
+    """While a poison is staged on the canary host, primary traffic is
+    bit-identical to the good model, and mirrored shadow frames are
+    excluded from the tenant served counters."""
+    plans, good = _mlp_spec(seed=3)
+    poison = [dict(p) for p in good]
+    poison[0] = dict(poison[0], weights=numpy.ascontiguousarray(
+        good[0]["weights"] * 50.0))
+    hosts = _Hosts(2, plans, good)
+    router = FleetRouter(hedge=False).start()
+    for i in range(2):
+        hosts.connect(router, i)
+    host_ids = sorted(router.snapshot()["hosts"])
+    controls = {hid: LocalHostControl(hosts.entries[i][1])
+                for i, hid in enumerate(host_ids)}
+    rng = numpy.random.RandomState(9)
+    x = rng.rand(4, 16).astype(numpy.float32)
+    reference = hosts.entries[0][0].infer(x)
+    try:
+        pairs = []
+        slice_ = router.begin_canary_slice(
+            host_ids[0], fraction=1.0, seed=1,
+            on_pair=lambda *pair: pairs.append(pair))
+        controls[host_ids[0]].stage(poison)
+        slice_.armed = True
+        served_int = _counter("serve.tenant.interactive.requests")
+        n = 24
+        for i in range(n):
+            out = router.infer(x[i % 4], timeout=15.0,
+                               slo_class="interactive")
+            assert (out == reference[i % 4]).all(), \
+                "primary traffic must never see the staged poison"
+        deadline = time.monotonic() + 10.0
+        while len(pairs) < n and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(pairs) == n, "fraction=1.0 mirrors every single"
+        # the shadow leg really ran the poison (evidence is real)...
+        assert any(not numpy.array_equal(p, s) for p, s, _, _ in pairs)
+        # ...but mirrors are EXCLUDED from tenant served accounting:
+        # only the n primary requests count
+        assert _counter("serve.tenant.interactive.requests") \
+            - served_int == n
+        controls[host_ids[0]].revert()
+        stats = router.end_canary_slice()
+        assert stats["mirrored"] == n and stats["pairs"] == n
+        assert stats["shadow_errors"] == 0
+        for i in range(4):
+            assert (router.infer(x[i], timeout=15.0)
+                    == reference[i]).all()
+    finally:
+        router.stop()
+        hosts.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_qos_soak_receipt(tmp_path):
+    """Acceptance (ISSUE 17): scripts/qos_soak.py floods real
+    subprocess hosts with a 3x best-effort storm under seeded stalls —
+    interactive p99 within the SLO budget, 0 interactive sheds, every
+    shed attributed to best_effort — then the fleet canary promotes a
+    good snapshot and rolls back a class-permuted poison with 0 failed
+    interactive requests and 0 new compiles.  The committed QOS.json
+    is this driver at full size."""
+    import json
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "QOS.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "qos_soak.py"),
+         "--out", str(out), "--fast"],
+        cwd=repo, timeout=900, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    receipt = json.loads(out.read_text())
+    assert receipt["passed"] is True
+    assert receipt["flood"]["interactive_sheds"] == 0
+    assert receipt["flood"]["counters"][
+        "serve.tenant.interactive.shed"] == 0
+    assert receipt["canary"]["promote"]["verdict"] == "promote"
+    assert receipt["canary"]["rollback"]["verdict"] == "rolled_back"
+    assert receipt["canary"]["rollback"]["new_compiles"] == 0
+    assert receipt["canary"]["interactive_failed"] == 0

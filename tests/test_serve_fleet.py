@@ -219,7 +219,7 @@ def test_cascade_then_503_with_fleet_minimum_retry_after(fleet):
     hosts, router, engine = fleet
 
     def shedding(retry_after):
-        def _admit():
+        def _admit(slo_class=None):
             raise _Overload("test shed", retry_after=retry_after)
         return _admit
 

@@ -109,6 +109,16 @@ point               module                     actions
                                                result exercises the
                                                duplicate-rejection
                                                fence deterministically)
+``serve.tenant.flood``  serve.batcher          (any action: ``param``
+                    (per admission)            — default 32 —
+                                               best_effort requests
+                                               flood the queue as real
+                                               load, so the class-
+                                               ordered shedder must
+                                               evict THEM to admit the
+                                               arriving request — the
+                                               QoS soak's noisy-
+                                               neighbor tenant)
 ==================  =========================  =========================
 
 (``snapshot.write`` also covers ``serve.freshness``'s

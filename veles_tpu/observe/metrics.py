@@ -232,6 +232,24 @@ _HEALTH_KEYS = (
     ("serve.hedge.fired", "hedges_fired"),
     ("serve.hedge.wins", "hedge_wins"),
     ("serve.hedge.duplicates_dropped", "hedge_duplicates_dropped"),
+    # multi-tenant QoS (veles_tpu/serve/qos.py): per-class served/shed
+    # accounting and the hedge-budget exhaustion count ride heartbeats
+    # so a post-mortem can see WHO an overload was shed onto — the
+    # contract is all sheds land on best_effort/batch before a single
+    # interactive request is touched; the full per-class block (with
+    # latency percentiles) is serve_snapshot()["tenants"]
+    ("serve.hedge.budget_exhausted", "hedge_budget_exhausted"),
+    ("serve.tenant.interactive.requests", "tenant_interactive_requests"),
+    ("serve.tenant.interactive.shed", "tenant_interactive_shed"),
+    ("serve.tenant.batch.requests", "tenant_batch_requests"),
+    ("serve.tenant.batch.shed", "tenant_batch_shed"),
+    ("serve.tenant.best_effort.requests", "tenant_best_effort_requests"),
+    ("serve.tenant.best_effort.shed", "tenant_best_effort_shed"),
+    # fleet canary (veles_tpu/serve/freshness.py FleetCanaryController):
+    # host-sliced mirror volume and promote/rollback outcomes
+    ("serve.fleet.canary.mirrors", "fleet_canary_mirrors"),
+    ("serve.fleet.canary.promotions", "fleet_canary_promotions"),
+    ("serve.fleet.canary.rollbacks", "fleet_canary_rollbacks"),
     # XLA introspection (observe/xla_introspect.py): live achieved-MFU
     # and compile accounting ride the same health surface
     ("xla.mfu_pct", "mfu_pct"),

@@ -35,6 +35,12 @@ inference runtime — rebuilt TPU-idiomatically in three layers:
   :class:`CanaryComparator`) over the router's canary state machine —
   promote fleet-wide or auto-roll back to the last-good digest with
   zero new compiles;
+- :mod:`veles_tpu.serve.qos` — multi-tenant QoS: SLO classes
+  (``interactive`` / ``batch`` / ``best_effort``), per-tenant
+  token-bucket admission quotas, class-ordered shedding
+  (:data:`~veles_tpu.serve.qos.SHED_ORDER`), per-class hedge budgets
+  and the seeded per-class ``retry_after`` jitter — the serve tier
+  degrades selectively under overload instead of uniformly;
 - :mod:`veles_tpu.serve.fleet` — the multi-host tier:
   :class:`FleetRouter` dispatches over many serve HOSTS (pipelined
   binary links, membership epochs via ``elastic.FleetView``,
@@ -49,6 +55,9 @@ snapshot; ``scripts/serve_load.py`` is the closed-loop load generator
 behind ``BENCH_serve.json``.
 """
 
+from veles_tpu.serve.qos import (  # noqa: F401
+    DEFAULT_CLASS, HedgeBudget, RetryJitter, SHED_ORDER, SLO_CLASSES,
+    TenantQuota, TokenBucket, normalize_class, parse_quota_spec)
 from veles_tpu.serve.batcher import (  # noqa: F401
     ContinuousBatcher, ServeOverload, serve_snapshot)
 from veles_tpu.serve.engine import (  # noqa: F401
@@ -57,8 +66,8 @@ from veles_tpu.serve.engine import (  # noqa: F401
 from veles_tpu.serve.fleet import (  # noqa: F401
     FleetRequest, FleetRouter, HostLink)
 from veles_tpu.serve.freshness import (  # noqa: F401
-    CanaryComparator, FreshnessController, SnapshotWatcher,
-    export_model_spec)
+    CanaryComparator, FleetCanaryController, FreshnessController,
+    LocalHostControl, SnapshotWatcher, export_model_spec)
 from veles_tpu.serve.router import (  # noqa: F401
     CanaryCutover, Replica, ReplicaPool, local_devices)
 from veles_tpu.serve.service import (  # noqa: F401
@@ -69,10 +78,14 @@ from veles_tpu.serve.transport import (  # noqa: F401
 
 __all__ = ["AOTEngine", "BinaryTransportClient",
            "BinaryTransportServer", "CanaryComparator",
-           "CanaryCutover", "ContinuousBatcher", "FleetRequest",
-           "FleetRouter", "FreshnessController", "HostLink",
-           "Replica", "ReplicaPool", "ServeOverload", "ServeService",
-           "SnapshotWatcher", "DEFAULT_LADDER", "decode_tensor",
+           "CanaryCutover", "ContinuousBatcher", "FleetCanaryController",
+           "FleetRequest", "FleetRouter", "FreshnessController",
+           "HedgeBudget", "HostLink", "LocalHostControl", "Replica",
+           "ReplicaPool", "RetryJitter", "ServeOverload",
+           "ServeService", "SnapshotWatcher", "TenantQuota",
+           "TokenBucket", "DEFAULT_CLASS", "DEFAULT_LADDER",
+           "SHED_ORDER", "SLO_CLASSES", "decode_tensor",
            "enable_persistent_cache", "encode_tensor",
            "export_model_spec", "format_result", "local_devices",
-           "model_digest", "serve_snapshot", "value_digest"]
+           "model_digest", "normalize_class", "parse_quota_spec",
+           "serve_snapshot", "value_digest"]

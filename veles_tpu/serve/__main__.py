@@ -62,6 +62,26 @@ def build_parser():
     parser.add_argument("--no-hedge", action="store_true",
                         help="--fleet: disable request hedging (the "
                         "straggler A/B's control leg)")
+    parser.add_argument("--tenant-quota", default=None,
+                        metavar="TENANT=RATE[:BURST],...",
+                        help="per-tenant token-bucket admission quotas "
+                        "(requests/s with optional burst; '*' sets the "
+                        "default for unlisted tenants, which are "
+                        "otherwise unlimited).  Over-quota requests "
+                        "get 503 + a per-class seeded-jittered "
+                        "retry_after; un-labelled traffic defaults to "
+                        "the 'batch' class (docs/serving.md "
+                        "'Multi-tenant QoS')")
+    parser.add_argument("--hedge-budget", action="store_true",
+                        help="--fleet: cap hedges per SLO class with "
+                        "per-class token budgets (exhausted budget = "
+                        "route normally, never fail)")
+    parser.add_argument("--max-inflight", type=int, default=None,
+                        help="--fleet: bound on unresolved front "
+                        "requests; past it the class-ordered shedder "
+                        "evicts best_effort, then batch — interactive "
+                        "only when the front is saturated with "
+                        "interactive work itself")
     parser.add_argument("--hedge-factor", type=float, default=2.0,
                         help="--fleet: hedge past factor x the mean "
                         "completed latency (throughput-corrected)")
@@ -209,13 +229,20 @@ def _fleet_front_main(args):
     """--fleet: the front tier — no local model, route over hosts."""
     from veles_tpu.serve import ServeService
     from veles_tpu.serve.fleet import FleetRouter
+    from veles_tpu.serve.qos import HedgeBudget, TenantQuota
     router = FleetRouter(hedge=not args.no_hedge,
                          hedge_factor=args.hedge_factor,
-                         hedge_floor_s=args.hedge_floor_ms / 1e3)
+                         hedge_floor_s=args.hedge_floor_ms / 1e3,
+                         hedge_budget=HedgeBudget()
+                         if args.hedge_budget else None,
+                         max_inflight=args.max_inflight)
     for address in args.fleet.split(","):
         router.add_host(address=address.strip())
+    quota = TenantQuota.from_spec(args.tenant_quota) \
+        if args.tenant_quota else None
     service = ServeService(router, port=args.port, path=args.path,
-                           transport_port=args.transport_port)
+                           transport_port=args.transport_port,
+                           quota=quota)
     service.start_background()
     snap = router.snapshot()
     print("fleet front on http://127.0.0.1:%d%s over %d host(s) "
@@ -246,9 +273,13 @@ def _fleet_host_main(args, pool, receipt, freshness=None):
     from veles_tpu.serve.transport import BinaryTransportServer
     host_id = args.host_id or "%s-%d" % (machine_id(), os.getpid())
     pool.start()
+    quota = None
+    if args.tenant_quota:
+        from veles_tpu.serve.qos import TenantQuota
+        quota = TenantQuota.from_spec(args.tenant_quota)
     transport = BinaryTransportServer(
         pool, port=args.transport_port or 0,
-        host_meta={"host_id": host_id})
+        host_meta={"host_id": host_id}, quota=quota)
     transport.start_background()
     # the READY line is the soak driver's handshake: parse, then dial
     print("FLEET_HOST_READY port=%d host_id=%s digest=%s "
@@ -317,10 +348,15 @@ def main(argv=None):
     if args.fleet_host:
         return _fleet_host_main(args, pool, receipt, freshness)
     loader = getattr(sw, "loader", None)
+    quota = None
+    if args.tenant_quota:
+        from veles_tpu.serve.qos import TenantQuota
+        quota = TenantQuota.from_spec(args.tenant_quota)
     service = ServeService(
         pool, port=args.port, path=args.path,
         labels_mapping=getattr(loader, "reversed_labels_mapping", None),
-        transport_port=args.transport_port, freshness=freshness)
+        transport_port=args.transport_port, freshness=freshness,
+        quota=quota)
     service.start_background()
     print("serving on http://127.0.0.1:%d%s with %d replica(s)%s  "
           "(compile receipt: %s)"

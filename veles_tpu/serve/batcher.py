@@ -31,12 +31,24 @@ bumps ``serve.slo_violations`` + records a trace/flight-recorder
 instant, so a post-mortem dump shows *when* the tail blew up, next to
 the batch spans that did it.
 
+Multi-tenant QoS (docs/serving.md "Multi-tenant QoS"): every request
+carries an SLO class (``interactive`` / ``batch`` / ``best_effort``;
+un-labelled traffic defaults to ``batch``) and the queue bound is
+class-aware — a full queue evicts a pending request of STRICTLY lower
+class (shed attributed to the victim's class, with a seeded per-class
+jittered ``retry_after``) before it sheds the incoming one, so
+interactive work starves last and is shed only when the queue is
+saturated with interactive work itself.
+
 Chaos points (docs/health.md table): ``serve.drop`` (submit-side shed),
 ``serve.stall`` (worker sleeps ``param`` seconds — trips the SLO
 watch), ``serve.oom`` (simulated RESOURCE_EXHAUSTED — exercises the
-degrade path).
+degrade path), ``serve.tenant.flood`` (``param`` synthetic best-effort
+requests storm the queue as real load — exercises class-ordered
+shedding).
 """
 
+import collections
 import queue
 import threading
 import time
@@ -49,6 +61,7 @@ from veles_tpu.memory import Array
 from veles_tpu.observe.metrics import percentiles
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
+from veles_tpu.serve import qos
 
 __all__ = ["ContinuousBatcher", "ServeOverload", "serve_snapshot"]
 
@@ -65,10 +78,16 @@ class ServeOverload(Exception):
 
 class _Request(object):
     __slots__ = ("sample", "enqueued", "done", "result", "error",
-                 "cancelled", "block", "shadow", "latency")
+                 "cancelled", "block", "shadow", "latency", "slo_class",
+                 "claimed")
 
-    def __init__(self, sample, block=False, shadow=False):
+    def __init__(self, sample, block=False, shadow=False,
+                 slo_class=None):
         self.sample = sample
+        #: canonical SLO class ("interactive" / "batch" /
+        #: "best_effort") — decides shed order under overload and which
+        #: serve.tenant.<class>.* series the request lands in
+        self.slo_class = qos.normalize_class(slo_class)
         self.enqueued = time.perf_counter()
         self.done = threading.Event()
         self.result = None
@@ -92,6 +111,11 @@ class _Request(object):
         #: the canary comparator reads it off shadow/primary pairs
         #: instead of re-timing around the Event wait
         self.latency = None
+        #: set by the worker when it dequeues the request: class-
+        #: ordered eviction must only cancel work still WAITING — a
+        #: claimed request is already being served, so evicting it
+        #: would not free queue capacity
+        self.claimed = False
 
     @property
     def rows(self):
@@ -114,11 +138,23 @@ class ContinuousBatcher(Logger):
 
     def __init__(self, engine, max_delay_s=0.002, max_queue=256,
                  slo_p50_ms=None, slo_p99_ms=None, slo_check_every=4,
-                 replica=None, **kwargs):
+                 replica=None, retry_jitter=None, **kwargs):
         super(ContinuousBatcher, self).__init__(**kwargs)
         self.engine = engine
         self.max_delay_s = float(max_delay_s)
         self.max_queue = int(max_queue)
+        #: seeded per-class retry_after jitter (satellite of the QoS
+        #: layer): synchronized clients shed together must not
+        #: re-stampede together
+        self.retry_jitter = retry_jitter if retry_jitter is not None \
+            else qos.RetryJitter()
+        #: pending requests a HIGHER class may evict when the queue is
+        #: full — interactive has no deque: it is never evicted, only
+        #: shed at its own admission when the queue is saturated with
+        #: interactive work itself (qos.SHED_ORDER contract)
+        self._evictable = {cls: collections.deque()
+                           for cls in qos.SHED_ORDER
+                           if cls != "interactive"}
         self.slo_p50_ms = slo_p50_ms
         self.slo_p99_ms = slo_p99_ms
         self.slo_check_every = max(1, int(slo_check_every))
@@ -240,31 +276,106 @@ class ContinuousBatcher(Logger):
         return min(5.0, max(0.05, per_batch * (
             1 + depth / float(self.engine.max_batch))))
 
-    def _admit(self):
-        """Shared admission control: running check, chaos shed, queue
-        bound.  Raises :class:`ServeOverload` when the request must be
-        shed."""
+    def _shed(self, slo_class, message):
+        """Account one shed against ``slo_class`` and raise the
+        overload with the class-jittered ``retry_after``."""
+        self._m_shed.inc()
+        qos.note_shed(slo_class)
+        retry = self.retry_jitter.apply(self._retry_after(), slo_class)
+        if _tracer.active:
+            _tracer.instant("serve.shed", cat="serve",
+                            depth=self._q.qsize(), slo_class=slo_class,
+                            retry_after=round(retry, 4))
+        raise ServeOverload(message, retry_after=retry)
+
+    def _evict_lower(self, incoming_cls):
+        """Cancel one pending request of STRICTLY lower class than
+        ``incoming_cls`` to make room; the shed is attributed to the
+        VICTIM's class.  Returns False when no lower-class work is
+        pending — the incoming request must be shed instead (so a
+        queue saturated with interactive work sheds interactive, and
+        nothing below interactive ever evicts it)."""
+        incoming_rank = qos.class_rank(incoming_cls)
+        for victim_cls in qos.SHED_ORDER:
+            if qos.class_rank(victim_cls) >= incoming_rank:
+                return False
+            dq = self._evictable[victim_cls]
+            while True:
+                try:
+                    victim = dq.popleft()
+                except IndexError:
+                    break
+                if victim.cancelled or victim.claimed or \
+                        victim.done.is_set():
+                    continue  # served, being served, or evicted
+                victim.cancelled = True
+                victim.error = ServeOverload(
+                    "shed for %s admission (class-ordered eviction)"
+                    % incoming_cls,
+                    retry_after=self.retry_jitter.apply(
+                        self._retry_after(), victim_cls))
+                self._m_shed.inc()
+                qos.note_shed(victim_cls)
+                if _tracer.active:
+                    _tracer.instant("serve.shed", cat="serve",
+                                    depth=self._q.qsize(),
+                                    slo_class=victim_cls,
+                                    evicted_for=incoming_cls)
+                victim.done.set()
+                return True
+        return False
+
+    def _flood(self, count):
+        """Chaos ``serve.tenant.flood``: enqueue ``count`` synthetic
+        zero-sample best_effort requests as REAL load (no waiter) —
+        the storm contends for queue capacity like any bulk tenant
+        would, and rows past the bound are shed like any best_effort."""
+        zero = numpy.zeros(self.engine.sample_shape, self.engine.dtype)
+        for _ in range(count):
+            if self._q.qsize() >= self.max_queue:
+                self._m_shed.inc()
+                qos.note_shed("best_effort")
+                continue
+            try:
+                self._enqueue(_Request(zero, slo_class="best_effort"))
+            except ServeOverload:
+                break  # racing a stop(): the storm dies with the queue
+
+    def _admit(self, slo_class=qos.DEFAULT_CLASS):
+        """Shared admission control: running check, chaos shed, class-
+        aware queue bound.  Raises :class:`ServeOverload` when the
+        request must be shed."""
         if self._thread is None or self._stop_:
             raise ServeOverload("batcher not running", retry_after=1.0)
         if chaos.plan is not None:
+            fault = chaos.plan.fire("serve.tenant.flood")
+            if fault is not None:
+                self._flood(int(fault.param) if fault.param else 32)
             fault = chaos.plan.fire("serve.drop")
             if fault is not None:
                 self._m_shed.inc()
-                raise ServeOverload("chaos: request dropped",
-                                    retry_after=self._retry_after())
-        if self._q.qsize() >= self.max_queue:
-            self._m_shed.inc()
-            retry = self._retry_after()
-            if _tracer.active:
-                _tracer.instant("serve.shed", cat="serve",
-                                depth=self._q.qsize(),
-                                retry_after=round(retry, 4))
-            raise ServeOverload(
-                "queue full (%d pending)" % self._q.qsize(),
-                retry_after=retry)
+                qos.note_shed(slo_class)
+                raise ServeOverload(
+                    "chaos: request dropped",
+                    retry_after=self.retry_jitter.apply(
+                        self._retry_after(), slo_class))
+        if self._q.qsize() >= self.max_queue and \
+                not self._evict_lower(slo_class):
+            self._shed(slo_class,
+                       "queue full (%d pending)" % self._q.qsize())
 
     def _enqueue(self, req):
         self._q.put(req)
+        if not req.shadow and req.slo_class in self._evictable:
+            dq = self._evictable[req.slo_class]
+            dq.append(req)
+            if len(dq) > 2 * self.max_queue:
+                # lazy compaction: drop served/evicted entries so the
+                # deque tracks only live pending work
+                live = [r for r in dq
+                        if not r.cancelled and not r.done.is_set()]
+                dq.clear()
+                dq.extend(live)
         if self._stop_:
             # lost the race with a concurrent stop(): its drain may
             # have already run, so complete the request here — nobody
@@ -276,18 +387,21 @@ class ContinuousBatcher(Logger):
         self._m_depth.set(self._q.qsize())
         return req
 
-    def submit(self, sample):
+    def submit(self, sample, slo_class=None):
         """Enqueue one sample; returns the pending request.  Raises
         :class:`ServeOverload` when shedding (full queue or chaos
-        ``serve.drop``)."""
-        self._admit()
+        ``serve.drop``).  ``slo_class`` labels the request for the QoS
+        layer (class-ordered shedding + per-class accounting);
+        un-labelled callers default to ``batch``."""
+        slo_class = qos.normalize_class(slo_class)
+        self._admit(slo_class)
         sample = numpy.ascontiguousarray(sample, self.engine.dtype)
         if sample.shape != self.engine.sample_shape:
             raise ValueError("expected sample shape %s, got %s" %
                              (self.engine.sample_shape, sample.shape))
-        return self._enqueue(_Request(sample))
+        return self._enqueue(_Request(sample, slo_class=slo_class))
 
-    def submit_block(self, block):
+    def submit_block(self, block, slo_class=None):
         """Enqueue a whole batch as ONE request whose rows stay in
         their caller-provided buffer.
 
@@ -302,7 +416,8 @@ class ContinuousBatcher(Logger):
         instead of a Python loop.  Non-conforming input falls back to
         one normalizing copy here, so callers need no special casing.
         """
-        self._admit()
+        slo_class = qos.normalize_class(slo_class)
+        self._admit(slo_class)
         block = numpy.asarray(block)
         if block.dtype != self.engine.dtype or \
                 not block.flags["C_CONTIGUOUS"]:
@@ -316,7 +431,8 @@ class ContinuousBatcher(Logger):
                 "block of %d rows overflows the ladder (max %d); "
                 "chunk at the caller" %
                 (block.shape[0], self.engine.max_batch))
-        return self._enqueue(_Request(block, block=True))
+        return self._enqueue(_Request(block, block=True,
+                                      slo_class=slo_class))
 
     def submit_shadow(self, sample):
         """Best-effort enqueue of a canary-mirror shadow copy: never
@@ -362,6 +478,12 @@ class ContinuousBatcher(Logger):
                     first = self._q.get(timeout=0.2)
                 except queue.Empty:
                     continue
+            first.claimed = True
+            if first.cancelled:
+                # evicted by a higher class while queued: drop the
+                # corpse without charging it against the rung budget
+                self._m_depth.set(self._q.qsize())
+                continue
             batch = self._collect(first)
             self._m_depth.set(self._q.qsize())
             try:
@@ -394,6 +516,9 @@ class ContinuousBatcher(Logger):
                     req = self._q.get(timeout=remaining)
             except queue.Empty:
                 break
+            req.claimed = True
+            if req.cancelled:
+                continue  # evicted while queued: zero rows, skip
             if rows + req.rows > limit:
                 self._carry = req
                 break
@@ -488,6 +613,10 @@ class ContinuousBatcher(Logger):
             req.latency = done - req.enqueued
             if not req.shadow:
                 self._m_latency.observe(req.latency)
+                # per-class accounting (docs/serving.md "Multi-tenant
+                # QoS") — shadow/mirror rows stay excluded here too
+                qos.note_request(req.slo_class, req.rows)
+                qos.note_latency(req.slo_class, req.latency)
             req.done.set()
         if _tracer.active:
             args = {"n": n, "rung": rung}
@@ -524,7 +653,8 @@ class ContinuousBatcher(Logger):
     def _run_block_sliced(self, req, cap):
         children = []
         for i in range(0, req.rows, cap):
-            child = _Request(req.sample[i:i + cap], block=True)
+            child = _Request(req.sample[i:i + cap], block=True,
+                             shadow=req.shadow, slo_class=req.slo_class)
             child.enqueued = req.enqueued
             children.append(child)
         for child in children:
@@ -640,7 +770,19 @@ def serve_snapshot(reg=None):
                         ("serve.hedge.fired", "hedges_fired"),
                         ("serve.hedge.wins", "hedge_wins"),
                         ("serve.hedge.duplicates_dropped",
-                         "hedge_duplicates_dropped")):
+                         "hedge_duplicates_dropped"),
+                        # multi-tenant QoS (docs/serving.md
+                        # "Multi-tenant QoS"): hedge suppressions and
+                        # fleet-canary verdicts next to load; the
+                        # per-class detail is the "tenants" block below
+                        ("serve.hedge.budget_exhausted",
+                         "hedge_budget_exhausted"),
+                        ("serve.fleet.canary.mirrors",
+                         "fleet_canary_mirrors"),
+                        ("serve.fleet.canary.promotions",
+                         "fleet_canary_promotions"),
+                        ("serve.fleet.canary.rollbacks",
+                         "fleet_canary_rollbacks")):
         metric = reg.peek(name)
         if metric is not None and metric.value is not None:
             out[short] = metric.value
@@ -664,4 +806,7 @@ def serve_snapshot(reg=None):
     batch = reg.peek("serve.batch_size")
     if batch is not None and batch.count:
         out["batch_mean"] = round(batch.snapshot()["mean"], 2)
+    tenants = qos.tenant_snapshot(reg)
+    if tenants:
+        out["tenants"] = tenants
     return out

@@ -56,6 +56,7 @@ vs hedging-off.
 """
 
 import itertools
+import random
 import socket as _socketmod
 import threading
 import time
@@ -70,6 +71,7 @@ from veles_tpu.network_common import (
     read_frame_sync)
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
+from veles_tpu.serve import qos
 from veles_tpu.serve.batcher import ServeOverload
 from veles_tpu.serve.transport import (
     MAX_FRAME_BYTES, decode_tensor, encode_tensor)
@@ -170,9 +172,18 @@ class HostLink(object):
 
     # -- API ----------------------------------------------------------------
 
-    def send_infer(self, wid, arr):
+    def send_infer(self, wid, arr, slo_class=None, shadow=False):
         meta, raw = encode_tensor(arr)
         msg = {"op": "infer", "id": wid}
+        if slo_class is not None:
+            # the front's QoS label travels with the copy so the
+            # host's batcher sheds and accounts by the SAME class
+            msg["slo_class"] = slo_class
+        if shadow:
+            # canary-slice mirror: the host serves it via
+            # submit_shadow — computed and answered, never counted in
+            # the served/tenant metrics
+            msg["shadow"] = True
         msg.update(meta)
         self._send(msg, raw)
 
@@ -253,9 +264,10 @@ class FleetRequest(object):
 
     __slots__ = ("sample", "rows", "block", "enqueued", "done",
                  "result", "error", "cancelled", "epoch", "copies",
-                 "sheds", "hedges", "resolved")
+                 "sheds", "hedges", "resolved", "slo_class", "latency",
+                 "mirror")
 
-    def __init__(self, sample, block=False):
+    def __init__(self, sample, block=False, slo_class=None):
         self.sample = sample
         self.rows = sample.shape[0] if block else 1
         self.block = block
@@ -269,6 +281,17 @@ class FleetRequest(object):
         self.sheds = {}         # host_id -> retry_after offered
         self.hedges = 0
         self.resolved = False
+        #: canonical SLO class — decides the class-aware inflight
+        #: bound, the per-class hedge budget, and the class the host's
+        #: batcher accounts the copy under
+        self.slo_class = qos.normalize_class(slo_class)
+        #: end-to-end seconds, stamped at resolution — the canary
+        #: comparator reads it off mirrored pairs
+        self.latency = None
+        #: _ShadowCopy when the canary slice mirrored this request to
+        #: the canary host; cleared once the pair is emitted (or the
+        #: shadow failed)
+        self.mirror = None
 
 
 class _Copy(object):
@@ -295,10 +318,50 @@ class _Host(object):
     def __init__(self, host_id, link, joined_epoch):
         self.host_id = host_id
         self.link = link
-        self.state = "live"     # live | dead | leaving
+        self.state = "live"     # live | dead | leaving | canary
         self.inflight = set()   # wire ids currently on this host
         self.info = dict(link.host_info)
         self.joined_epoch = joined_epoch
+
+
+class _ShadowCopy(object):
+    """The canary-slice mirror of one request: dispatched to the
+    canary host beside (never instead of) the primary copy, tracked in
+    the router's SEPARATE shadow wire map so it can never trip the
+    exactly-once fence, resolve the entry, or count as served."""
+
+    __slots__ = ("entry", "host_id", "sent_at", "out", "latency")
+
+    def __init__(self, entry, host_id):
+        self.entry = entry
+        self.host_id = host_id
+        self.sent_at = time.perf_counter()
+        self.out = None
+        self.latency = None
+
+
+class _CanarySlice(object):
+    """Router-side state of an active fleet-canary traffic slice: ONE
+    host out of rotation, a seeded fraction of single-sample traffic
+    mirrored to it as shadow copies, mirrored (primary, shadow) pairs
+    fed to ``on_pair`` for the comparator's verdict."""
+
+    __slots__ = ("host_id", "fraction", "rng", "on_pair", "mirrored",
+                 "pairs", "shadow_errors", "link_down", "armed")
+
+    def __init__(self, host_id, fraction, seed, on_pair):
+        self.host_id = host_id
+        self.fraction = float(fraction)
+        self.rng = random.Random(seed)
+        self.on_pair = on_pair
+        self.mirrored = 0
+        self.pairs = 0
+        self.shadow_errors = 0
+        self.link_down = False
+        #: mirroring is held off until the controller ARMS the slice —
+        #: after the candidate is staged — so every judged pair really
+        #: compares candidate output, never stale old-vs-old evidence
+        self.armed = False
 
 
 class _FleetProfile(object):
@@ -339,7 +402,8 @@ class FleetRouter(Logger):
     def __init__(self, secret=None, hedge=True, hedge_factor=2.0,
                  hedge_floor_s=0.05, hedge_tick_s=0.02, max_hedges=1,
                  hedge_warmup=8, throughput_alpha=0.2,
-                 link_timeout=30.0, keepalive_s=5.0, **kwargs):
+                 link_timeout=30.0, keepalive_s=5.0, hedge_budget=None,
+                 max_inflight=None, retry_jitter=None, **kwargs):
         super(FleetRouter, self).__init__(**kwargs)
         self._secret = secret
         self.hedge = bool(hedge)
@@ -350,6 +414,26 @@ class FleetRouter(Logger):
         self.hedge_warmup = int(hedge_warmup)
         self.link_timeout = float(link_timeout)
         self.keepalive_s = float(keepalive_s)
+        #: per-class hedge token buckets (qos.HedgeBudget): an
+        #: exhausted class routes normally (no hedge this tick), it
+        #: never fails; None = unlimited (legacy behavior)
+        self.hedge_budget = hedge_budget
+        #: class-aware bound on unresolved front requests: past it an
+        #: incoming request evicts one of STRICTLY lower class (shed
+        #: attributed to the victim) or is shed itself; None =
+        #: unbounded (legacy behavior — hosts shed at their queues)
+        self.max_inflight = max_inflight
+        self.retry_jitter = retry_jitter if retry_jitter is not None \
+            else qos.RetryJitter()
+        #: unresolved entries per class — the eviction pool behind
+        #: max_inflight
+        self._unresolved = {cls: set() for cls in qos.SLO_CLASSES}
+        #: active _CanarySlice (begin_canary_slice), or None
+        self._canary = None
+        #: wid -> _ShadowCopy: the canary mirror's OWN wire map —
+        #: checked before the primary map so shadow replies can never
+        #: trip the duplicate fence or resolve an entry
+        self._shadow_wire = {}
         self.fleet = elastic.FleetView(
             throughput_alpha=throughput_alpha)
         self._lock = threading.RLock()
@@ -373,6 +457,8 @@ class FleetRouter(Logger):
         self._m_hedge_wins = _registry.counter("serve.hedge.wins")
         self._m_dup = _registry.counter(
             "serve.hedge.duplicates_dropped")
+        self._m_shed = _registry.counter("serve.fleet.shed")
+        self._m_mirrors = _registry.counter("serve.fleet.canary.mirrors")
         self._m_latency = _registry.histogram("serve.fleet.latency_s")
         self._g_live.set(0)
         self._g_epoch.set(0)
@@ -443,11 +529,18 @@ class FleetRouter(Logger):
 
     def _on_link_down(self, host):
         with self._lock:
-            if host.state != "live":
+            if host.state not in ("live", "canary"):
                 # graceful close or already handled: just park the
                 # thread for the final join
                 self._retired.append(host.link)
                 return
+            if host.state == "canary" and self._canary is not None \
+                    and self._canary.host_id == host.host_id:
+                # the canary host died mid-judgment: the slice is
+                # over (the controller sees link_down and rolls back);
+                # shadow copies die with it — mirrors are
+                # observations, nothing requeues
+                self._canary.link_down = True
             host.state = "dead"
             self._retire_host(host, reason="link down")
             self._retired.append(host.link)
@@ -467,6 +560,12 @@ class FleetRouter(Logger):
                         host=host.host_id, epoch=epoch, reason=reason)
         wids, host.inflight = list(host.inflight), set()
         for wid in wids:
+            shadow = self._shadow_wire.pop(wid, None)
+            if shadow is not None:
+                # a canary mirror dies with its host: drop the record
+                # so the entry's pair simply never emits
+                shadow.entry.mirror = None
+                continue
             copy = self._wire.pop(wid, None)
             if copy is None:
                 continue
@@ -547,7 +646,8 @@ class FleetRouter(Logger):
             entry.copies[wid] = host.host_id
             host.inflight.add(wid)
             try:
-                host.link.send_infer(wid, entry.sample)
+                host.link.send_infer(wid, entry.sample,
+                                     slo_class=entry.slo_class)
                 return copy
             except Exception:
                 del self._wire[wid]
@@ -560,19 +660,21 @@ class FleetRouter(Logger):
                     self._retired.append(host.link)
                     host.link.close(join=False)
 
-    def submit(self, sample):
+    def submit(self, sample, slo_class=None):
         """Enqueue one sample on the fleet; returns the pending
         request (the batcher contract).  Raises ServeOverload when
-        every live host sheds."""
+        every live host sheds.  ``slo_class`` labels the request for
+        the QoS layer; un-labelled callers default to ``batch``."""
         if self._profile is None:
             raise ServeOverload("fleet has no hosts", retry_after=1.0)
         sample = numpy.ascontiguousarray(sample, self._profile.dtype)
         if sample.shape != self._profile.sample_shape:
             raise ValueError("expected sample shape %s, got %s" %
                              (self._profile.sample_shape, sample.shape))
-        return self._submit_entry(FleetRequest(sample))
+        return self._submit_entry(
+            FleetRequest(sample, slo_class=slo_class))
 
-    def submit_block(self, block):
+    def submit_block(self, block, slo_class=None):
         """Enqueue a contiguous batch as ONE request (the transport's
         block path); rows stay together on one host per copy."""
         if self._profile is None:
@@ -587,20 +689,121 @@ class FleetRouter(Logger):
                 "block of %d rows overflows the fleet ladder (max %d);"
                 " chunk at the caller" %
                 (block.shape[0], self._profile.max_batch))
-        return self._submit_entry(FleetRequest(block, block=True))
+        return self._submit_entry(
+            FleetRequest(block, block=True, slo_class=slo_class))
+
+    def _inflight_total(self):
+        return sum(len(pool) for pool in self._unresolved.values())
+
+    def _evict_lower(self, incoming_cls):
+        """Under the lock: resolve one unresolved entry of STRICTLY
+        lower class with ServeOverload (copies cancelled over the
+        wire, shed attributed to the victim's class) to admit an
+        incoming ``incoming_cls`` request past ``max_inflight``.
+        Returns False when nothing lower is pending — the incoming
+        request must be shed instead."""
+        incoming_rank = qos.class_rank(incoming_cls)
+        for victim_cls in qos.SHED_ORDER:
+            if qos.class_rank(victim_cls) >= incoming_rank:
+                return False
+            pool = self._unresolved[victim_cls]
+            while pool:
+                victim = pool.pop()
+                if victim.resolved or victim.cancelled:
+                    continue
+                victim.resolved = True
+                for wid, hid in list(victim.copies.items()):
+                    self._wire.pop(wid, None)
+                    host = self._hosts.get(hid)
+                    if host is not None:
+                        host.inflight.discard(wid)
+                        if host.state == "live":
+                            try:
+                                host.link.send_cancel(wid)
+                            except Exception:
+                                pass
+                victim.copies.clear()
+                victim.mirror = None
+                self._m_shed.inc()
+                qos.note_shed(victim_cls)
+                victim.error = ServeOverload(
+                    "shed for %s admission (class-ordered eviction)"
+                    % incoming_cls,
+                    retry_after=self.retry_jitter.apply(
+                        self._retry_estimate(), victim_cls))
+                if _tracer.active:
+                    _tracer.instant("serve.fleet.shed", cat="serve",
+                                    slo_class=victim_cls,
+                                    evicted_for=incoming_cls)
+                victim.done.set()
+                return True
+        return False
+
+    def _retry_estimate(self):
+        """Base retry_after for front-side sheds: the recent mean
+        end-to-end latency, floored for cold fronts."""
+        if self._latencies:
+            return max(0.05,
+                       sum(self._latencies) / len(self._latencies))
+        return 0.1
 
     def _submit_entry(self, entry):
         self._m_requests.inc()
         with self._lock:
+            if self.max_inflight is not None and \
+                    self._inflight_total() >= self.max_inflight and \
+                    not self._evict_lower(entry.slo_class):
+                self._m_shed.inc()
+                qos.note_shed(entry.slo_class)
+                raise ServeOverload(
+                    "fleet front full (%d unresolved)"
+                    % self._inflight_total(),
+                    retry_after=self.retry_jitter.apply(
+                        self._retry_estimate(), entry.slo_class))
             self._send_copy(entry, exclude=set())
+            self._unresolved[entry.slo_class].add(entry)
+            self._maybe_mirror(entry)
         return entry
 
-    def infer(self, sample, timeout=30.0):
-        """Blocking single-sample round-trip through the fleet."""
-        return self._wait(self.submit(sample), timeout)
+    def _maybe_mirror(self, entry):
+        """Under the lock: canary-slice mirroring — a seeded fraction
+        of single-sample traffic gets a shadow copy on the canary
+        host, tracked in the SEPARATE shadow wire map.  Never raises:
+        mirroring is an observation, the primary dispatch already
+        succeeded and stands either way."""
+        slice_ = self._canary
+        if slice_ is None or not slice_.armed or entry.block:
+            return
+        if slice_.rng.random() >= slice_.fraction:
+            return
+        host = self._hosts.get(slice_.host_id)
+        if host is None or host.state != "canary":
+            return
+        wid = next(self._wids)
+        shadow = _ShadowCopy(entry, slice_.host_id)
+        self._shadow_wire[wid] = shadow
+        host.inflight.add(wid)
+        try:
+            host.link.send_infer(wid, entry.sample,
+                                 slo_class=entry.slo_class,
+                                 shadow=True)
+        except Exception:
+            self._shadow_wire.pop(wid, None)
+            host.inflight.discard(wid)
+            slice_.shadow_errors += 1
+            return
+        entry.mirror = shadow
+        slice_.mirrored += 1
+        self._m_mirrors.inc()
 
-    def infer_block(self, block, timeout=30.0):
-        return self._wait(self.submit_block(block), timeout)
+    def infer(self, sample, timeout=30.0, slo_class=None):
+        """Blocking single-sample round-trip through the fleet."""
+        return self._wait(self.submit(sample, slo_class=slo_class),
+                          timeout)
+
+    def infer_block(self, block, timeout=30.0, slo_class=None):
+        return self._wait(
+            self.submit_block(block, slo_class=slo_class), timeout)
 
     def _wait(self, entry, timeout):
         if not entry.done.wait(timeout):
@@ -617,6 +820,8 @@ class FleetRouter(Logger):
         rejected as duplicates."""
         with self._lock:
             entry.cancelled = True
+            self._unresolved[entry.slo_class].discard(entry)
+            entry.mirror = None
             for wid, hid in list(entry.copies.items()):
                 self._wire.pop(wid, None)
                 host = self._hosts.get(hid)
@@ -634,6 +839,21 @@ class FleetRouter(Logger):
     def _on_result(self, host, wid, arr):
         now = time.perf_counter()
         with self._lock:
+            shadow = self._shadow_wire.pop(wid, None)
+            if shadow is not None:
+                # canary mirror reply: pure evidence, NEVER a caller
+                # answer — record and try to emit the judgment pair
+                host.inflight.discard(wid)
+                shadow.out = arr[0] if arr.ndim == 2 and \
+                    not shadow.entry.block else arr
+                shadow.latency = now - shadow.sent_at
+                entry = shadow.entry
+            else:
+                entry = None
+        if entry is not None:
+            self._maybe_emit_pair(entry)
+            return
+        with self._lock:
             copy = self._wire.pop(wid, None)
             if copy is None or copy.entry.resolved or \
                     copy.entry.cancelled:
@@ -647,6 +867,7 @@ class FleetRouter(Logger):
                 return
             entry = copy.entry
             entry.resolved = True
+            self._unresolved[entry.slo_class].discard(entry)
             host.inflight.discard(wid)
             entry.copies.pop(wid, None)
             latency = now - copy.sent_at
@@ -665,9 +886,14 @@ class FleetRouter(Logger):
         # transport both rely on row semantics)
         entry.result = arr if entry.block or arr.ndim != 2 else arr[0]
         entry.error = None
-        self._m_latency.observe(now - entry.enqueued)
-        self._latencies.append(now - entry.enqueued)
+        # tenant served counters are bumped at the HOST batcher (the
+        # serving edge), never here: an in-process front + host pair
+        # shares one registry and would double-count otherwise
+        entry.latency = now - entry.enqueued
+        self._m_latency.observe(entry.latency)
+        self._latencies.append(entry.latency)
         entry.done.set()
+        self._maybe_emit_pair(entry)
 
     def _cancel_losers(self, entry):
         """Under the lock: retire every other live copy of a resolved
@@ -704,6 +930,15 @@ class FleetRouter(Logger):
 
     def _on_error(self, host, wid, exc):
         with self._lock:
+            shadow = self._shadow_wire.pop(wid, None)
+            if shadow is not None:
+                # a failed mirror is lost evidence, never a failed
+                # request — the primary copy answers the caller
+                host.inflight.discard(wid)
+                if self._canary is not None:
+                    self._canary.shadow_errors += 1
+                shadow.entry.mirror = None
+                return
             copy = self._wire.pop(wid, None)
             if copy is None or copy.entry.resolved or \
                     copy.entry.cancelled:
@@ -732,9 +967,11 @@ class FleetRouter(Logger):
 
     def _resolve_error(self, entry, exc):
         entry.resolved = True
+        self._unresolved[entry.slo_class].discard(entry)
         for wid in list(entry.copies):
             self._wire.pop(wid, None)
         entry.copies.clear()
+        entry.mirror = None
         self._m_failed.inc()
         entry.error = exc
         entry.done.set()
@@ -765,6 +1002,13 @@ class FleetRouter(Logger):
                                                       mean_tp),
                         mean_power=mean_tp)
                     if now - copy.sent_at <= threshold:
+                        continue
+                    if self.hedge_budget is not None and \
+                            not self.hedge_budget.try_take(
+                                entry.slo_class):
+                        # budget exhausted for this class: route
+                        # normally — the primary copy stands, the
+                        # request NEVER fails for lack of hedge tokens
                         continue
                     entry.hedges += 1
                     try:
@@ -822,11 +1066,113 @@ class FleetRouter(Logger):
                         ServeOverload("fleet front shutting down",
                                       retry_after=1.0))
             self._wire.clear()
+            self._shadow_wire.clear()
+            self._canary = None
+            for pool in self._unresolved.values():
+                pool.clear()
         for host in hosts:
             host.link.close()
         for link in retired:
             link.close()
         self._g_live.set(0)
+
+    # -- canary slicing (fleet canary controller hooks) ---------------------
+
+    def begin_canary_slice(self, host_id, fraction=0.25, seed=0,
+                           on_pair=None):
+        """Take ``host_id`` out of the routing rotation and mirror a
+        seeded ``fraction`` of live single-sample traffic to it as
+        shadow copies.  ``on_pair(primary_out, shadow_out,
+        primary_latency, shadow_latency)`` fires (outside the lock)
+        once BOTH sides of a mirrored request answered — the fleet
+        canary controller's evidence stream.
+
+        The host keeps draining its previously-assigned inflight work
+        (it is ``canary``, not ``dead``); it just receives no new
+        PRIMARY dispatches, so the staged candidate only ever answers
+        shadow traffic until promotion."""
+        with self._lock:
+            if self._canary is not None:
+                raise RuntimeError(
+                    "a canary slice is already active on %r"
+                    % self._canary.host_id)
+            host = self._hosts.get(host_id)
+            if host is None or host.state != "live":
+                raise RuntimeError(
+                    "cannot slice host %r: not a live host" % host_id)
+            if not any(h.state == "live"
+                       for h in self._hosts.values()
+                       if h.host_id != host_id):
+                raise RuntimeError(
+                    "cannot slice host %r: no live sibling would "
+                    "remain to serve primary traffic" % host_id)
+            host.state = "canary"
+            self._canary = _CanarySlice(host_id, fraction, seed,
+                                        on_pair)
+            if _tracer.active:
+                _tracer.instant("serve.fleet.canary.begin",
+                                cat="serve", host=host_id,
+                                fraction=fraction)
+            return self._canary
+
+    def end_canary_slice(self):
+        """Tear down the active slice: purge the shadow wire, restore
+        the host to the routing rotation (unless it died mid-slice)
+        and return the slice's evidence counters."""
+        with self._lock:
+            slice_, self._canary = self._canary, None
+            if slice_ is None:
+                return None
+            for wid in list(self._shadow_wire):
+                rec = self._shadow_wire.pop(wid)
+                rec.entry.mirror = None
+                host = self._hosts.get(rec.host_id)
+                if host is not None:
+                    host.inflight.discard(wid)
+            host = self._hosts.get(slice_.host_id)
+            if host is not None and host.state == "canary":
+                host.state = "live"
+            if _tracer.active:
+                _tracer.instant("serve.fleet.canary.end", cat="serve",
+                                host=slice_.host_id,
+                                mirrored=slice_.mirrored,
+                                pairs=slice_.pairs)
+            return {"host_id": slice_.host_id,
+                    "mirrored": slice_.mirrored,
+                    "pairs": slice_.pairs,
+                    "shadow_errors": slice_.shadow_errors,
+                    "link_down": slice_.link_down}
+
+    def host_inflight(self, host_id):
+        """How many wire ids (primary + shadow) the host still owes —
+        the controller drains this to 0 before staging a candidate so
+        old-model work never mixes with new-model judging."""
+        with self._lock:
+            host = self._hosts.get(host_id)
+            return len(host.inflight) if host is not None else 0
+
+    def _maybe_emit_pair(self, entry):
+        """Emit the (primary, shadow) judgment pair once both sides of
+        a mirrored request answered.  The callback runs OUTSIDE the
+        lock — comparator judging must never stall reader threads."""
+        with self._lock:
+            shadow = entry.mirror
+            if shadow is None or shadow.out is None or \
+                    entry.result is None or not entry.resolved:
+                return
+            entry.mirror = None
+            slice_ = self._canary
+            if slice_ is None:
+                return
+            slice_.pairs += 1
+            on_pair = slice_.on_pair
+        if on_pair is None:
+            return
+        try:
+            on_pair(entry.result, shadow.out, entry.latency,
+                    shadow.latency)
+        except Exception:
+            pass  # judging is evidence collection, never a fault path
 
     # -- metadata (pool duck-type) ------------------------------------------
 
@@ -889,4 +1235,15 @@ class FleetRouter(Logger):
                 "hedge_wins": self._m_hedge_wins.value,
                 "duplicates_dropped": self._m_dup.value,
                 "requeues": self._m_requeues.value,
+                "max_inflight": self.max_inflight,
+                "unresolved": {
+                    cls: len(pool)
+                    for cls, pool in self._unresolved.items()},
+                "canary": None if self._canary is None else {
+                    "host_id": self._canary.host_id,
+                    "fraction": self._canary.fraction,
+                    "mirrored": self._canary.mirrored,
+                    "pairs": self._canary.pairs,
+                    "shadow_errors": self._canary.shadow_errors,
+                },
             }

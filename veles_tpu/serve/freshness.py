@@ -57,7 +57,8 @@ from veles_tpu.snapshotter import (
     SnapshotterBase, read_latest)
 from veles_tpu.tune.cache import BANK_FILE_NAME as _BANK_FILE_NAME
 
-__all__ = ["CanaryComparator", "FreshnessController", "ModelCandidate",
+__all__ = ["CanaryComparator", "FleetCanaryController",
+           "FreshnessController", "LocalHostControl", "ModelCandidate",
            "SnapshotWatcher", "export_model_spec"]
 
 #: keys a published "model spec" pickle must carry (the lightweight
@@ -708,3 +709,225 @@ class FreshnessController(Logger):
         if self.history:
             out["last_cycle"] = self.history[-1]
         return out
+
+
+class LocalHostControl(object):
+    """Stage/revert control over ONE serve host's engines — the
+    in-process handle the fleet canary controller drives.
+
+    ``stage(params)`` swaps a same-architecture candidate into every
+    engine behind the host's pool via
+    :meth:`~veles_tpu.serve.engine.AOTEngine.swap_params` — the
+    structural-digest-checked buffer swap, ZERO new backend compiles by
+    construction, receipted via ``xla_introspect.compile_delta`` —
+    saving the previous params once so ``revert()`` restores them
+    exactly.  In a real fleet each host runs one of these next to its
+    transport server; tests drive them directly over socketpair
+    hosts."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._saved = None
+
+    def _engines(self):
+        replicas = getattr(self.pool, "replicas", None)
+        if replicas is not None:
+            return [rep.engine for rep in replicas]
+        return [self.pool.engine]
+
+    def stage(self, params):
+        """Swap ``params`` into every engine; returns ``{"digest",
+        "new_compiles"}``.  Raises ``ValueError`` (from swap_params)
+        when the candidate is a different architecture — staging is
+        swap-only, never a recompile."""
+        from veles_tpu.observe import xla_introspect
+        engines = self._engines()
+        if self._saved is None:
+            self._saved = [dict(p) for p in engines[0].params]
+        with xla_introspect.compile_delta() as delta:
+            digest = None
+            for engine in engines:
+                digest = engine.swap_params(params)
+        receipt = dict(delta.receipt)
+        receipt["digest"] = digest
+        return receipt
+
+    def revert(self):
+        """Restore the params saved by the first :meth:`stage`;
+        returns the swap receipt or None when nothing was staged."""
+        if self._saved is None:
+            return None
+        saved, self._saved = self._saved, None
+        return self.stage_params_quietly(saved)
+
+    def stage_params_quietly(self, params):
+        from veles_tpu.observe import xla_introspect
+        with xla_introspect.compile_delta() as delta:
+            digest = None
+            for engine in self._engines():
+                digest = engine.swap_params(params)
+        self._saved = None
+        receipt = dict(delta.receipt)
+        receipt["digest"] = digest
+        return receipt
+
+
+class FleetCanaryController(Logger):
+    """Fleet-level canary: judge a candidate on ONE host's live
+    traffic slice, then promote host-by-host or roll the whole fleet
+    back.
+
+    The freshness loop's discipline lifted one tier up: where
+    :class:`FreshnessController` canaries a candidate on one REPLICA
+    of a single-host pool, this controller canaries it on one HOST of
+    a :class:`~veles_tpu.serve.fleet.FleetRouter` fleet —
+
+    - finite-gate the candidate (:func:`veles_tpu.health.all_finite`);
+    - ``begin_canary_slice`` pulls the canary host from rotation and
+      mirrors a seeded fraction of live single-sample traffic to it;
+    - drain the host's previously-assigned inflight work, then
+      ``stage`` the candidate via the host's
+      :class:`LocalHostControl` — a zero-new-compile buffer swap;
+    - judge real mirrored (primary, shadow) pairs through
+      :class:`CanaryComparator` (output divergence bound + the
+      :class:`~veles_tpu.health.EmaSpikeWatch` latency spike
+      discipline + the non-finite tripwire);
+    - **promote**: stage every sibling host in order (the rolling
+      fleet-wide swap), then end the slice — the canary host returns
+      to rotation already serving the candidate;
+    - **rollback**: revert the canary host FIRST, then end the slice —
+      a bad candidate never serves a primary request on ANY host.
+
+    A timed-out or evidence-starved verdict rolls back: thin evidence
+    is evidence against the candidate (the single-host loop's rule).
+    Counters: ``serve.fleet.canary.{promotions,rollbacks}`` (mirrors
+    are counted by the router as it sends them)."""
+
+    def __init__(self, router, controls, mirror_fraction=0.25,
+                 min_mirrors=8, divergence_limit=0.5,
+                 latency_spike_factor=10.0, latency_floor_s=0.05,
+                 breach_budget=3, verdict_timeout_s=30.0,
+                 drain_timeout_s=10.0, finite_gate=True, seed=0,
+                 **kwargs):
+        super(FleetCanaryController, self).__init__(**kwargs)
+        self.router = router
+        #: ``{host_id: LocalHostControl-like}`` — stage/revert handles
+        #: for every host in the fleet (duck-typed: anything with
+        #: ``stage(params)`` / ``revert()``)
+        self.controls = dict(controls)
+        self.mirror_fraction = float(mirror_fraction)
+        self.verdict_timeout_s = float(verdict_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.finite_gate = bool(finite_gate)
+        self.seed = int(seed)
+        self._comparator_kwargs = dict(
+            min_mirrors=min_mirrors, divergence_limit=divergence_limit,
+            latency_spike_factor=latency_spike_factor,
+            latency_floor_s=latency_floor_s,
+            breach_budget=breach_budget)
+        self.history = []
+        self._m_promotions = _registry.counter(
+            "serve.fleet.canary.promotions")
+        self._m_rollbacks = _registry.counter(
+            "serve.fleet.canary.rollbacks")
+
+    def run(self, params, canary_host):
+        """One full fleet-canary cycle for ``params`` judged on
+        ``canary_host``; returns the receipt dict (``verdict`` is
+        ``"promote"`` / ``"rolled_back"`` / ``"poisoned"``)."""
+        start = time.perf_counter()
+        receipt = {"canary_host": canary_host, "new_compiles": 0}
+        if self.finite_gate and not all_finite(params):
+            self._m_rollbacks.inc()
+            _tracer.instant("serve.canary", cat="serve",
+                            phase="poisoned", host=canary_host,
+                            reason="non-finite params")
+            _flight.dump(reason="fleet-canary-poisoned")
+            self.warning("fleet candidate REJECTED: non-finite params "
+                         "(never staged, never mirrored)")
+            receipt.update(verdict="poisoned",
+                           reason="non-finite params")
+            self.history.append(receipt)
+            return receipt
+        control = self.controls[canary_host]
+        comparator = CanaryComparator(**self._comparator_kwargs)
+        verdict_ready = threading.Event()
+
+        def on_pair(primary_out, shadow_out, p_lat, s_lat):
+            if comparator.add(primary_out, shadow_out,
+                              primary_latency=p_lat,
+                              shadow_latency=s_lat) is not None:
+                verdict_ready.set()
+
+        slice_ = self.router.begin_canary_slice(
+            canary_host, fraction=self.mirror_fraction,
+            seed=self.seed, on_pair=on_pair)
+        _tracer.instant("serve.canary", cat="serve", phase="begin",
+                        host=canary_host, tier="fleet")
+        try:
+            # drain: old-model inflight work must finish before the
+            # swap so mirrored judging only ever sees candidate output
+            deadline = time.monotonic() + self.drain_timeout_s
+            while self.router.host_inflight(canary_host) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            stage = control.stage(params)
+            receipt["new_compiles"] += stage.get("new_compiles") or 0
+            receipt["digest"] = stage.get("digest")
+            # arm mirroring only now: every judged pair compares
+            # CANDIDATE output against the live fleet, never a stale
+            # pre-stage shadow
+            slice_.armed = True
+            verdict_ready.wait(self.verdict_timeout_s)
+            verdict = comparator.verdict
+            if verdict is None:
+                if comparator.breaches == 0 and \
+                        comparator.pairs >= comparator.min_mirrors:
+                    verdict = "promote"
+                else:
+                    comparator.reasons.append(
+                        "verdict timeout (%d/%d mirrors, %d breaches)"
+                        % (comparator.pairs, comparator.min_mirrors,
+                           comparator.breaches))
+                    verdict = "rolled_back"
+            if slice_.link_down:
+                comparator.reasons.append(
+                    "canary host link died mid-judgment")
+                verdict = "rolled_back"
+        except Exception:
+            # an unexpected staging/judging failure must not strand
+            # the fleet mid-canary: revert, restore routing, re-raise
+            try:
+                control.revert()
+            finally:
+                self.router.end_canary_slice()
+            raise
+        if verdict == "promote":
+            # rolling fleet-wide promotion: siblings first (each a
+            # zero-new-compile swap), the canary host re-enters
+            # rotation LAST — already serving the candidate
+            for host_id, sibling in self.controls.items():
+                if host_id == canary_host:
+                    continue
+                rec = sibling.stage(params)
+                receipt["new_compiles"] += rec.get("new_compiles") or 0
+            self._m_promotions.inc()
+        else:
+            # rollback: revert the canary BEFORE it re-enters rotation
+            # — the bad candidate never answers a primary request
+            control.revert()
+            self._m_rollbacks.inc()
+            receipt["reason"] = comparator.reason()
+        stats = self.router.end_canary_slice()
+        _tracer.instant("serve.canary", cat="serve", phase=verdict,
+                        host=canary_host, tier="fleet",
+                        mirrors=comparator.pairs)
+        receipt.update(
+            verdict=verdict, mirrors=comparator.pairs,
+            max_divergence=round(comparator.max_divergence, 6),
+            slice=stats, seconds=round(time.perf_counter() - start, 4))
+        self.history.append(receipt)
+        self.info("fleet canary on %s: %s (%d mirrored pairs, %d new "
+                  "compiles)", canary_host, verdict, comparator.pairs,
+                  receipt["new_compiles"])
+        return receipt

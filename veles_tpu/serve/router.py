@@ -657,8 +657,9 @@ class ReplicaPool(Logger):
             # every pick raced a cutover transition: re-rank and retry
         raise ServeOverload("fleet reconfiguring", retry_after=0.1)
 
-    def submit(self, sample):
-        req = self._submit(lambda batcher: batcher.submit(sample))
+    def submit(self, sample, slo_class=None):
+        req = self._submit(
+            lambda batcher: batcher.submit(sample, slo_class=slo_class))
         hook = self.mirror_hook
         if hook is not None:
             try:
@@ -669,18 +670,21 @@ class ReplicaPool(Logger):
                 self.exception("canary mirror hook failed")
         return req
 
-    def submit_block(self, block):
+    def submit_block(self, block, slo_class=None):
         return self._submit(
-            lambda batcher: batcher.submit_block(block))
+            lambda batcher: batcher.submit_block(
+                block, slo_class=slo_class))
 
-    def infer(self, sample, timeout=30.0):
+    def infer(self, sample, timeout=30.0, slo_class=None):
         """Blocking submit through the router (single sample)."""
-        return self._wait(self.submit(sample), timeout)
+        return self._wait(self.submit(sample, slo_class=slo_class),
+                          timeout)
 
-    def infer_block(self, block, timeout=30.0):
+    def infer_block(self, block, timeout=30.0, slo_class=None):
         """Blocking whole-batch submit (the binary transport's path):
         one request, zero row copies, result is the 2-D block."""
-        return self._wait(self.submit_block(block), timeout)
+        return self._wait(
+            self.submit_block(block, slo_class=slo_class), timeout)
 
     @staticmethod
     def _wait(req, timeout):

@@ -31,6 +31,7 @@ import numpy
 from veles_tpu.http_util import BackgroundHTTPServer, RequestTimer
 from veles_tpu.logger import Logger
 from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.serve import qos
 from veles_tpu.serve.batcher import ContinuousBatcher, ServeOverload
 from veles_tpu.serve.batcher import serve_snapshot
 
@@ -78,8 +79,15 @@ class ServeService(Logger):
     def __init__(self, engine, batcher=None, port=0, path="/infer",
                  labels_mapping=None, executor_workers=64,
                  transport_port=None, transport_secret=None,
-                 freshness=None, **batcher_kwargs):
+                 freshness=None, quota=None, retry_jitter=None,
+                 **batcher_kwargs):
         super(ServeService, self).__init__()
+        #: per-tenant admission quota (qos.TenantQuota), shared with
+        #: the binary transport when one is opened; None disables
+        #: quota — legacy behavior, nothing is rejected here
+        self.quota = quota
+        self.retry_jitter = retry_jitter if retry_jitter is not None \
+            else qos.RetryJitter()
         from veles_tpu.serve.fleet import FleetRouter
         from veles_tpu.serve.router import ReplicaPool
         self._is_fleet = isinstance(engine, FleetRouter)
@@ -140,21 +148,37 @@ class ServeService(Logger):
 
     # -- request handling (executor thread) ---------------------------------
 
-    def infer_payload(self, sample):
+    def infer_payload(self, sample, tenant=None, slo_class=None):
         """Blocking inference for one payload: a single sample or a
         batch.  Batch payloads are submitted row-by-row, so their rows
         co-batch with every other in-flight request — a large payload
         does not monopolize a rung.  A payload that sheds partway
         through submission cancels its already-queued rows (the worker
         drops them at dispatch) so a 503'd request never leaves orphan
-        work computing for nobody."""
+        work computing for nobody.
+
+        ``tenant``/``slo_class`` are the QoS identity (docs/serving.md
+        "Multi-tenant QoS"): the tenant's token-bucket quota is charged
+        per SAMPLE here — one admission decision covers the payload —
+        and the class labels every row for class-ordered shedding;
+        un-labelled legacy payloads serve as class ``batch``."""
+        slo_class = qos.normalize_class(slo_class)
         x = numpy.asarray(sample, self.engine.dtype)
         if x.shape == self.engine.sample_shape:
             x = x[None]
+        if self.quota is not None:
+            wait = self.quota.admit(tenant, cost=float(x.shape[0]))
+            if wait is not None:
+                qos.note_shed(slo_class)
+                raise ServeOverload(
+                    "tenant %r over quota" % (tenant,),
+                    retry_after=self.retry_jitter.apply(
+                        max(wait, 0.05), slo_class))
         requests = []
         try:
             for row in x:
-                requests.append(self.batcher.submit(row))
+                requests.append(
+                    self.batcher.submit(row, slo_class=slo_class))
         except Exception:
             for req in requests:
                 req.cancelled = True
@@ -247,10 +271,20 @@ class ServeService(Logger):
                     self.set_status(400)
                     self.write({"error": "bad request: %s" % exc})
                     return
+                # QoS identity: body fields win over headers; both
+                # optional — un-labelled legacy clients serve as
+                # tenant None / class "batch"
+                tenant = body.get("tenant") or \
+                    self.request.headers.get("X-Tenant")
+                slo_class = body.get("slo_class") or \
+                    self.request.headers.get("X-SLO-Class")
                 loop = asyncio.get_event_loop()
                 try:
                     answer = await loop.run_in_executor(
-                        svc._executor, svc.infer_payload, payload)
+                        svc._executor,
+                        lambda: svc.infer_payload(
+                            payload, tenant=tenant,
+                            slo_class=slo_class))
                 except ServeOverload as exc:
                     # the blacklist protocol's transient-reject shape
                     self.set_status(503)
@@ -364,7 +398,8 @@ class ServeService(Logger):
             from veles_tpu.serve.transport import BinaryTransportServer
             self._transport = BinaryTransportServer(
                 self.batcher, port=self._transport_port,
-                secret=self._transport_secret)
+                secret=self._transport_secret, quota=self.quota,
+                retry_jitter=self.retry_jitter)
             self._transport.start_background()
         self._server = BackgroundHTTPServer(self._make_app(),
                                             port=self._port)

@@ -87,6 +87,7 @@ from veles_tpu.network_common import (
     pack_frame, read_frame, read_frame_sync, write_frame)
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
+from veles_tpu.serve import qos
 from veles_tpu.serve.batcher import ServeOverload
 
 __all__ = ["encode_tensor", "decode_tensor", "BinaryTransportServer",
@@ -223,12 +224,18 @@ class BinaryTransportServer(Logger):
 
     def __init__(self, pool, port=0, address="127.0.0.1", secret=None,
                  executor_workers=32, timeout=30.0, host_meta=None,
-                 **kwargs):
+                 quota=None, retry_jitter=None, **kwargs):
         super(BinaryTransportServer, self).__init__(**kwargs)
         self.pool = pool
         self.address = address
         self.port = port
         self.timeout = float(timeout)
+        #: per-tenant admission quota (qos.TenantQuota) — checked per
+        #: infer frame BEFORE the request reaches any queue; None =
+        #: quota disabled (legacy behavior, nothing rejected here)
+        self.quota = quota
+        self.retry_jitter = retry_jitter if retry_jitter is not None \
+            else qos.RetryJitter()
         #: fleet-host identity ({"host_id": ...}) acked back in every
         #: hello reply's "host" block together with the pool's compile
         #: receipt summary; None = not a fleet host, no block
@@ -398,6 +405,12 @@ class BinaryTransportServer(Logger):
             engine = self.pool.engine
             same_host = hello.get("mid") == machine_id()
             pipelined = bool(hello.get("pipeline"))
+            # connection-default QoS identity: a client that labels
+            # its hello stamps every frame on this link; individual
+            # infer frames may still override per request, and
+            # un-labelled legacy clients fall through to class "batch"
+            conn_tenant = hello.get("tenant")
+            conn_class = hello.get("slo_class")
             reply = {
                 "op": "hello", "mid": machine_id(),
                 "digest": engine.digest,
@@ -438,7 +451,9 @@ class BinaryTransportServer(Logger):
             write_frame(writer, reply, secret=self._secret)
             await writer.drain()
             if pipelined:
-                await self._handle_pipelined(reader, writer)
+                await self._handle_pipelined(reader, writer,
+                                             tenant=conn_tenant,
+                                             slo_class=conn_class)
                 return
             while True:
                 try:
@@ -463,7 +478,8 @@ class BinaryTransportServer(Logger):
                 # the next frame is read, which is what makes the
                 # two-slot shm layout race-free
                 await self._serve_one(msg, payload, chan_in, chan_out,
-                                      writer)
+                                      writer, tenant=conn_tenant,
+                                      slo_class=conn_class)
         except ProtocolError as exc:
             self._m_errors.inc()
             self.debug("transport protocol error: %s", exc)
@@ -478,7 +494,8 @@ class BinaryTransportServer(Logger):
             except Exception:
                 pass
 
-    async def _handle_pipelined(self, reader, writer):
+    async def _handle_pipelined(self, reader, writer, tenant=None,
+                                slo_class=None):
         """The fleet-link loop: every ``infer`` frame becomes its own
         task (replies out of order, matched by id), ``cancel`` frames
         retire in-flight scopes, and frame WRITES are serialized by
@@ -494,7 +511,8 @@ class BinaryTransportServer(Logger):
             try:
                 await self._serve_one(msg, payload, None, None, writer,
                                       write_lock=write_lock,
-                                      scope=scope)
+                                      scope=scope, tenant=tenant,
+                                      slo_class=slo_class)
             except (ConnectionError, OSError):
                 # chaos sever / peer gone: drop the whole connection
                 try:
@@ -575,10 +593,15 @@ class BinaryTransportServer(Logger):
         return stall
 
     async def _serve_one(self, msg, payload, chan_in, chan_out,
-                         writer, write_lock=None, scope=None):
+                         writer, write_lock=None, scope=None,
+                         tenant=None, slo_class=None):
         start = time.perf_counter()
         rid = msg.get("id")
         self._m_requests.inc()
+        # per-frame QoS labels override the hello's connection default
+        tenant = msg.get("tenant", tenant)
+        slo_class = qos.normalize_class(msg.get("slo_class", slo_class))
+        shadow = bool(msg.get("shadow"))
 
         async def reply_frame(frame, raw=b""):
             if write_lock is None:
@@ -592,6 +615,20 @@ class BinaryTransportServer(Logger):
                     await writer.drain()
 
         try:
+            if self.quota is not None and not shadow:
+                # shadow (canary mirror) frames are evidence, not
+                # tenant load: never quota-charged, never counted
+                wait = self.quota.admit(tenant)
+                if wait is not None:
+                    # over-quota: reject BEFORE any queue sees the
+                    # request, shed attributed to the tenant's class,
+                    # retry_after seeded-jittered per class so a
+                    # synchronized flood does not re-stampede
+                    qos.note_shed(slo_class)
+                    raise ServeOverload(
+                        "tenant %r over quota" % (tenant,),
+                        retry_after=self.retry_jitter.apply(
+                            max(wait, 0.05), slo_class))
             stall = self._fire_host_chaos()
             if stall:
                 await asyncio.sleep(stall)
@@ -608,7 +645,8 @@ class BinaryTransportServer(Logger):
             arr = decode_tensor(msg, raw)
             loop = asyncio.get_event_loop()
             result = await loop.run_in_executor(
-                self._executor, self._infer, arr, scope)
+                self._executor, self._infer, arr, scope, slo_class,
+                shadow)
             if scope is not None and scope.cancelled:
                 return  # hedged loser: the peer forgot this copy
             meta, raw_out = encode_tensor(
@@ -655,18 +693,34 @@ class BinaryTransportServer(Logger):
                 _tracer.complete("transport.request", start, elapsed,
                                  cat="serve")
 
-    def _infer(self, arr, scope=None):
+    def _infer(self, arr, scope=None, slo_class=None, shadow=False):
         """Blocking dispatch (executor thread): single samples ride
         :meth:`submit`, contiguous blocks ride :meth:`submit_block` —
         the zero-intermediate-copy path — chunked at the ladder top.
         Always returns a 2-D block.  ``scope`` (pipelined mode)
         registers every batcher request so a wire cancel can retire
-        them mid-flight instead of computing for a departed peer."""
+        them mid-flight instead of computing for a departed peer.
+        ``shadow`` frames (canary mirrors from a fleet front) ride
+        :meth:`submit_shadow` so they are excluded from the served and
+        tenant counters; a dropped shadow answers with a transient
+        error — lost evidence, never a failed request."""
         engine = self.pool.engine
         shape = engine.sample_shape
         track = scope.add if scope is not None else (lambda req: req)
-        if arr.shape == shape:
-            requests = [track(self.pool.submit(arr))]
+        if shadow:
+            if arr.shape != shape:
+                raise ValueError(
+                    "shadow frames mirror single samples only, got %s"
+                    % (arr.shape,))
+            req = self.pool.submit_shadow(arr)
+            if req is None:
+                raise ServeOverload(
+                    "shadow mirror dropped (host loaded)",
+                    retry_after=0.05)
+            requests, single = [track(req)], True
+        elif arr.shape == shape:
+            requests = [track(self.pool.submit(arr,
+                                               slo_class=slo_class))]
             single = True
         elif arr.shape[1:] == shape and arr.ndim == len(shape) + 1 \
                 and arr.shape[0] >= 1:
@@ -675,7 +729,8 @@ class BinaryTransportServer(Logger):
             try:
                 for i in range(0, arr.shape[0], engine.max_batch):
                     requests.append(track(self.pool.submit_block(
-                        arr[i:i + engine.max_batch])))
+                        arr[i:i + engine.max_batch],
+                        slo_class=slo_class)))
             except Exception:
                 for req in requests:
                     req.cancelled = True
@@ -719,7 +774,13 @@ class BinaryTransportClient(object):
 
     def __init__(self, host="127.0.0.1", port=None, sock=None,
                  secret=None, shm=True, shm_slot_mb=4.0, codec="none",
-                 timeout=30.0):
+                 timeout=30.0, tenant=None, slo_class=None):
+        #: QoS identity stamped into the hello as this connection's
+        #: default (every frame inherits it server-side; per-call
+        #: overrides ride infer(..., slo_class=...)).  None = legacy
+        #: un-labelled client, served as class "batch"
+        self.tenant = tenant
+        self.slo_class = slo_class
         if sock is None:
             sock = _socketmod.create_connection((host, port), timeout)
         else:
@@ -738,6 +799,10 @@ class BinaryTransportClient(object):
         self.shm_tx_bytes = 0
         self.shm_rx_bytes = 0
         hello = {"op": "hello", "mid": machine_id()}
+        if tenant is not None:
+            hello["tenant"] = tenant
+        if slo_class is not None:
+            hello["slo_class"] = slo_class
         if shm:
             # the client creates BOTH segments (it owns size and
             # lifetime; the server only attaches what it acks), so
@@ -797,15 +862,21 @@ class BinaryTransportClient(object):
     def shm_active(self):
         return self._chan_out is not None
 
-    def infer(self, x):
+    def infer(self, x, slo_class=None, tenant=None):
         """One tensor round-trip: a sample or a contiguous batch in,
         the probability block out (numpy).  Overload answers raise
-        :class:`ServeOverload` with the server's ``retry_after``."""
+        :class:`ServeOverload` with the server's ``retry_after``.
+        ``slo_class``/``tenant`` override this connection's hello
+        default for one request."""
         with self._lock:
             meta, raw = encode_tensor(x, self.codec)
             rid = self._next_id
             self._next_id += 1
             msg = {"op": "infer", "id": rid}
+            if slo_class is not None:
+                msg["slo_class"] = slo_class
+            if tenant is not None:
+                msg["tenant"] = tenant
             msg.update(meta)
             payload = raw
             if self._chan_out is not None:

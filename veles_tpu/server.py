@@ -141,6 +141,10 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
     #: sentinel for an apply that raised (already acked 0); distinct
     #: from a legal None return, which counts as a served update
     _FAILED = object()
+    #: bound on the shutdown drain of in-flight applies (_main's
+    #: teardown waits for apply bookkeeping, not forever on a wedged
+    #: executor)
+    APPLY_DRAIN_S = 10.0
 
     @classmethod
     def init_parser(cls, parser):
@@ -379,6 +383,24 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             self._finishing = True
             watchdog.cancel()
             self._broadcast_stop()
+            # Drain in-flight applies before tearing down.  A workflow
+            # that completes INSIDE check_and_apply (decision latching
+            # ``complete`` on the executor thread) schedules the stop
+            # via call_soon_threadsafe BEFORE the executor future's own
+            # continuation, so returning here would let asyncio.run
+            # cancel the _apply_update coroutine mid-bookkeeping: the
+            # weights already mutated but updates_applied / the ack /
+            # deferred drops never ran (the kill-during-reshard
+            # lost-update race).  The continuation from the executor
+            # await through the counter bump has no awaits, so an empty
+            # _applying map guarantees the bookkeeping finished.
+            deadline = self._loop.time() + self.APPLY_DRAIN_S
+            while self._applying and self._loop.time() < deadline:
+                await asyncio.sleep(0.01)
+            if self._applying:
+                self.warning(
+                    "shutdown drain timed out with %d apply(s) still "
+                    "in flight", len(self._applying))
             for conn in list(self.slaves.values()):
                 conn.close_shm()
             self._server.close()
