@@ -108,6 +108,10 @@ SECTION_EST = {
     # in-process batcher, interleaved flood legs with class-ordered
     # shedding off/on + the quiet anchor leg
     "qos_ab": 30.0,
+    # elastic-mesh reshard A/B (docs/distributed.md "Elastic mesh
+    # contract"): two ZeRO-1 compiles (initial + cold shrink; the
+    # grow-back is the compile-cache hit under test) + 4 small steps
+    "reshard_ab": 60.0,
 }
 
 # a section whose dominant cost (the one-time server compile) loosely
@@ -199,6 +203,9 @@ def _compact_record(value, small, extras):
     if qos.get("qos_interactive_p99_guard") is not None:
         rec["qos_interactive_p99_guard"] = \
             qos["qos_interactive_p99_guard"]
+    reshard = extras.get("reshard_ab") or {}
+    if reshard.get("reshard_bytes_saved_pct") is not None:
+        rec["reshard_bytes_saved"] = reshard["reshard_bytes_saved_pct"]
     if "wall_s" in extras:
         rec["wall_s"] = extras["wall_s"]
     if extras.get("shed"):
@@ -1903,6 +1910,85 @@ def bench_qos_ab(small):
     }
 
 
+def bench_reshard_ab(small):
+    """Elastic-mesh reshard A/B (docs/distributed.md, "Elastic mesh
+    contract"): time-to-recover and bytes of train state moved for a
+    live consistent-hash reshard versus the full-gather baseline
+    (re-materializing all ``n_shards`` rows on every membership
+    change).  Three events on one MeshManager: a cold shrink (8 -> 6,
+    pays a recompile), a warm grow back to the seen 8-device set (the
+    digest-keyed compile cache makes rejoin recovery cheap — the
+    receipt row the rejoin story rests on), and a swap.  The seeded
+    soak with the crash leg is scripts/mesh_soak.py ->
+    ELASTIC_MESH.json."""
+    import jax as _jax
+
+    from veles_tpu.compiler import LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.parallel.mesh import MeshManager
+    devices = sorted(_jax.devices(), key=lambda d: d.id)
+    if len(devices) < 4:
+        return {"skipped": "needs >= 4 devices, have %d" % len(devices)}
+    fan_in, hidden, classes = 16, 48 if small else 128, 4
+    rng = numpy.random.RandomState(0)
+    hyper = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    plans = [LayerPlan(All2AllTanh, hyper=hyper),
+             LayerPlan(All2AllSoftmax, hyper=hyper)]
+    state = []
+    for fi, fo in ((fan_in, hidden), (hidden, classes)):
+        state.append({
+            "weights": rng.randn(fi, fo).astype(numpy.float32) * 0.1,
+            "bias": numpy.zeros(fo, numpy.float32),
+            "accum_weights": numpy.zeros((fi, fo), numpy.float32),
+            "accum_bias": numpy.zeros(fo, numpy.float32),
+            "accum2_weights": None, "accum2_bias": None})
+    n = len(devices)
+    batch = n * (n - 2) * 3  # divisible by every size the A/B visits
+    x = rng.randn(batch, fan_in).astype(numpy.float32)
+    y = (numpy.arange(batch) % classes).astype(numpy.int32)
+    mgr = MeshManager(plans, state, devices=devices, n_shards=2 * n,
+                      donate=False)
+    mgr.step(x, y)
+    mgr.step(x, y)
+    # shrink (cold compile), grow back (warm: the compile-cache hit),
+    # swap to a DIFFERENT same-size subset (ownership follows device
+    # identity).  reshard_s covers the state movement; the first
+    # post-reshard step carries the (lazily dispatched) compile, so
+    # time-to-recover is their sum.
+    first_step_s = []
+    for target in (devices[:n - 2], devices, devices[2:n]):
+        mgr.submit_membership(target)
+        t0 = time.perf_counter()
+        mgr.step(x, y)
+        first_step_s.append(time.perf_counter() - t0)
+    rows = []
+    for ev, step_s in zip(mgr.reshard_log, first_step_s):
+        row = {k: ev[k] for k in (
+            "from_size", "to_size", "moved_shards", "changed_fraction",
+            "bytes_moved", "full_gather_bytes", "reshard_s",
+            "compile_cached")}
+        row["time_to_recover_s"] = round(ev["reshard_s"] + step_s, 4)
+        rows.append(row)
+    moved = sum(r["bytes_moved"] for r in rows)
+    full = sum(r["full_gather_bytes"] for r in rows)
+    warm = [r["time_to_recover_s"] for r in rows if r["compile_cached"]]
+    cold = [r["time_to_recover_s"] for r in rows
+            if not r["compile_cached"]]
+    return {
+        "devices": n,
+        "n_shards": mgr.n_shards,
+        "events": rows,
+        "bytes_moved_total": moved,
+        "full_gather_bytes_total": full,
+        "reshard_bytes_saved_pct": (
+            round(100.0 * (1.0 - moved / full), 1) if full else None),
+        "cold_recover_s": round(max(cold), 4) if cold else None,
+        "warm_recover_s": round(max(warm), 4) if warm else None,
+        "warm_over_cold": (round(max(warm) / max(cold), 3)
+                           if warm and cold and max(cold) else None),
+    }
+
+
 def _build_native():
     from veles_tpu import native
     native.build_native()
@@ -2106,6 +2192,14 @@ def main():
     qos_res = section("qos_ab", lambda: bench_qos_ab(small))
     if qos_res is not None:
         extras["qos_ab"] = qos_res
+
+    # elastic-mesh reshard A/B (docs/distributed.md "Elastic mesh
+    # contract"): time-to-recover + bytes moved for a consistent-hash
+    # live reshard vs the full-gather baseline, cold and warm legs
+    reshard_res = section("reshard_ab",
+                          lambda: bench_reshard_ab(small))
+    if reshard_res is not None:
+        extras["reshard_ab"] = reshard_res
 
     # AlexNet rows, one program (= one ~60-200 s server compile) each.
     # Batch 256 bf16 = the throughput/MFU sweet spot and the only

@@ -10,10 +10,10 @@ reclaimed).  Each incarnation's kill point comes from an aK-style
 seeded ``slave.rejoin_after`` delay and respawns.  Receipts:
 
 - **bit-stable convergence**: the soaked master's final weights are
-  bit-identical to a fault-free run of the same seeds (momentum-free
-  layers — slave-local solver state is NOT shipped per job, so only
-  stateless jobs make a respawned process equivalent to a surviving
-  one; docs/distributed.md documents the caveat);
+  bit-identical to a fault-free run of the same seeds (solver state
+  ships with every job the same way params do, so momentum layers
+  replay bit-faithfully through a respawn too; docs/distributed.md,
+  "Exactly-once updates");
 - **bounded throughput loss**: soak wall time minus fault-free wall
   time stays under the injected rejoin delays plus a per-preempt
   respawn allowance (subprocess + jax import + workflow build);
@@ -50,14 +50,15 @@ from veles_tpu.chaos import FaultPlan  # noqa: E402
 from veles_tpu.loader.fullbatch import FullBatchLoader  # noqa: E402
 from veles_tpu.prng import RandomGenerator  # noqa: E402
 
-#: momentum-free on purpose: gd solver state (velocity) lives on the
-#: slave and is NOT shipped per job, so only stateless jobs make a
-#: RESPAWNED slave process bit-equivalent to one that survived
+#: momentum ON: the gd units ship their solver accumulators with every
+#: job (nn_units.GradientDescentBase master-slave contract) and the
+#: master merges the deltas, so a RESPAWNED slave replays momentum
+#: runs bit-equivalently to one that survived — the soak proves it
 LAYERS = [
     {"type": "all2all_tanh", "output_sample_shape": 24,
-     "learning_rate": 0.05, "gradient_moment": 0.0},
+     "learning_rate": 0.05, "gradient_moment": 0.9},
     {"type": "softmax", "output_sample_shape": 4,
-     "learning_rate": 0.05, "gradient_moment": 0.0},
+     "learning_rate": 0.05, "gradient_moment": 0.9},
 ]
 
 #: per-preempt respawn allowance for the throughput bound: process
@@ -337,9 +338,9 @@ def main(argv=None):
             "max_epochs": args.max_epochs,
             "minibatch": 64,
             "train_samples": 256,
-            "layers": "all2all_tanh(24)+softmax(4), momentum-free "
-                      "(slave-local solver state is not shipped per "
-                      "job; see docs/distributed.md)",
+            "layers": "all2all_tanh(24)+softmax(4), momentum 0.9 "
+                      "(solver accumulators ship with every job; see "
+                      "docs/distributed.md, Exactly-once updates)",
         },
         "fault_free": ref,
         "soak": soak,

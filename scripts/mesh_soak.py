@@ -1,0 +1,364 @@
+"""Elastic-mesh soak: live-reshard a ZeRO-1 training mesh through a
+seeded shrink/grow/swap schedule and prove the elastic-mesh contract
+with receipts (ELASTIC_MESH.json; docs/distributed.md, "Elastic mesh
+contract").
+
+The driver trains the chaos-suite MLP through a
+:class:`veles_tpu.parallel.mesh.MeshManager` over 8 virtual CPU
+devices (``--xla_force_host_platform_device_count``; the protocol
+under test — consistent-hash ownership, slot-table repack, digest-
+keyed compile cache — is device-agnostic) and receipts:
+
+- **fixed-mesh bit-identity**: the ZeRO-1 step (reduce-scatter +
+  all-gather, sharded optimizer state) produces bit-identical params
+  AND solver accumulators to the flat all-reduce SPMD step
+  (``grad_bucket_mb=inf``) on a fixed mesh;
+- **ZeRO-1 memory**: per-device optimizer-state bytes shrink ~1/N
+  versus the replicated flat path (measured from the live arrays'
+  ``addressable_shards``; ``device_memory_gauges`` rides along);
+- **soaked convergence**: final weights after the seeded
+  shrink->coalesced-shrink->grow->swap schedule stay within the TP
+  ULP contract (<= 1e-3 max rel, docs/parallel.md) of the fault-free
+  fixed-mesh run — reshards move rows, never values, so the only
+  drift is the reduce association order changing with N;
+- **minimal movement**: every reshard's ``bytes_moved`` equals the
+  changed-owner fraction of the state and stays strictly under the
+  full-gather reference (``n_shards`` rows) the receipt carries;
+- **warm rejoin**: growing back to a previously-seen device set hits
+  the digest-keyed compile cache (no recompile in the recovery path);
+- **exactly-once minibatches**: the soak and the crash leg consume
+  every minibatch index exactly once — nothing lost, nothing
+  double-applied across reshard or crash-recovery boundaries;
+- **crash-mid-reshard recovery**: ``mesh.reshard=crash`` dies after
+  the safety snapshot, before destructive movement;
+  ``MeshManager.resume`` (the ``--resume auto`` path) rebuilds from
+  the manifest-verified snapshot and the finished run is bit-identical
+  to the uninterrupted elastic run.
+
+    python scripts/mesh_soak.py --out ELASTIC_MESH.json \
+        [--steps 12] [--seed 42]
+
+Exit code 0 only when every gate holds.  The tier-1 equivalents live
+in tests/test_mesh.py (``mesh`` marker).
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy  # noqa: E402
+
+#: global batch — divisible by every mesh size the schedule can reach
+BATCH = 48
+FAN_IN, HIDDEN, CLASSES = 16, 32, 4
+#: the TP ULP contract bound the soaked run must stay inside
+#: (docs/parallel.md: association order changes with N, values don't)
+ULP_BOUND = 1e-3
+
+
+def _plans():
+    from veles_tpu.compiler import LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    hyper = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    return [LayerPlan(All2AllTanh, hyper=hyper),
+            LayerPlan(All2AllSoftmax, hyper=hyper)]
+
+
+def _state(seed):
+    rng = numpy.random.RandomState(seed)
+    out = []
+    for fi, fo in ((FAN_IN, HIDDEN), (HIDDEN, CLASSES)):
+        out.append({
+            "weights": rng.randn(fi, fo).astype(numpy.float32) * 0.1,
+            "bias": numpy.zeros(fo, numpy.float32),
+            "accum_weights": numpy.zeros((fi, fo), numpy.float32),
+            "accum_bias": numpy.zeros(fo, numpy.float32),
+            "accum2_weights": None, "accum2_bias": None})
+    return out
+
+
+def _data(seed, steps):
+    rng = numpy.random.RandomState(seed + 1)
+    xs = [rng.randn(BATCH, FAN_IN).astype(numpy.float32)
+          for _ in range(steps)]
+    ys = [(rng.randint(0, CLASSES, BATCH)).astype(numpy.int32)
+          for _ in range(steps)]
+    return xs, ys
+
+
+def _schedule(seed, steps):
+    """The seeded membership schedule: (step -> list of device-set
+    builders).  Two sets submitted at one boundary prove coalescing;
+    the grow back to the full set proves the warm rejoin; the swap
+    proves ownership follows device identity, not position."""
+    rnd = random.Random(seed)
+    # four event boundaries spread over the run, in order, >= 1 apart
+    marks = sorted(rnd.sample(range(2, steps - 1), 4))
+    return {
+        marks[0]: [lambda d: d[:6]],                 # shrink 8 -> 6
+        marks[1]: [lambda d: d[:5], lambda d: d[:4]],  # coalesce -> 4
+        marks[2]: [lambda d: d],                     # grow 4 -> 8 (warm)
+        marks[3]: [lambda d: d[2:8]],                # swap to 6 others
+    }
+
+
+def _final(mgr):
+    return mgr.canonical_state()
+
+
+def _max_rel(a, b):
+    worst = 0.0
+    for pa, pb in zip(a, b):
+        for key in ("weights", "bias", "accum_weights", "accum_bias"):
+            x = numpy.asarray(pa[key], numpy.float64)
+            y = numpy.asarray(pb[key], numpy.float64)
+            denom = numpy.maximum(numpy.abs(y), 1e-12)
+            worst = max(worst, float(numpy.max(numpy.abs(x - y) / denom)))
+    return worst
+
+
+def _bit_identical(a, b, keys=("weights", "bias", "accum_weights",
+                               "accum_bias")):
+    return all(numpy.array_equal(numpy.asarray(pa[k]),
+                                 numpy.asarray(pb[k]))
+               for pa, pb in zip(a, b) for k in keys)
+
+
+def _accum_device_bytes(state, devices):
+    """Per-device bytes of optimizer state measured from the live
+    arrays' addressable shards (works for replicated AND sharded
+    placements; host-numpy leaves count as fully replicated)."""
+    per_device = {d.id: 0 for d in devices}
+    for entry in state:
+        for key in ("accum_weights", "accum_bias", "accum2_weights",
+                    "accum2_bias"):
+            arr = entry.get(key)
+            if arr is None:
+                continue
+            shards = getattr(arr, "addressable_shards", None)
+            if shards is None:
+                for d in per_device:
+                    per_device[d] += int(arr.nbytes)
+                continue
+            for shard in shards:
+                per_device[shard.device.id] += int(shard.data.nbytes)
+    return per_device
+
+
+def leg_fixed_identity(steps, seed):
+    """Flat all-reduce vs ZeRO-1 on the SAME fixed 8-device mesh:
+    bit-identical state, and the per-device optimizer bytes ratio."""
+    import jax
+
+    from veles_tpu import compiler
+    from veles_tpu.observe.xla_introspect import device_memory_gauges
+    from veles_tpu.parallel.mesh import MeshManager, auto_mesh
+    devices = sorted(jax.devices(), key=lambda d: d.id)
+    xs, ys = _data(seed, steps)
+    mesh = auto_mesh("data", devices)
+
+    flat_step = compiler.build_train_step(
+        _plans(), mesh=mesh, grad_bucket_mb=float("inf"), donate=False)
+    flat_state = _state(seed)
+    for i in range(steps):
+        flat_state, flat_metrics = flat_step(
+            flat_state, xs[i], ys[i], numpy.float32(BATCH))
+    flat_bytes = _accum_device_bytes(flat_state, devices)
+
+    mgr = MeshManager(_plans(), _state(seed), devices=devices,
+                      n_shards=16, donate=False)
+    for i in range(steps):
+        zero_metrics = mgr.step(xs[i], ys[i])
+    zero_bytes = _accum_device_bytes(mgr._state, devices)
+
+    flat_final = [{k: numpy.asarray(v) for k, v in e.items()
+                   if v is not None} for e in flat_state]
+    identical = _bit_identical(flat_final, _final(mgr))
+    ratio = (max(zero_bytes.values()) / max(flat_bytes.values())
+             if max(flat_bytes.values()) else None)
+    return {
+        "steps": steps,
+        "flat_vs_zero_bit_identical": bool(identical),
+        "loss_last": {"flat": float(flat_metrics["loss"]),
+                      "zero": float(zero_metrics["loss"])},
+        "grad_norm_last": {"flat": float(flat_metrics["grad_norm"]),
+                           "zero": float(zero_metrics["grad_norm"])},
+        "zero1_memory": {
+            "n_devices": len(devices),
+            "n_shards": mgr.n_shards,
+            "flat_per_device_opt_bytes": max(flat_bytes.values()),
+            "zero_per_device_opt_bytes": max(zero_bytes.values()),
+            "per_device_ratio": None if ratio is None
+            else round(ratio, 4),
+            # ~1/N plus the ceil-division pad on each tensor
+            "bound": round(1.5 / len(devices), 4),
+            "device_memory_gauges": device_memory_gauges(),
+        },
+    }
+
+
+def _run_elastic(steps, seed, schedule, snapshot_dir=None, crash=False):
+    """One elastic run over the seeded schedule; returns (manager,
+    ledger of minibatch indices consumed, crash/resume count)."""
+    import jax
+
+    from veles_tpu import chaos
+    from veles_tpu.parallel.mesh import MeshManager
+    devices = sorted(jax.devices(), key=lambda d: d.id)
+    xs, ys = _data(seed, steps)
+    mgr = MeshManager(_plans(), _state(seed), devices=devices,
+                      n_shards=16, snapshot_dir=snapshot_dir,
+                      donate=False)
+    if crash:
+        chaos.install(chaos.FaultPlan.from_spec("mesh.reshard=crash:n1"))
+    ledger = []
+    resumes = 0
+    last_devices = devices
+    try:
+        while mgr.applied_steps < steps:
+            for build in schedule.get(mgr.applied_steps, ()):
+                last_devices = mgr._order(build(devices))
+                mgr.submit_membership(last_devices)
+            i = mgr.applied_steps
+            try:
+                mgr.step(xs[i], ys[i])
+            except chaos.ChaosCrash:
+                # "process died" mid-reshard: the --resume auto path
+                resumes += 1
+                mgr = MeshManager.resume(snapshot_dir, _plans(),
+                                         devices=last_devices,
+                                         donate=False)
+                continue
+            ledger.append(i)
+    finally:
+        if crash:
+            chaos.uninstall()
+    return mgr, ledger, resumes
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default="ELASTIC_MESH.json")
+    parser.add_argument("--steps", type=int, default=12)
+    parser.add_argument("--seed", type=int, default=42)
+    args = parser.parse_args(argv)
+    t0 = time.time()
+
+    print("== fixed-mesh flat-vs-ZeRO identity + memory ==")
+    fixed = leg_fixed_identity(args.steps, args.seed)
+    mem = fixed["zero1_memory"]
+    print("   bit_identical=%s, per-device opt bytes %d -> %d (%.3fx)"
+          % (fixed["flat_vs_zero_bit_identical"],
+             mem["flat_per_device_opt_bytes"],
+             mem["zero_per_device_opt_bytes"],
+             mem["per_device_ratio"] or 0))
+
+    print("== fault-free fixed-mesh reference ==")
+    ref, ref_ledger, _ = _run_elastic(args.steps, args.seed, {})
+    ref_state = _final(ref)
+
+    print("== elastic soak: seeded shrink/coalesce/grow/swap ==")
+    schedule = _schedule(args.seed, args.steps)
+    soak, soak_ledger, _ = _run_elastic(args.steps, args.seed, schedule)
+    soak_state = _final(soak)
+    max_rel = _max_rel(soak_state, ref_state)
+    sizes = [ev["to_size"] for ev in soak.reshard_log]
+    print("   reshards %s, max_rel vs fault-free %.3g" % (sizes, max_rel))
+
+    print("== crash-mid-reshard recovery (mesh.reshard=crash) ==")
+    with tempfile.TemporaryDirectory() as snapdir:
+        crashed, crash_ledger, resumes = _run_elastic(
+            args.steps, args.seed, schedule, snapshot_dir=snapdir,
+            crash=True)
+        crash_state = _final(crashed)
+    crash_identical = _bit_identical(crash_state, soak_state)
+    print("   resumes=%d, bit_identical to uninterrupted soak: %s"
+          % (resumes, crash_identical))
+
+    want_ledger = list(range(args.steps))
+    movement_ok = all(
+        ev["bytes_moved"] == round(
+            ev["changed_fraction"] * ev["full_gather_bytes"])
+        and ev["bytes_moved"] < ev["full_gather_bytes"]
+        for ev in soak.reshard_log)
+    from veles_tpu.observe.metrics import registry as _registry
+    gates = {
+        "flat_vs_zero_bit_identical":
+            fixed["flat_vs_zero_bit_identical"],
+        "zero1_memory_1_over_n":
+            mem["per_device_ratio"] is not None
+            and mem["per_device_ratio"] <= mem["bound"],
+        "soak_within_ulp_bound": max_rel <= ULP_BOUND,
+        "minibatch_ledger_exact":
+            ref_ledger == want_ledger and soak_ledger == want_ledger
+            and crash_ledger == want_ledger,
+        "movement_minimal": movement_ok,
+        "coalesced_event_seen":
+            _registry.counter("mesh.coalesced_events").value >= 1,
+        "warm_rejoin_compile_cached": any(
+            ev["compile_cached"] for ev in soak.reshard_log
+            if ev["to_size"] == 8),
+        "crash_recovery_bit_identical": bool(crash_identical),
+        "crash_resumed_once": resumes == 1,
+    }
+    receipt = {
+        "schema": "elastic-mesh-soak-v1",
+        "generated_unix": int(time.time()),
+        "platform": "cpu (JAX_PLATFORMS=cpu, 8 virtual devices — the "
+                    "ownership/repack/compile-cache protocol under "
+                    "test is device-agnostic; TPU-pod receipt is the "
+                    "outstanding ROADMAP item)",
+        "seed": args.seed,
+        "config": {
+            "steps": args.steps, "batch": BATCH,
+            "layers": "all2all_tanh(%d)+softmax(%d), momentum 0.9"
+                      % (HIDDEN, CLASSES),
+            "n_shards": 16, "ulp_bound": ULP_BOUND,
+        },
+        "fixed_identity": fixed,
+        "soak": {
+            "schedule_sizes": sizes,
+            "reshard_events": soak.reshard_log,
+            "applied_steps": soak.applied_steps,
+            "max_rel_vs_fault_free": max_rel,
+            "bytes_moved_total": sum(
+                ev["bytes_moved"] for ev in soak.reshard_log),
+            "full_gather_total": sum(
+                ev["full_gather_bytes"] for ev in soak.reshard_log),
+        },
+        "crash_recovery": {
+            "resumes": resumes,
+            "bit_identical_to_uninterrupted": bool(crash_identical),
+            "applied_steps": crashed.applied_steps,
+            "minibatches_lost": len(set(want_ledger) -
+                                    set(crash_ledger)),
+            "minibatches_double_applied": len(crash_ledger) -
+            len(set(crash_ledger)),
+        },
+        "wall_s": round(time.time() - t0, 1),
+        "gates": gates,
+        "pass": all(gates.values()),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(receipt, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print("wrote %s: %d reshards, pass=%s (%s)" % (
+        args.out, len(soak.reshard_log), receipt["pass"],
+        ", ".join(k for k, v in gates.items() if not v) or "all gates"))
+    return 0 if receipt["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

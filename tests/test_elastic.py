@@ -1116,3 +1116,109 @@ def test_loader_unserved_remainder_tracks_epoch_progress(cpu_device):
     # mid-epoch arithmetic (no serving needed: pure accounting)
     loader.samples_served = total + 70
     assert loader.unserved_remainder() == total - 70
+
+
+# -- reshard-failure rejoin + mesh-epoch stamping -------------------------
+
+
+class _HookFailSlave(_StubSlave):
+    """apply_reshard raises on the FIRST push only — the stale-
+    elasticity-state shape the sever-and-rejoin contract covers."""
+
+    def __init__(self, *args, **kwargs):
+        super(_HookFailSlave, self).__init__(*args, **kwargs)
+        self.failures_left = 1
+
+    def apply_reshard(self, info):
+        if self.failures_left:
+            self.failures_left -= 1
+            raise RuntimeError("loader cannot adopt the new window")
+        super(_HookFailSlave, self).apply_reshard(info)
+
+
+def test_reshard_hook_failure_severs_and_rejoins_at_fresh_epoch():
+    """Regression for the log-and-continue swallow: a failed
+    ``apply_reshard`` hook leaves the slave on stale elasticity state,
+    so the client must sever the session and rejoin at a fresh
+    membership epoch — counted in ``elastic.reshard_failures``."""
+    before = _registry.counter("elastic.reshard_failures").value
+    master = _StubMaster([], remainder=100)
+    server, _ = _stub_server(master)
+    wf = _HookFailSlave()
+    client = Client("127.0.0.1:%d" % server.port, wf)
+    thread = client.start_background()
+    try:
+        # first join push fails the hook -> sever -> reconnect; the
+        # rejoin bumps the epoch past the leave and the replayed push
+        # lands on a hook that now works
+        _wait_for(lambda: wf.reshards, what="post-rejoin reshard push")
+        _wait_for(lambda: client.sessions_established >= 2,
+                  what="fresh handshake after the sever")
+        assert wf.failures_left == 0
+        assert _registry.counter("elastic.reshard_failures").value \
+            == before + 1
+        # join(1) + leave(2) + rejoin(3): the recorded epoch is FRESH
+        assert wf.reshards[-1]["epoch"] >= 3
+        assert client.member_epoch >= 3
+    finally:
+        server.stop()
+        server._done.wait(10)
+        thread.join(10)
+
+
+def test_reshard_frame_carries_mesh_epoch():
+    """A master training through a MeshManager stamps its device-mesh
+    epoch into reshard frames so slaves can correlate membership churn
+    with the train-state reshard it produced."""
+
+    class _MeshStub(object):
+        mesh_epoch = 7
+
+    master = _StubMaster([], remainder=100)
+    server, _ = _stub_server(master)
+    server.mesh_manager = _MeshStub()
+    wf = _StubSlave()
+    client = Client("127.0.0.1:%d" % server.port, wf)
+    thread = client.start_background()
+    try:
+        _wait_for(lambda: wf.reshards, what="join reshard push")
+        assert wf.reshards[-1]["mesh_epoch"] == 7
+        assert client.mesh_epoch == 7
+    finally:
+        server.stop()
+        server._done.wait(10)
+        thread.join(10)
+
+
+# -- solver-state delta shipping (momentum through respawn) ---------------
+
+
+def test_gd_units_ship_solver_state_deltas(cpu_device):
+    """The PR-9 caveat closed: gd units ship canonical solver
+    accumulators with each job and merge the slave's accumulator
+    deltas additively — the same master-slave contract params ride —
+    so a respawned slave replays momentum runs bit-faithfully
+    (receipted at soak scale in ELASTIC.json)."""
+    from tests.test_chaos import _build as _build_chaos
+    master = _build_chaos("master", "elastic_accum_m", cpu_device)
+    slave = _build_chaos("slave", "elastic_accum_s", cpu_device)
+    gd_m, gd_s = master.gds[0], slave.gds[0]
+    gd_m.accum_weights.map_invalidate()
+    gd_m.accum_weights.mem[:] = 0.25
+    payload = gd_m.generate_data_for_slave()
+    assert numpy.all(payload["accum_weights"] == 0.25)
+    assert "accum_bias" in payload
+
+    gd_s.apply_data_from_master(payload)
+    gd_s.accum_weights.map_read()
+    assert numpy.all(gd_s.accum_weights.mem == 0.25)
+    # the slave trains: its accums move; the delta is what ships back
+    gd_s.accum_weights.map_write()
+    gd_s.accum_weights.mem += 1.0
+    delta = gd_s.generate_data_for_master()
+    assert numpy.allclose(delta["accum_weights"], 1.0)
+    assert numpy.allclose(delta["accum_bias"], 0.0)
+
+    gd_m.apply_data_from_slave(delta)
+    gd_m.accum_weights.map_read()
+    assert numpy.allclose(gd_m.accum_weights.mem, 1.25)

@@ -1,0 +1,438 @@
+"""Elastic device-mesh tests (docs/distributed.md, "Elastic mesh
+contract"): consistent-hash shard ownership, the ZeRO-1 state layout
+and its flat-all-reduce bit-identity, and the MeshManager's live
+reshard — quiesce/coalesce, minimal movement, warm-rejoin compile
+cache, safety-snapshot crash recovery.  The seeded soak receipt is
+scripts/mesh_soak.py -> ELASTIC_MESH.json."""
+
+import numpy
+import pytest
+
+import jax
+
+from veles_tpu import chaos
+from veles_tpu.compiler import LayerPlan, build_train_step
+from veles_tpu.elastic import FleetView, movement_plan, shard_owners
+from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+from veles_tpu.observe.metrics import registry as _registry
+from veles_tpu.parallel.mesh import (
+    MeshManager, auto_mesh, mesh_snapshot, unzero_state, zero_slot_table,
+    zero_state)
+
+pytestmark = pytest.mark.mesh
+
+DEVICES = sorted(jax.devices(), key=lambda d: d.id)
+
+FAN_IN, HIDDEN, CLASSES = 16, 32, 4
+
+
+def _plans(solver="momentum"):
+    hyper = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    return [LayerPlan(All2AllTanh, solver=solver, hyper=hyper),
+            LayerPlan(All2AllSoftmax, solver=solver, hyper=hyper)]
+
+
+def _state(seed=0, adadelta=False):
+    rng = numpy.random.RandomState(seed)
+    out = []
+    for fi, fo in ((FAN_IN, HIDDEN), (HIDDEN, CLASSES)):
+        entry = {
+            "weights": rng.randn(fi, fo).astype(numpy.float32) * 0.1,
+            "bias": numpy.zeros(fo, numpy.float32),
+            "accum_weights": numpy.zeros((fi, fo), numpy.float32),
+            "accum_bias": numpy.zeros(fo, numpy.float32),
+            "accum2_weights": None, "accum2_bias": None}
+        if adadelta:
+            entry["accum2_weights"] = numpy.zeros((fi, fo),
+                                                  numpy.float32)
+            entry["accum2_bias"] = numpy.zeros(fo, numpy.float32)
+        out.append(entry)
+    return out
+
+
+def _batch(seed=0, n=48):
+    rng = numpy.random.RandomState(seed + 1)
+    x = rng.randn(n, FAN_IN).astype(numpy.float32)
+    y = (rng.randint(0, CLASSES, n)).astype(numpy.int32)
+    return x, y
+
+
+def _assert_states_equal(a, b):
+    keys = ("weights", "bias", "accum_weights", "accum_bias",
+            "accum2_weights", "accum2_bias")
+    for pa, pb in zip(a, b):
+        for key in keys:
+            va, vb = pa.get(key), pb.get(key)
+            if va is None or vb is None:
+                assert va is None and vb is None
+                continue
+            numpy.testing.assert_array_equal(
+                numpy.asarray(va), numpy.asarray(vb), err_msg=key)
+
+
+# -- consistent-hash ownership (elastic.shard_owners) ---------------------
+
+
+def test_shard_owners_exact_quotas_and_determinism():
+    members = ["d%d" % i for i in range(5)]
+    owners = shard_owners(16, members)
+    assert sorted(owners) == list(range(16))
+    counts = {m: 0 for m in members}
+    for m in owners.values():
+        counts[m] += 1
+    # 16 over 5: three members own 3, two own 4 (floor/ceil quotas)
+    assert sorted(counts.values()) == [3, 3, 3, 3, 4]
+    assert owners == shard_owners(16, list(reversed(members)))
+
+
+def test_shard_owners_leave_moves_only_departed_shards():
+    members = ["d%d" % i for i in range(8)]
+    before = shard_owners(16, members)
+    after = shard_owners(16, members[:6], previous=before)
+    departed = {s for s, m in before.items() if m in ("d6", "d7")}
+    moved = {s for s in after if after[s] != before.get(s)}
+    # minimal movement: ONLY the departed members' shards move...
+    assert moved == departed
+    # ...and the survivors' plans agree
+    plan = movement_plan(before, after)
+    assert plan["n_moved"] == len(departed)
+    assert plan["changed_fraction"] == pytest.approx(
+        len(departed) / 16.0)
+
+
+def test_shard_owners_join_sheds_at_most_quota_excess():
+    members = ["d%d" % i for i in range(6)]
+    before = shard_owners(18, members)          # 3 each
+    after = shard_owners(18, members + ["d6"], previous=before)
+    counts = {}
+    for m in after.values():
+        counts[m] = counts.get(m, 0) + 1
+    # every member lands on floor/ceil of 18/7 = 2..3
+    assert set(counts.values()) <= {2, 3}
+    moved = sum(1 for s in after if after[s] != before.get(s))
+    # the joiner's quota is filled by shed shards only — never a
+    # reshuffle among survivors
+    assert moved == counts["d6"]
+
+
+def test_movement_plan_counts_new_shards_as_moved():
+    plan = movement_plan({}, {0: "a", 1: "b"})
+    assert plan["n_moved"] == 2
+    assert plan["changed_fraction"] == 1.0
+
+
+# -- ZeRO-1 state layout --------------------------------------------------
+
+
+def test_zero_slot_table_round_robin_and_padding():
+    table = zero_slot_table(5, 2)
+    # k = ceil(5/2) = 3 slots per device; pad id is n_shards (5)
+    assert table.shape == (6,)
+    assert table.dtype == numpy.int32
+    assert sorted(t for t in table if t != 5) == [0, 1, 2, 3, 4]
+    assert list(table).count(5) == 1
+
+
+def test_zero_slot_table_rejects_over_capacity():
+    with pytest.raises(ValueError):
+        zero_slot_table(4, 2, owners={0: 0, 1: 0, 2: 0, 3: 1})
+
+
+def test_zero_state_round_trip_bit_exact():
+    state = _state(seed=3, adadelta=True)
+    rng = numpy.random.RandomState(7)
+    for entry in state:   # non-trivial accums: round-trip must move rows
+        for key in ("accum_weights", "accum_bias", "accum2_weights",
+                    "accum2_bias"):
+            entry[key] = rng.randn(*entry[key].shape).astype(
+                numpy.float32)
+    packed = zero_state(state, 8, n_shards=16)
+    assert all(e["zero_slots"].shape == (16,) for e in packed)
+    _assert_states_equal(unzero_state(packed, 16), state)
+
+
+# -- flat-vs-ZeRO bit-identity on a fixed mesh ---------------------------
+
+
+@pytest.mark.dist
+@pytest.mark.parametrize("solver,n_shards", [
+    ("momentum", None), ("momentum", 16), ("adadelta", 16)])
+def test_zero1_step_bit_identical_to_flat_allreduce(solver, n_shards):
+    """The tentpole numerics gate: reduce-scatter + all-gather with
+    sharded optimizer state produces bit-identical params AND accums
+    to the flat all-reduce step — psum_scatter sums like psum, the
+    repack moves rows, never values.  Any logical shard layout."""
+    mesh = auto_mesh("data", DEVICES)
+    plans = _plans(solver)
+    adadelta = solver == "adadelta"
+    x, y = _batch()
+    flat_step = build_train_step(plans, mesh=mesh,
+                                 grad_bucket_mb=float("inf"),
+                                 donate=False)
+    zero_step = build_train_step(plans, mesh=mesh, zero=1,
+                                 zero_shards=n_shards, donate=False)
+    flat = _state(adadelta=adadelta)
+    packed = zero_state(_state(adadelta=adadelta), len(DEVICES),
+                        n_shards=n_shards)
+    m = n_shards or len(DEVICES)
+    for _ in range(3):
+        flat, flat_metrics = flat_step(flat, x, y, numpy.float32(48))
+        packed, zero_metrics = zero_step(packed, x, y,
+                                         numpy.float32(48))
+    flat_host = [{k: None if v is None else numpy.asarray(v)
+                  for k, v in e.items()} for e in flat]
+    _assert_states_equal(unzero_state(packed, m), flat_host)
+    assert float(flat_metrics["loss"]) == float(zero_metrics["loss"])
+    # grad_norm may differ in last ULPs (per-shard association)
+    assert float(zero_metrics["grad_norm"]) == pytest.approx(
+        float(flat_metrics["grad_norm"]), rel=1e-5)
+
+
+@pytest.mark.dist
+def test_zero1_optimizer_state_shards_to_1_over_n():
+    """The ZeRO-1 memory receipt: per-device optimizer-state bytes
+    shrink ~1/N vs the replicated flat path (addressable_shards
+    accounting; device_memory_gauges publishes the census gauges)."""
+    from veles_tpu.observe.xla_introspect import device_memory_gauges
+    mesh = auto_mesh("data", DEVICES)
+    n = len(DEVICES)
+    x, y = _batch()
+    flat_step = build_train_step(_plans(), mesh=mesh,
+                                 grad_bucket_mb=float("inf"),
+                                 donate=False)
+    zero_step = build_train_step(_plans(), mesh=mesh, zero=1,
+                                 zero_shards=2 * n, donate=False)
+    flat, _ = flat_step(_state(), x, y, numpy.float32(48))
+    packed, _ = zero_step(zero_state(_state(), n, n_shards=2 * n),
+                          x, y, numpy.float32(48))
+
+    def per_device_accum_bytes(state):
+        out = {d.id: 0 for d in DEVICES}
+        for entry in state:
+            for key in ("accum_weights", "accum_bias"):
+                for shard in entry[key].addressable_shards:
+                    out[shard.device.id] += int(shard.data.nbytes)
+        return out
+
+    flat_bytes = per_device_accum_bytes(flat)
+    zero_bytes = per_device_accum_bytes(packed)
+    # flat replicates: every device holds the full accums
+    full = sum(e["accum_weights"].nbytes + e["accum_bias"].nbytes
+               for e in _state())
+    assert max(flat_bytes.values()) == full
+    # sharded: ~1/N plus the ceil-division pad per tensor
+    assert max(zero_bytes.values()) <= 1.5 * full / n
+    gauges = device_memory_gauges()
+    assert gauges, "memory gauges must publish on CPU too"
+
+
+# -- MeshManager: live reshard -------------------------------------------
+
+
+def test_mesh_manager_fixed_mesh_matches_flat_step():
+    """No membership events: the manager is a plain ZeRO-1 trainer,
+    bit-identical to the flat path."""
+    mesh = auto_mesh("data", DEVICES)
+    x, y = _batch()
+    flat_step = build_train_step(_plans(), mesh=mesh,
+                                 grad_bucket_mb=float("inf"),
+                                 donate=False)
+    flat = _state()
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES, n_shards=16,
+                      donate=False)
+    for _ in range(3):
+        flat, _ = flat_step(flat, x, y, numpy.float32(48))
+        mgr.step(x, y)
+    flat_host = [{k: None if v is None else numpy.asarray(v)
+                  for k, v in e.items()} for e in flat]
+    _assert_states_equal(mgr.canonical_state(), flat_host)
+    assert mgr.reshard_log == []
+
+
+def test_reshard_moves_only_changed_owner_bytes():
+    x, y = _batch()
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES, n_shards=16,
+                      donate=False)
+    mgr.step(x, y)
+    mgr.submit_membership(DEVICES[:6])
+    mgr.step(x, y)
+    (event,) = mgr.reshard_log
+    assert event["from_size"] == 8 and event["to_size"] == 6
+    # two departed devices owned 2 shards each (16 over 8)
+    assert event["moved_shards"] == 4
+    assert event["changed_fraction"] == pytest.approx(0.25)
+    assert event["bytes_moved"] < event["full_gather_bytes"]
+    assert event["bytes_moved"] == round(
+        event["changed_fraction"] * event["full_gather_bytes"])
+
+
+def test_reshard_convergence_within_ulp_band_and_warm_rejoin():
+    """Shrink then grow back: final state stays inside the TP ULP
+    contract of the fault-free run (association order changes with N;
+    rows never change), and the rejoin to a seen device set hits the
+    compile cache."""
+    x, y = _batch()
+    ref = MeshManager(_plans(), _state(), devices=DEVICES, n_shards=16,
+                      donate=False)
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES, n_shards=16,
+                      donate=False)
+    for i in range(6):
+        if i == 2:
+            mgr.submit_membership(DEVICES[:6])
+        if i == 4:
+            mgr.submit_membership(DEVICES)
+        ref.step(x, y)
+        mgr.step(x, y)
+    assert [e["to_size"] for e in mgr.reshard_log] == [6, 8]
+    assert mgr.reshard_log[1]["compile_cached"], \
+        "rejoining a seen device set must not recompile"
+    for pa, pb in zip(mgr.canonical_state(), ref.canonical_state()):
+        for key in ("weights", "bias"):
+            numpy.testing.assert_allclose(
+                pa[key], pb[key], rtol=1e-3, atol=1e-6)
+
+
+def test_shrink_to_one_device_and_grow_past_original():
+    x, y = _batch()
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES[:4],
+                      n_shards=16, donate=False)
+    mgr.step(x, y)
+    mgr.submit_membership(DEVICES[:1])
+    mgr.step(x, y)
+    assert mgr.size == 1
+    # grow PAST the original size: 1 -> 8
+    mgr.submit_membership(DEVICES)
+    mgr.step(x, y)
+    assert mgr.size == 8
+    assert [e["to_size"] for e in mgr.reshard_log] == [1, 8]
+    # every device owns at least one of the 16 shards after the grow
+    assert len(set(mgr._owners.values())) == 8
+
+
+def test_back_to_back_events_coalesce_into_one_reshard():
+    x, y = _batch()
+    before = _registry.counter("mesh.coalesced_events").value
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES, n_shards=16,
+                      donate=False)
+    mgr.step(x, y)
+    mgr.submit_membership(DEVICES[:6])
+    mgr.submit_membership(DEVICES[:5])
+    mgr.submit_membership(DEVICES[:4])   # newest wins, one reshard
+    mgr.step(x, y)
+    assert [e["to_size"] for e in mgr.reshard_log] == [4]
+    assert _registry.counter("mesh.coalesced_events").value \
+        == before + 2
+
+
+def test_same_device_set_event_is_a_noop():
+    x, y = _batch()
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES, n_shards=16,
+                      donate=False)
+    mgr.step(x, y)
+    mgr.submit_membership(list(DEVICES))   # leave+rejoin of the same set
+    mgr.step(x, y)
+    assert mgr.reshard_log == []
+    assert mgr.mesh_epoch == 0
+
+
+def test_poisoned_step_skips_uniformly_across_reshard_boundary():
+    """The skip-step guard (docs/health.md) must hold THROUGH a
+    reshard: a poisoned gradient on the first post-reshard step leaves
+    params and solver state bit-identical to never having run it —
+    on the new mesh, uniformly across every device's owned shards."""
+    x, y = _batch()
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES, n_shards=16,
+                      donate=False)
+    mgr.step(x, y)
+    mgr.submit_membership(DEVICES[:6])
+    mgr.maybe_reshard()
+    before = mgr.canonical_state()
+    metrics = mgr.step(x, y, grad_poison=numpy.float32(float("nan")))
+    assert int(metrics["skipped"]) == 1
+    _assert_states_equal(mgr.canonical_state(), before)
+    # and the next clean step advances normally
+    clean = mgr.step(x, y)
+    assert int(clean["skipped"]) == 0
+
+
+def test_crash_mid_reshard_resumes_bit_exact(tmp_path):
+    """Chaos ``mesh.reshard=crash`` dies after the safety snapshot,
+    before destructive movement; MeshManager.resume (the --resume auto
+    path) restores from the manifest-verified snapshot and the run
+    finishes bit-identical to the uninterrupted one, with every step
+    applied exactly once."""
+    x, y = _batch()
+
+    def run(crash, snapdir):
+        mgr = MeshManager(_plans(), _state(), devices=DEVICES,
+                          n_shards=16, snapshot_dir=snapdir,
+                          donate=False)
+        if crash:
+            chaos.install(
+                chaos.FaultPlan.from_spec("mesh.reshard=crash:n1"))
+        applied = []
+        try:
+            while mgr.applied_steps < 6:
+                if mgr.applied_steps == 3 and not mgr.reshard_log:
+                    mgr.submit_membership(DEVICES[:6])
+                i = mgr.applied_steps
+                try:
+                    mgr.step(x, y)
+                except chaos.ChaosCrash:
+                    mgr = MeshManager.resume(snapdir, _plans(),
+                                             devices=DEVICES[:6],
+                                             donate=False)
+                    continue
+                applied.append(i)
+        finally:
+            if crash:
+                chaos.uninstall()
+        return mgr, applied
+
+    ref, ref_applied = run(False, str(tmp_path / "ref"))
+    mgr, applied = run(True, str(tmp_path / "crash"))
+    assert ref_applied == applied == list(range(6)), \
+        "no minibatch lost or double-applied across the crash"
+    _assert_states_equal(mgr.canonical_state(), ref.canonical_state())
+
+
+def test_sync_fleet_feeds_membership_from_fleet_view():
+    x, y = _batch()
+    fleet = FleetView()
+    fleet.join("s0", 1.0)
+    fleet.join("s1", 1.0)
+    by_sid = {"s0": DEVICES[:4], "s1": DEVICES[4:]}
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES, n_shards=16,
+                      donate=False)
+    assert mgr.sync_fleet(fleet, lambda sid: by_sid[sid])
+    # same epoch again: deduped, no new event
+    assert not mgr.sync_fleet(fleet, lambda sid: by_sid[sid])
+    mgr.step(x, y)
+    assert mgr.reshard_log == []   # same 8-device union: no-op
+    fleet.leave("s1")
+    assert mgr.sync_fleet(fleet, lambda sid: by_sid[sid])
+    mgr.step(x, y)
+    assert [e["to_size"] for e in mgr.reshard_log] == [4]
+
+
+def test_mesh_snapshot_publishes_gauges_and_histogram():
+    x, y = _batch()
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES, n_shards=16,
+                      donate=False)
+    mgr.step(x, y)
+    mgr.submit_membership(DEVICES[:6])
+    mgr.step(x, y)
+    snap = mesh_snapshot()
+    assert snap["size"] == 6
+    assert snap["epoch"] == mgr.mesh_epoch
+    assert snap["reshards"] >= 1
+    assert snap["bytes_moved"] >= mgr.reshard_log[-1]["bytes_moved"]
+    assert snap["reshard_s"]["count"] >= 1
+
+
+def test_batch_not_divisible_raises_helpfully():
+    mgr = MeshManager(_plans(), _state(), devices=DEVICES[:5],
+                      n_shards=16, donate=False)
+    x, y = _batch(n=48)   # 48 % 5 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        mgr.step(x, y)

@@ -109,6 +109,13 @@ point               module                     actions
                                                result exercises the
                                                duplicate-rejection
                                                fence deterministically)
+``mesh.reshard``    parallel.mesh              crash (die after the
+                    (MeshManager._reshard)     safety snapshot, before
+                                               destructive shard
+                                               movement —
+                                               ``MeshManager.resume``
+                                               / ``--resume auto``
+                                               recovers bit-exactly)
 ``serve.tenant.flood``  serve.batcher          (any action: ``param``
                     (per admission)            — default 32 —
                                                best_effort requests

@@ -118,6 +118,9 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
         self.share = None
         self.fleet_size = None
         self.reshards_seen = 0
+        #: device-mesh epoch stamped into reshard frames when the
+        #: master trains on an elastic mesh (parallel.mesh.MeshManager)
+        self.mesh_epoch = None
         self._handshaken = False
         self._session_progress = False
         self._stopping = False
@@ -486,12 +489,16 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
         the fleet's new membership epoch and this slave's power-
         weighted share, and forward both to the workflow's
         ``apply_reshard`` hook when it defines one (the loader records
-        them as its window hint).  Advisory by design — the master
-        still serves minibatches job by job, so a stale share can
-        never corrupt the sample accounting."""
+        them as its window hint).  The share itself is advisory — the
+        master still serves minibatches job by job, so a stale share
+        can never corrupt the sample accounting — but a FAILED hook is
+        not: a slave whose loader could not adopt the new window is
+        operating on stale elasticity state, so it severs and rejoins
+        at the fresh epoch instead of limping along."""
         self.member_epoch = msg.get("epoch", self.member_epoch)
         self.share = msg.get("share")
         self.fleet_size = msg.get("fleet")
+        self.mesh_epoch = msg.get("mesh_epoch", self.mesh_epoch)
         self.reshards_seen += 1
         _registry.gauge("elastic.membership_epoch").set(
             self.member_epoch or 0)
@@ -503,9 +510,16 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             try:
                 hook({"epoch": self.member_epoch, "share": self.share,
                       "fleet": self.fleet_size,
+                      "mesh_epoch": self.mesh_epoch,
                       "remaining": msg.get("remaining")})
             except Exception:
-                self.exception("apply_reshard hook failed")
+                self.exception("apply_reshard hook failed; severing to "
+                               "rejoin at membership epoch %s",
+                               self.member_epoch)
+                _registry.counter("elastic.reshard_failures").inc()
+                raise ConnectionResetError(
+                    "apply_reshard hook failed; rejoining at a fresh "
+                    "epoch")
 
     async def _run_job(self, data):
         result = {}

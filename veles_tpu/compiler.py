@@ -200,7 +200,8 @@ def build_forward(plans):
 
 def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
                    row_offset_fn=None, bwd_schedule=None,
-                   bwd_remat=False, forward_fn=None, gsq_fn=None):
+                   bwd_remat=False, forward_fn=None, gsq_fn=None,
+                   zero_update=None):
     """The raw (unjitted) train-step function shared by
     build_train_step (which jits one minibatch per dispatch) and
     build_train_epoch (which lax.scans it — one dispatch per epoch).
@@ -227,7 +228,15 @@ def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
     pipeline forward runs the stage wavefront) and ``gsq_fn(grads)``
     replaces the flat squared-sum for the numerics guard (sharded
     leaves need a model-axis psum so every shard sees the SAME global
-    norm and a poisoned step skips uniformly)."""
+    norm and a poisoned step skips uniformly).
+
+    ZeRO hook (:func:`_build_zero1_spmd_train_step`):
+    ``zero_update(state, grads)`` replaces the grad_sync + squared-sum
+    + update loop as one unit — the gradient merge (reduce-scatter),
+    the sharded solver update, and the param all-gather are coupled,
+    and the global grad-norm falls out of the owned shards.  Returns
+    ``(new_state, gsq)``; the finiteness guard and the skip-select
+    still run here so the skip contract has exactly one definition."""
     import jax
     import jax.numpy as jnp
 
@@ -304,7 +313,15 @@ def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
         # gradients makes the squared-sum non-finite, so isfinite of
         # the norm covers every leaf; both flags stay LAZY device
         # scalars riding the existing metrics result — no host sync
-        if gsq_fn is not None:
+        if zero_update is not None:
+            # ZeRO-1: reduce-scatter + sharded update + all-gather in
+            # one coupled unit; the grad-norm's squared-sum comes back
+            # from the owned shards (psum over the data axis, so the
+            # skip verdict below is uniform across ranks).  The
+            # poisons above inject BEFORE the reduce-scatter, so a
+            # fault on one shard still spreads like a real bad chip.
+            new_state, gsq = zero_update(state, grads)
+        elif gsq_fn is not None:
             gsq = gsq_fn(grads)
         else:
             gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -312,6 +329,27 @@ def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
         grad_norm = jnp.sqrt(gsq)
         step_finite = jnp.isfinite(loss_value) & jnp.isfinite(grad_norm)
 
+        new_state = new_state if zero_update is not None else \
+            _apply_solver(plans, hypers, state, grads)
+        # a non-finite update is SKIPPED, not applied: every state leaf
+        # falls back to its pre-step value, so one poisoned minibatch
+        # leaves params (and solver accumulators) bit-identical to
+        # never having served it (tests/test_health.py proves equality)
+        new_state = [GradientDescentBase.select_state(step_finite,
+                                                      entry, old)
+                     for entry, old in zip(new_state, state)]
+        if loss == "softmax":
+            metrics = {"loss": loss_value, "n_err": aux}
+        else:
+            metrics = {"loss": loss_value,
+                       "n_err": jnp.zeros((), jnp.int32),
+                       "mse_sum": aux}
+        metrics["grad_norm"] = grad_norm
+        metrics["finite"] = step_finite
+        metrics["skipped"] = (~step_finite).astype(jnp.int32)
+        return new_state, metrics
+
+    def _apply_solver(plans, hypers, state, grads):
         new_state = []
         for plan, hyper, s, g in zip(plans, hypers, state, grads):
             if s["weights"] is None:  # param-less layer (pooling, ...)
@@ -343,23 +381,7 @@ def _build_step_fn(plans, loss, grad_sync=None, metric_sync=None,
                 entry.update({"bias": new_b, "accum_bias": acc_b,
                               "accum2_bias": acc2_b})
             new_state.append(entry)
-        # a non-finite update is SKIPPED, not applied: every state leaf
-        # falls back to its pre-step value, so one poisoned minibatch
-        # leaves params (and solver accumulators) bit-identical to
-        # never having served it (tests/test_health.py proves equality)
-        new_state = [GradientDescentBase.select_state(step_finite,
-                                                      entry, old)
-                     for entry, old in zip(new_state, state)]
-        if loss == "softmax":
-            metrics = {"loss": loss_value, "n_err": aux}
-        else:
-            metrics = {"loss": loss_value,
-                       "n_err": jnp.zeros((), jnp.int32),
-                       "mse_sum": aux}
-        metrics["grad_norm"] = grad_norm
-        metrics["finite"] = step_finite
-        metrics["skipped"] = (~step_finite).astype(jnp.int32)
-        return new_state, metrics
+        return new_state
 
     return step
 
@@ -392,7 +414,7 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
                      donate=True, compiler_options=None,
                      grad_bucket_mb=None, grad_compress=None,
                      grad_allreduce_impl="psum", bwd_schedule=None,
-                     bwd_remat=False):
+                     bwd_remat=False, zero=None, zero_shards=None):
     """Compile fn(state, x, labels_or_targets, batch_size) ->
     (new_state, metrics).
 
@@ -429,9 +451,39 @@ def build_train_step(plans, loss="softmax", mesh=None, data_axis="data",
     optimization_barriers in backward production order — bit-identical
     values, decongested MXU schedule; ``bwd_remat`` checkpoints layer
     forwards (recompute-over-store).
+
+    ``zero=1`` (with ``mesh``) selects the ZeRO-1 shard_map path
+    (docs/distributed.md, "Elastic mesh contract"): the gradient merge
+    is a reduce-scatter in backward production order, the solver
+    update runs on each device's OWNED shards only (optimizer state —
+    the accum leaves — lives sharded over the data axis, ~1/N per
+    device), and an all-gather re-replicates the updated params.
+    Bit-identical params to the flat all-reduce path on a fixed mesh
+    (``psum_scatter`` sums like ``psum``; tests/test_mesh.py); only
+    the ``grad_norm`` metric may differ in last-ULP digits (its
+    squared-sum associates per-shard).  State must be in ZeRO form
+    (:func:`veles_tpu.parallel.mesh.zero_state`): accum leaves shaped
+    (n_slots, shard_elems) and a replicated int32 ``zero_slots`` table
+    per layer mapping device slots to the ``zero_shards`` logical
+    shards (default: one shard per device).  The table is a RUNTIME
+    input — moving shards between devices never recompiles.
     """
     import jax
 
+    if zero:
+        if int(zero) != 1:
+            raise ValueError("only the ZeRO-1 rung is implemented, "
+                             "got zero=%r" % (zero,))
+        if mesh is None:
+            raise ValueError("zero=1 needs a mesh (the optimizer "
+                             "state shards over its data axis)")
+        if grad_compress:
+            raise ValueError("zero=1 does not take grad_compress "
+                             "(the reduce-scatter is the wire format)")
+        return _build_zero1_spmd_train_step(
+            plans, loss, mesh, data_axis,
+            zero_shards or mesh.shape[data_axis], donate,
+            compiler_options, bwd_schedule, bwd_remat)
     if mesh is not None and grad_bucket_mb is not None:
         return _build_spmd_train_step(
             plans, loss, mesh, data_axis, grad_bucket_mb, grad_compress,
@@ -566,6 +618,156 @@ def _build_spmd_train_step(plans, loss, mesh, data_axis, grad_bucket_mb,
     return _finalize_step(spmd, donate, compiler_options, mesh=mesh,
                           data_axis=data_axis,
                           bucket_bytes=bucket_bytes)
+
+
+def _build_zero1_spmd_train_step(plans, loss, mesh, data_axis, n_shards,
+                                 donate, compiler_options,
+                                 bwd_schedule=None, bwd_remat=False):
+    """The ZeRO-1 shard_map data plane (docs/distributed.md, "Elastic
+    mesh contract"): per-device backward on the local batch shard, the
+    gradient merge as a chained reduce-scatter in backward production
+    order, the solver update on each device's OWNED logical shards
+    only (accum leaves live sharded over ``data_axis`` — per-device
+    optimizer memory is ~1/N), and an all-gather re-replicating the
+    updated params.  Shard placement is the runtime ``zero_slots``
+    table (parallel/bucketed.py slot helpers), so the compiled program
+    depends on the mesh SIZE but never on which device owns which
+    shard — the MeshManager moves shards without recompiling."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu.parallel import bucketed as _bucketed
+    from veles_tpu.parallel.mesh import shard_map
+
+    n = mesh.shape[data_axis]
+    m = int(n_shards)
+    k = -(-m // n)  # device slots; table pads with the zero-row id m
+    hypers = [p.hyper_full() for p in plans]
+    _local_rows = [0]
+
+    def metric_sync(value):
+        return lax.psum(value, data_axis)
+
+    def row_offset_fn():
+        return lax.axis_index(data_axis) * _local_rows[0]
+
+    # (tensor key, accum keys, hyper keys) — the two per-layer tensors
+    # the solver walks, same hyper wiring as the flat update loop
+    _TENSORS = (
+        ("weights", "accum_weights", "accum2_weights", "learning_rate",
+         "gradient_moment", "weights_decay"),
+        ("bias", "accum_bias", "accum2_bias", "learning_rate_bias",
+         "gradient_moment_bias", "weights_decay_bias"),
+    )
+
+    def zero_update(state, grads):
+        slots = next(s["zero_slots"] for s in state
+                     if s.get("zero_slots") is not None)
+        rank = lax.axis_index(data_axis)
+        # backward PRODUCTION order (last layer first, weights before
+        # bias — grads of a layer exist together), so each
+        # reduce-scatter can issue while earlier layers' backward runs
+        jobs = []
+        for idx in range(len(plans) - 1, -1, -1):
+            s = state[idx]
+            if s["weights"] is None:
+                continue
+            jobs.append((idx, "weights"))
+            if plans[idx].include_bias and s["bias"] is not None:
+                jobs.append((idx, "bias"))
+        mats = []
+        for idx, tensor in jobs:
+            g = grads[idx][tensor]
+            e = _bucketed.shard_elems(g.size, m)
+            mats.append(_bucketed.slot_matrix(g, slots, m, e))
+        parts = _bucketed.chained_reduce_scatter(mats, data_axis)
+        shard_of = dict(zip(jobs, parts))
+        # global grad-norm from the owned shards: every element of the
+        # summed gradient lives in exactly one shard (pad rows are
+        # zero), so the psum'd squared-sum covers every leaf and the
+        # skip verdict is uniform across ranks — association differs
+        # from the flat path's, so grad_norm may differ in last ULPs
+        gsq = lax.psum(
+            sum(jnp.sum(jnp.square(p.astype(jnp.float32)))
+                for p in parts), data_axis)
+        my_slots = lax.dynamic_slice(slots, (rank * k,), (k,))
+        new_state = []
+        for idx, s in enumerate(state):
+            if s["weights"] is None:  # param-less layer passthrough
+                new_state.append(dict(s))
+                continue
+            plan, hyper = plans[idx], hypers[idx]
+            entry = dict(s)
+            for (tensor, acc_key, acc2_key, lr_key, mom_key,
+                 dec_key) in _TENSORS:
+                g_my = shard_of.get((idx, tensor))
+                if g_my is None:
+                    continue
+                w = s[tensor]
+                e = _bucketed.shard_elems(w.size, m)
+                w_rows = _bucketed.slot_matrix(w, slots, m, e)
+                w_my = lax.dynamic_slice(w_rows, (rank * k, 0), (k, e))
+                gw = GradientDescentBase.regularized(
+                    g_my.astype(w.dtype), w_my, hyper[dec_key],
+                    hyper["l1_vs_l2"])
+                # elementwise solver with per-layer SCALAR hypers: the
+                # sharded update is the full-tensor update restricted
+                # to owned elements — bit-identical per element
+                new_my, new_acc, new_acc2 = \
+                    GradientDescentBase.solver_update(
+                        plan.solver, w_my, gw, s[acc_key], s[acc2_key],
+                        hyper[lr_key], hyper[mom_key],
+                        hyper["adadelta_rho"], hyper["solver_epsilon"])
+                w_all = _bucketed.gather_slots(new_my, data_axis)
+                entry[tensor] = _bucketed.unslot_matrix(
+                    w_all, slots, m, w.size, w.shape, w.dtype)
+                entry[acc_key] = new_acc
+                entry[acc2_key] = new_acc2
+            new_state.append(entry)
+        return new_state, gsq
+
+    raw = _build_step_fn(plans, loss, metric_sync=metric_sync,
+                         row_offset_fn=row_offset_fn,
+                         bwd_schedule=bwd_schedule, bwd_remat=bwd_remat,
+                         zero_update=zero_update)
+
+    def local_step(state, x, target, batch_size, step_key,
+                   grad_poison, loss_poison):
+        _local_rows[0] = x.shape[0]
+        if step_key is not None:
+            step_key = jax.random.fold_in(
+                step_key, lax.axis_index(data_axis))
+        return raw(state, x, target, batch_size, step_key,
+                   grad_poison, loss_poison)
+
+    _SHARDED = ("accum_weights", "accum_bias", "accum2_weights",
+                "accum2_bias")
+
+    def state_specs(state):
+        # accum leaves ride sharded on the leading (slot) dim; params,
+        # slot tables and None leaves ride replicated.  Built from the
+        # traced state at trace time, so the one builder serves any
+        # solver's state structure
+        return [{key: (None if value is None else
+                       P(data_axis) if key in _SHARDED else P())
+                 for key, value in entry.items()} for entry in state]
+
+    def spmd_fn(state, x, target, batch_size, step_key, grad_poison,
+                loss_poison):
+        specs = state_specs(state)
+        fn = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(specs, P(data_axis), P(data_axis), P(), P(), P(),
+                      P()),
+            out_specs=(specs, P()), check_vma=False)
+        return fn(state, x, target, batch_size, step_key, grad_poison,
+                  loss_poison)
+
+    return _finalize_step(spmd_fn, donate, compiler_options, mesh=mesh,
+                          data_axis=data_axis, zero=1, n_shards=m,
+                          slots_per_device=k)
 
 
 def _labels_sharding(mesh, data_axis, loss):

@@ -27,11 +27,12 @@ neutralized to the baseline 1.0 before any division, so the threshold
 and partition math never divide by a sick fleet aggregate.
 """
 
+import hashlib
 import math
 
 __all__ = ["FleetView", "effective_power", "fleet_mean_power",
            "power_shares", "speculation_threshold", "fleet_snapshot",
-           "POWER_SCALE_BOUND"]
+           "shard_owners", "movement_plan", "POWER_SCALE_BOUND"]
 
 #: Bound on the power correction applied to the speculation threshold:
 #: a chip rated 100x slower than the fleet mean must still be
@@ -80,6 +81,91 @@ def power_shares(total, powers):
                                           str(k)))[:leftover]:
         shares[key] += 1
     return shares
+
+
+def _hrw(shard, member):
+    """Rendezvous (highest-random-weight) score of ``member`` for
+    ``shard`` — a keyed 64-bit hash, so each shard ranks the member
+    set in an order that is stable across processes and independent of
+    which OTHER members exist (the property that makes membership
+    churn move only the affected shards)."""
+    digest = hashlib.blake2b(
+        ("%s|%s" % (shard, member)).encode(), digest_size=8).digest()
+    return int.from_bytes(digest, "big")
+
+
+def shard_owners(n_shards, members, previous=None):
+    """Consistent-hash assignment of ``n_shards`` logical state shards
+    to ``members`` (hashable keys), balanced to exact quotas.
+
+    Rendezvous hashing gives every shard a preference order over the
+    member set; the assignment is then rebalanced so each member owns
+    ``floor(n_shards/len(members))`` or one more — the uneven remainder
+    lands on the members the shard space prefers.  With ``previous``
+    (the pre-churn ``{shard: member}`` map) the rebalance is
+    *minimal-move*: a shard keeps its old owner unless that owner left
+    or sits over quota, so one leave moves ~n_shards/N shards (the
+    departed member's) and one join moves ~n_shards/N' (one shed per
+    over-quota member), never a full reshuffle.  Deterministic for a
+    given (n_shards, member set, previous).  Returns {shard: member}.
+    """
+    keys = sorted(set(members), key=str)
+    if not keys:
+        raise ValueError("shard_owners: empty member set")
+    n = len(keys)
+    base, extra = divmod(int(n_shards), n)
+    # quota: the members ranked highest by the whole shard space get
+    # the remainder — deterministic in the member set alone, so the
+    # same fleet always computes the same quotas
+    rank = sorted(keys, key=lambda m: (-_hrw("quota", m), str(m)))
+    quota = {m: base + (1 if rank.index(m) < extra else 0)
+             for m in keys}
+    held = {m: [] for m in keys}
+    pool = []
+    for shard in range(int(n_shards)):
+        owner = (previous or {}).get(shard)
+        if owner in quota:
+            held[owner].append(shard)
+        else:
+            pool.append(shard)
+    # over-quota members shed the shards that prefer them LEAST —
+    # those are exactly the shards most likely to prefer the joiner
+    for m in keys:
+        if len(held[m]) > quota[m]:
+            held[m].sort(key=lambda s: (-_hrw(s, m), s))
+            pool.extend(held[m][quota[m]:])
+            held[m] = held[m][:quota[m]]
+    # the pool (new/orphaned/shed shards) lands by preference order,
+    # respecting quotas; ties broken by shard id for determinism
+    for shard in sorted(pool):
+        prefs = sorted(keys, key=lambda m: (-_hrw(shard, m), str(m)))
+        for m in prefs:
+            if len(held[m]) < quota[m]:
+                held[m].append(shard)
+                break
+    owners = {}
+    for m, shards in held.items():
+        for shard in shards:
+            owners[shard] = m
+    return owners
+
+
+def movement_plan(previous, owners):
+    """The shards a reshard actually moves: those whose owner changed
+    between ``previous`` and ``owners`` (both ``{shard: member}``).
+    New shards (absent from ``previous``) count as moved — they must
+    be materialized on their owner either way.  The ``changed_fraction``
+    against the full shard count is the receipt the consistent-hash
+    claim is audited by (a full gather would move fraction 1.0)."""
+    moved = sorted(s for s in owners
+                   if previous is None or previous.get(s) != owners[s])
+    total = max(len(owners), 1)
+    return {
+        "moved": moved,
+        "n_moved": len(moved),
+        "n_shards": len(owners),
+        "changed_fraction": len(moved) / float(total),
+    }
 
 
 def fleet_mean_power(fleet_powers):

@@ -409,6 +409,59 @@ class GradientDescentBase(Unit):
                             if self.accum2_bias else None),
         }
 
+    # -- master-slave contract (job-farming DP, SURVEY.md section 2.6) -----
+    #
+    # The forward unit ships canonical PARAMS per job; this unit ships
+    # canonical SOLVER STATE (momentum velocity / adagrad / adadelta
+    # accumulators) the same way and merges the slave's accumulator
+    # deltas additively — so a momentum run farms out bit-faithfully
+    # instead of every slave re-warming velocity from zero on each job.
+
+    def _accum_pairs(self):
+        return (("accum_weights", self.accum_weights),
+                ("accum_bias", self.accum_bias),
+                ("accum2_weights", self.accum2_weights),
+                ("accum2_bias", self.accum2_bias))
+
+    def generate_data_for_slave(self, slave=None):
+        payload = {}
+        for name, arr in self._accum_pairs():
+            if arr:
+                arr.map_read()
+                payload[name] = numpy.array(arr.mem)
+        return payload or None
+
+    def apply_data_from_master(self, data):
+        if not data:
+            return
+        self._job_start_accums_ = {}
+        for name, arr in self._accum_pairs():
+            value = data.get(name)
+            if value is not None and arr:
+                arr.map_invalidate()
+                arr.mem = numpy.array(value)
+                self._job_start_accums_[name] = numpy.array(value)
+
+    def generate_data_for_master(self):
+        start = getattr(self, "_job_start_accums_", None)
+        if not start:
+            return None
+        delta = {}
+        for name, arr in self._accum_pairs():
+            if name in start and arr:
+                arr.map_read()
+                delta[name] = arr.mem - start[name]
+        return delta or None
+
+    def apply_data_from_slave(self, data, slave=None):
+        if not data:
+            return
+        for name, arr in self._accum_pairs():
+            value = data.get(name)
+            if value is not None and arr:
+                arr.map_write()
+                arr.mem += value
+
     def __getstate__(self):
         # snapshots carry plain ints, not lazy device scalars
         state = super(GradientDescentBase, self).__getstate__()

@@ -278,6 +278,16 @@ _HEALTH_KEYS = (
     # distribution moved and the published scales are stale
     ("serve.quantized", "serve_quantized"),
     ("serve.quant.clip_fraction", "quant_clip_fraction"),
+    # elastic device mesh (parallel.mesh.MeshManager, docs/
+    # distributed.md "Elastic mesh contract"): current mesh size and
+    # epoch, lifetime reshard count, and cumulative bytes of train
+    # state moved — bytes_moved growing faster than reshards * the
+    # changed-owner fraction means ownership is churning more than the
+    # membership changes justify
+    ("mesh.size", "mesh_size"),
+    ("mesh.epoch", "mesh_epoch"),
+    ("mesh.reshards", "mesh_reshards"),
+    ("mesh.bytes_moved", "mesh_bytes_moved"),
 )
 
 

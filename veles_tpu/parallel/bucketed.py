@@ -41,7 +41,9 @@ from jax import lax
 
 __all__ = ["DEFAULT_BUCKET_MB", "Bucket", "BucketPlan", "plan_buckets",
            "bucketed_all_reduce", "flat_all_reduce", "overlap_model",
-           "comm_receipt", "publish_comm_receipt"]
+           "comm_receipt", "publish_comm_receipt", "shard_elems",
+           "slot_matrix", "unslot_matrix", "chained_reduce_scatter",
+           "gather_slots"]
 
 #: default bucket size target.  25 MB rides the knee of the v5e ring
 #: model: big enough that per-hop launch latency stays < 3 % of a
@@ -227,6 +229,84 @@ def flat_all_reduce(grads, axis_name, impl="psum", compress=None,
     return bucketed_all_reduce(
         grads, axis_name, bucket_bytes=float("inf"), impl=impl,
         compress=compress, axis_size=axis_size, chain=False)
+
+
+# -- ZeRO-1 reduce-scatter + all-gather (docs/distributed.md, "Elastic
+#    mesh contract") ------------------------------------------------------
+#
+# The sharded-optimizer data plane replaces the flat all-reduce with
+# the two halves it is made of: a reduce-scatter hands each device the
+# SUMMED gradient rows of the shards it owns (where the solver update
+# runs on 1/N of the state), and an all-gather re-replicates the
+# updated params.  ``lax.psum_scatter(tiled=True)`` is bit-identical to
+# ``psum`` + slice on every row (tests/test_mesh.py proves it), so the
+# split costs no numerics.  Shard-to-device placement is a runtime
+# *slot table* (int32, one logical-shard id per device slot, the pad
+# id pointing at an all-zero row), so the compiled step is independent
+# of WHICH device owns which shard — a reshard changes only the table,
+# and the digest-keyed compile cache stays warm.
+
+def shard_elems(size, n_shards):
+    """Per-shard element count for a tensor of ``size`` elements split
+    into ``n_shards`` logical shards (ceil-div; the last shard pads)."""
+    return -(-int(size) // max(int(n_shards), 1))
+
+
+def slot_matrix(flat, slots, n_shards, elems):
+    """Arrange a flattened tensor into per-slot rows: pad ``flat`` to
+    ``n_shards * elems``, reshape to (n_shards, elems), append one
+    all-zero pad row (logical id ``n_shards``), and gather rows by the
+    ``slots`` table — the (n_slots, elems) matrix whose row i is the
+    shard device ``i // slots_per_device`` hosts in slot ``i``."""
+    flat = flat.reshape((-1,))
+    pad = n_shards * elems - flat.shape[0]
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    mat = flat.reshape((n_shards, elems))
+    mat = jnp.concatenate([mat, jnp.zeros((1, elems), mat.dtype)])
+    return jnp.take(mat, slots, axis=0)
+
+
+def unslot_matrix(rows, slots, n_shards, size, shape, dtype):
+    """Invert :func:`slot_matrix`: scatter slot rows back to canonical
+    shard order (pad slots all target the dropped row ``n_shards``),
+    strip the padding, and reshape to the tensor's ``shape``."""
+    elems = rows.shape[-1]
+    full = jnp.zeros((n_shards + 1, elems), dtype).at[slots].set(
+        rows.astype(dtype))
+    return full[:n_shards].reshape((-1,))[:size].reshape(shape)
+
+
+def chained_reduce_scatter(mats, axis_name, chain=True):
+    """Reduce-scatter each (n_slots, elems) slot matrix over
+    ``axis_name``; device r receives the summed rows
+    ``[r*k, (r+1)*k)`` (k = n_slots / axis size) — its owned shards.
+
+    ``mats`` arrive in backward PRODUCTION order (last layer first) and
+    ``chain=True`` threads each input through an
+    ``optimization_barrier`` on the previous result, the same
+    scheduling contract as :func:`bucketed_all_reduce`: collectives
+    stay distinct and issue while the backward still runs.  Returns
+    the per-device (k, elems) shard matrices, same order.
+    ``psum_scatter`` sums in ``psum``'s order, so every returned row is
+    bit-identical to the matching rows of a flat all-reduce."""
+    out = []
+    token = None
+    for mat in mats:
+        if chain and token is not None and _opt_barrier is not None:
+            mat, _ = _opt_barrier((mat, token))
+        part = lax.psum_scatter(mat, axis_name, scatter_dimension=0,
+                                tiled=True)
+        token = part
+        out.append(part)
+    return out
+
+
+def gather_slots(part, axis_name):
+    """All-gather the per-device (k, elems) shard rows back to the full
+    (n_slots, elems) slot matrix — the replication half of the ZeRO-1
+    update (params come back identical on every device)."""
+    return lax.all_gather(part, axis_name, axis=0, tiled=True)
 
 
 # -- analytic overlap model (shared with scripts/scaling.py) --------------

@@ -268,6 +268,11 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         #: in flight: slave id -> (conn, reason); the apply path
         #: finishes them when the executor returns
         self._deferred_drops = {}
+        #: optional parallel.mesh.MeshManager driving an elastic device
+        #: mesh on this master: when set, reshard frames carry its
+        #: ``mesh_epoch`` so slaves see which train-state layout their
+        #: membership change produced
+        self.mesh_manager = None
         # elastic-fleet accounting (mirrored into elastic.* metrics)
         self.reshards = 0
         self.speculated = 0
@@ -1094,6 +1099,13 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
             member.share = shares.get(sid)
             msg = {"type": "reshard", "epoch": epoch,
                    "fleet": len(self.fleet)}
+            # a master training on an elastic device mesh
+            # (parallel.mesh.MeshManager) stamps its device-mesh epoch
+            # so slaves can correlate membership churn with the
+            # train-state reshard that followed it
+            mesh_epoch = getattr(self.mesh_manager, "mesh_epoch", None)
+            if mesh_epoch is not None:
+                msg["mesh_epoch"] = mesh_epoch
             if member.share is not None:
                 msg["share"] = member.share
             if remaining is not None:

@@ -47,7 +47,9 @@ from veles_tpu.units import Unit
 
 __all__ = ["SnapshotterBase", "Snapshotter", "SnapshotError",
            "RollbackExhausted", "MANIFEST_SUFFIX", "LATEST_NAME",
-           "publish_snapshot", "publish_schedule_bank", "read_latest"]
+           "publish_snapshot", "publish_schedule_bank", "read_latest",
+           "write_state_snapshot", "load_state_snapshot",
+           "latest_state_snapshot"]
 
 #: sidecar manifest filename suffix (next to the snapshot it describes)
 MANIFEST_SUFFIX = ".manifest"
@@ -1042,3 +1044,58 @@ class Snapshotter(SnapshotterBase):
         self.warning("snapshot is large; top units by pickle size:")
         for nbytes, name in sizes[:5]:
             self.warning("  %8.1f MB  %s", nbytes / 1e6, name)
+
+
+# -- raw state snapshots (parallel/mesh.py MeshManager) -------------------
+#
+# The elastic mesh's pre-reshard safety snapshots are plain pickled
+# state pytrees, not whole workflows, but they ride the SAME atomics
+# and manifest contract as every other snapshot in this module: tmp ->
+# fsync -> os.replace -> dir-fsync, sha256+size sidecar written after
+# the data is durable, verify-before-unpickle on restore.  That is
+# what lets a crash mid-reshard recover through the existing
+# ``--resume auto`` machinery instead of a parallel bespoke path.
+
+def write_state_snapshot(path, obj, workflow_name=None, epoch=None):
+    """Atomically pickle ``obj`` to ``path`` and write its manifest
+    sidecar; returns the manifest.  Honors the ``snapshot.write``
+    chaos point (crash leaves only a ``.tmp`` residue — the final
+    path is never torn)."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fout:
+        if chaos.plan is not None:
+            fault = chaos.plan.fire("snapshot.write")
+            if fault is not None:
+                if fault.action == "crash":
+                    fout.write(payload[:max(1, len(payload) // 2)])
+                    fout.flush()
+                    raise chaos.ChaosCrash(
+                        "simulated crash mid-snapshot-write")
+                if fault.action == "enospc":
+                    raise chaos.enospc()
+        fout.write(payload)
+    _fsync_file(tmp)
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+    return SnapshotterBase.write_manifest(
+        path, workflow_name=workflow_name, epoch=epoch)
+
+
+def load_state_snapshot(path):
+    """Verify ``path`` against its manifest, then unpickle it.  Raises
+    :class:`SnapshotError` on a failed or impossible verification —
+    a torn or tampered state snapshot must never be resumed from."""
+    ok, detail = SnapshotterBase.verify_snapshot(path)
+    if not ok:
+        raise SnapshotError("state snapshot %s failed verification: %s"
+                            % (path, detail))
+    return SnapshotterBase._load_pickle(os.path.realpath(path))
+
+
+def latest_state_snapshot(directory):
+    """The newest manifest-verified snapshot in ``directory`` (or None)
+    — the ``--resume auto`` semantics for raw state snapshots."""
+    for snap in SnapshotterBase._iter_verified_snapshots(directory):
+        return snap
+    return None

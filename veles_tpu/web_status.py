@@ -443,6 +443,7 @@ class StatusReporter(object):
         from veles_tpu.elastic import fleet_snapshot
         from veles_tpu.observe.metrics import health_snapshot
         from veles_tpu.observe.metrics import registry as _registry
+        from veles_tpu.parallel.mesh import mesh_snapshot
         from veles_tpu.serve.batcher import serve_snapshot
         decision = getattr(self.workflow, "decision", None)
         launcher = self.workflow.launcher
@@ -482,6 +483,11 @@ class StatusReporter(object):
             # quarantined counts, speculative jobs in flight — only on
             # masters (the server publishes the elastic.* gauges)
             "fleet": fleet_snapshot() or None,
+            # elastic device-mesh state (docs/distributed.md, "Elastic
+            # mesh contract"): mesh size/epoch, reshard count, bytes of
+            # train state moved, and the reshard-latency histogram —
+            # only on masters training through a MeshManager
+            "mesh": mesh_snapshot() or None,
         }
 
     def _post_json(self, path, payload):
