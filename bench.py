@@ -108,6 +108,10 @@ SECTION_EST = {
     # in-process batcher, interleaved flood legs with class-ordered
     # shedding off/on + the quiet anchor leg
     "qos_ab": 30.0,
+    # request-tracing overhead A/B (docs/observability.md "Request
+    # tracing"): one small AOT ladder + interleaved closed-loop legs
+    # with the per-request segment stamps on vs VELES_REQTRACE=0
+    "trace_overhead": 30.0,
     # elastic-mesh reshard A/B (docs/distributed.md "Elastic mesh
     # contract"): two ZeRO-1 compiles (initial + cold shrink; the
     # grow-back is the compile-cache hit under test) + 4 small steps
@@ -203,6 +207,9 @@ def _compact_record(value, small, extras):
     if qos.get("qos_interactive_p99_guard") is not None:
         rec["qos_interactive_p99_guard"] = \
             qos["qos_interactive_p99_guard"]
+    reqtrace = extras.get("trace_overhead") or {}
+    if reqtrace.get("trace_overhead_pct") is not None:
+        rec["trace_overhead_pct"] = reqtrace["trace_overhead_pct"]
     reshard = extras.get("reshard_ab") or {}
     if reshard.get("reshard_bytes_saved_pct") is not None:
         rec["reshard_bytes_saved"] = reshard["reshard_bytes_saved_pct"]
@@ -1652,6 +1659,97 @@ def bench_serve_ab(small):
     }
 
 
+def bench_trace_overhead(small):
+    """Request-tracing overhead A/B (docs/observability.md "Request
+    tracing"): the SAME continuously-batched serve knee measured with
+    the per-request segment stamps ON (the shipping default) vs the
+    ``VELES_REQTRACE=0`` kill switch, interleaved off/on passes so
+    drift hits both legs alike.  The stamps are a handful of
+    ``perf_counter`` calls and tuple appends per request, so the gate
+    is <= 2% rps — if this A/B ever reports more, the serve hot path
+    regressed.  Span emission stays off in BOTH legs (no tracer
+    active): the number isolates the always-on mark/exemplar cost
+    every production request pays."""
+    import threading as _threading
+
+    from veles_tpu.backends import Device
+    from veles_tpu.compiler import LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.observe import requests as reqtrace
+    from veles_tpu.serve import AOTEngine, ContinuousBatcher
+
+    fan_in, hidden, classes = (196, 64, 10) if small else (784, 256, 10)
+    rng = numpy.random.RandomState(7)
+    plans = [LayerPlan(All2AllTanh), LayerPlan(All2AllSoftmax)]
+    params = [
+        {"weights": rng.rand(fan_in, hidden).astype(numpy.float32),
+         "bias": numpy.zeros(hidden, numpy.float32)},
+        {"weights": rng.rand(hidden, classes).astype(numpy.float32),
+         "bias": numpy.zeros(classes, numpy.float32)},
+    ]
+    ladder = (1, 8, 32) if small else (1, 8, 32, 128)
+    engine = AOTEngine(plans, params, (fan_in,), ladder=ladder,
+                       device=Device())
+    engine.compile()
+    samples = rng.rand(256, fan_in).astype(numpy.float32)
+    duration = 0.5 if small else 1.0
+    clients = 8 if small else 32
+    batcher = ContinuousBatcher(engine, max_delay_s=0.002).start()
+
+    def leg():
+        done, lock = [0], _threading.Lock()
+        stop_at = time.perf_counter() + duration
+
+        def client(k):
+            n = 0
+            while time.perf_counter() < stop_at:
+                batcher.infer(
+                    samples[(k * 31 + n) % len(samples)],
+                    timeout=30.0)
+                n += 1
+            with lock:
+                done[0] += n
+
+        threads = [_threading.Thread(target=client, args=(k,))
+                   for k in range(clients)]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return done[0] / (time.perf_counter() - start)
+
+    saved = reqtrace.enabled
+    passes = 3
+    rps = {"off": [], "on": []}
+    try:
+        leg()  # warm the ladder + thread pool out of the measurement
+        for _ in range(passes):
+            for mode in ("off", "on"):
+                reqtrace.enabled = mode == "on"
+                rps[mode].append(leg())
+    finally:
+        reqtrace.enabled = saved
+        batcher.stop()
+        # the A/B's own tail requests are not serving evidence
+        reqtrace.exemplars.clear()
+
+    def median(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    rps_off, rps_on = median(rps["off"]), median(rps["on"])
+    pct = 100.0 * (rps_off - rps_on) / max(rps_off, 1e-9)
+    return {
+        "clients": clients,
+        "passes": passes,
+        "rps_tracing_off": round(rps_off, 1),
+        "rps_stamps_on": round(rps_on, 1),
+        "trace_overhead_pct": round(pct, 2),
+        "gate_pct": 2.0,
+        "within_gate": pct <= 2.0,
+    }
+
+
 def bench_hedge_ab(small):
     """Multi-host hedging A/B (docs/serving.md "Multi-host tier"):
     closed-loop p50/p95/p99 through a :class:`FleetRouter` over two
@@ -2192,6 +2290,14 @@ def main():
     qos_res = section("qos_ab", lambda: bench_qos_ab(small))
     if qos_res is not None:
         extras["qos_ab"] = qos_res
+
+    # request-tracing overhead A/B (docs/observability.md "Request
+    # tracing"): serve rps with segment stamps on vs VELES_REQTRACE=0,
+    # interleaved passes — the <= 2% gate on the always-on cost
+    reqtrace_res = section("trace_overhead",
+                           lambda: bench_trace_overhead(small))
+    if reqtrace_res is not None:
+        extras["trace_overhead"] = reqtrace_res
 
     # elastic-mesh reshard A/B (docs/distributed.md "Elastic mesh
     # contract"): time-to-recover + bytes moved for a consistent-hash

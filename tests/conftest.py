@@ -109,6 +109,20 @@ def _schedule_cache_to_tmp(tmp_path, monkeypatch):
 
 
 @pytest.fixture(autouse=True)
+def _exemplar_ring_reset():
+    """The tail-exemplar ring (observe/requests.py) is a process
+    singleton fed by every batcher completion — one serve test's tail
+    timelines must never leak into another's ring-bound or
+    SLO-violation-dump assertions.  (Its dumps already land in tmp via
+    _flight_dumps_to_tmp.)"""
+    import sys
+    yield
+    mod = sys.modules.get("veles_tpu.observe.requests")
+    if mod is not None:
+        mod.exemplars.clear()
+
+
+@pytest.fixture(autouse=True)
 def _calibration_to_tmp(tmp_path, monkeypatch):
     """The post-training quantization pass writes a calibration
     sidecar JSON on every quantize (veles_tpu/quant/ptq.py) — those

@@ -1,0 +1,449 @@
+"""Request-scoped serve tracing (veles_tpu/observe/requests.py,
+docs/observability.md "Request tracing"): trace-id minting and
+normalization at the serve port's never-unpickle trust boundary, id
+propagation across the HTTP front, the binary front (hello default +
+per-frame override) and the pipelined fleet link, the hedged two-leg
+stitch under ONE id over socketpair hosts (validate_trace nesting +
+the observe/merge.py offset-corrected round-trip), tail-exemplar ring
+bounds with shadow/mirror exclusion, SLO-violation flight dumps that
+carry the offending timeline, arrival-anchored end-to-end latency
+under chaos requeue, and the ``python -m veles_tpu.observe requests``
+critical-path analyzer CLI."""
+
+import json
+import socket
+import time
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu import chaos
+from veles_tpu.backends import Device
+from veles_tpu.observe import requests as reqtrace
+from veles_tpu.observe.trace import tracer, validate_trace
+from veles_tpu.serve import (
+    AOTEngine, BinaryTransportServer, ContinuousBatcher, FleetRouter,
+    ServeService)
+from veles_tpu.serve.transport import BinaryTransportClient
+from tests.test_serve import _mlp_spec
+from tests.test_serve_fleet import _Hosts, _counter, _wait_for
+
+pytestmark = [pytest.mark.serve, pytest.mark.reqtrace]
+
+
+def _engine(seed=0):
+    plans, params = _mlp_spec(seed=seed)
+    eng = AOTEngine(plans, params, (16,), ladder=(8, 32),
+                    device=Device(backend="cpu"))
+    eng.compile()
+    return eng
+
+
+# -- id contract (trust boundary) -------------------------------------------
+
+
+def test_mint_and_normalize_trace_ids():
+    """Minted ids are unique, short, and pass their own normalization;
+    anything that crossed the wire is accepted only as a bounded plain
+    string (the serve port never unpickles — ids do not change that)."""
+    a, b = reqtrace.mint_trace_id(), reqtrace.mint_trace_id()
+    assert a != b
+    assert reqtrace.normalize_trace_id(a) == a
+    assert reqtrace.normalize_trace_id("  cli-1.2:x_y-Z  ") == \
+        "cli-1.2:x_y-Z"
+    for bad in (None, 17, b"bytes", "", "has space", "semi;colon",
+                "x" * 65, {"trace": "dict"}, ["list"]):
+        assert reqtrace.normalize_trace_id(bad) is None
+
+
+def test_sampling_is_deterministic_in_the_id():
+    """Keep/drop hashes the id, no RNG: the two legs of one hedged
+    request — different hosts, different processes — make the SAME
+    decision, which is what lets them stitch under one id."""
+    ids = ["req-%d" % i for i in range(400)]
+    first = [reqtrace.sampled(t, rate=0.5) for t in ids]
+    assert first == [reqtrace.sampled(t, rate=0.5) for t in ids]
+    kept = sum(first)
+    assert 0 < kept < len(ids)  # rate actually partitions
+    assert all(reqtrace.sampled(t, rate=1.0) for t in ids)
+    assert not any(reqtrace.sampled(t, rate=0.0) for t in ids)
+    assert not reqtrace.sampled(None, rate=1.0)
+
+
+# -- tail-exemplar ring ------------------------------------------------------
+
+
+def test_exemplar_ring_bound_and_shadow_exclusion():
+    """The ring is bounded, keeps over-budget timelines, and never
+    keeps shadow/mirror traffic no matter how slow it ran."""
+    ring = reqtrace.ExemplarRing(capacity=4, window=16, min_samples=4)
+    marks = [("queue", 10.0, 0.001), ("device", 10.001, 0.040)]
+    # shadow traffic is excluded outright
+    assert not ring.note("shadow-1", 9.9, marks=marks, t0=10.0,
+                         budget_s=0.1, shadow=True)
+    assert ring.kept == 0
+    # over-budget requests are kept with their full timeline
+    for i in range(10):
+        assert ring.note("slow-%d" % i, 0.5, marks=marks, t0=10.0,
+                         slo_class="interactive", budget_s=0.1,
+                         kind="host", extra={"hedges": 0})
+    snap = ring.snapshot()
+    assert snap["capacity"] == 4
+    assert len(snap["entries"]) == 4  # bounded: oldest evicted
+    assert snap["kept"] == 10
+    assert snap["seen"] == 10  # shadow notes are not even counted
+    entry = snap["entries"][-1]
+    assert entry["trace"] == "slow-9"
+    assert entry["over"] == "budget"
+    assert [m["seg"] for m in entry["timeline"]] == ["queue", "device"]
+    assert entry["timeline"][1]["dur_s"] == pytest.approx(0.040)
+    assert entry["hedges"] == 0
+    # fast traffic under budget and under the rolling p99 is not kept
+    assert not ring.note("fast", 0.001, marks=marks, t0=10.0,
+                         budget_s=0.1)
+    ring.clear()
+    assert ring.snapshot()["entries"] == []
+
+
+# -- HTTP front --------------------------------------------------------------
+
+
+def test_http_front_propagates_and_echoes_trace_id():
+    """A client id rides the body or the X-Trace-Id header and is
+    echoed back; an id that fails normalization is REPLACED by a
+    server-minted one — never trusted, never erred on."""
+    svc = ServeService(_engine(seed=11), max_delay_s=0.002)
+    svc.start_background()
+    try:
+        base = "http://127.0.0.1:%d" % svc.port
+        row = numpy.zeros(16, numpy.float32).tolist()
+
+        def post(body, headers=()):
+            req = urllib.request.Request(
+                base + "/infer", data=json.dumps(body).encode(),
+                headers=dict({"Content-Type": "application/json"},
+                             **dict(headers)))
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        answer = post({"input": row, "trace": "cli-http-1"})
+        assert answer["trace"] == "cli-http-1"
+        answer = post({"input": row},
+                      headers={"X-Trace-Id": "hdr-trace-2"})
+        assert answer["trace"] == "hdr-trace-2"
+        # malformed wire id: minted server-side instead
+        answer = post({"input": row, "trace": "bad id!"})
+        assert answer["trace"] != "bad id!"
+        assert reqtrace.normalize_trace_id(answer["trace"])
+        # no id offered: one is still minted while tracing is enabled
+        answer = post({"input": row})
+        assert reqtrace.normalize_trace_id(answer["trace"])
+    finally:
+        svc.stop()
+
+
+# -- binary front ------------------------------------------------------------
+
+
+@pytest.mark.transport
+def test_binary_front_hello_default_and_per_frame_override():
+    """``trace=True`` in the hello makes the server mint an id per
+    frame; an explicit ``infer(..., trace=...)`` overrides it; the
+    reply echoes the id plus the per-segment breakdown the host
+    batcher stamped (queue/assemble/h2d/device/d2h at minimum)."""
+    batcher = ContinuousBatcher(_engine(seed=12),
+                                max_delay_s=0.002).start()
+    server = BinaryTransportServer(batcher, port=None,
+                                   host_meta={"host_id": "h0"})
+    server.start_background()
+    client = None
+    try:
+        ours, theirs = socket.socketpair()
+        server.serve_socket(ours)
+        client = BinaryTransportClient(sock=theirs, shm=False,
+                                       trace=True)
+        x = numpy.zeros(16, numpy.float32)
+        out = client.infer(x)
+        assert out.shape == (1, 4)
+        minted = client.last_trace
+        assert reqtrace.normalize_trace_id(minted)
+        client.infer(x, trace="cli-bin.7")
+        assert client.last_trace == "cli-bin.7"
+        segs = client.last_segments
+        assert isinstance(segs, dict)
+        for seg in ("queue", "assemble", "h2d", "device", "d2h"):
+            assert seg in segs and segs[seg] >= 0.0
+        assert set(segs) <= set(reqtrace.SEGMENTS)
+        # a malformed per-frame id falls back to the hello default
+        client.infer(x, trace="not ok!")
+        assert client.last_trace != "not ok!"
+        assert reqtrace.normalize_trace_id(client.last_trace)
+    finally:
+        if client is not None:
+            client.close()
+        server.stop()
+        batcher.stop()
+
+
+# -- SLO-violation dump ------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_slo_violation_dump_carries_exemplar_timeline(tmp_path):
+    """A chaos-stalled request breaches the SLO watch: the ENTER-edge
+    flight dump must carry the tail exemplars, timeline included, so
+    the violation arrives WITH the offending request's breakdown."""
+    batcher = ContinuousBatcher(_engine(seed=13), max_delay_s=0.002,
+                                slo_p99_ms=1.0,
+                                slo_check_every=1).start()
+    # the stall must push the request PAST its 100 ms interactive
+    # budget (qos.DEFAULT_SLO_BUDGETS_S) or the ring keeps nothing
+    chaos.install(chaos.FaultPlan(seed=1).add(
+        "serve.stall", "stall", nth=1, param=0.15))
+    try:
+        req = batcher.submit(numpy.zeros(16, numpy.float32),
+                             slo_class="interactive",
+                             trace="slo-breach-1")
+        assert req.done.wait(10) and req.error is None
+
+        def dumped():
+            return list(tmp_path.glob(
+                "veles_flight.serve.slo_violation.*.json"))
+
+        _wait_for(lambda: bool(dumped()), what="SLO-violation dump")
+    finally:
+        chaos.uninstall()
+        batcher.stop()
+    doc = json.loads(dumped()[0].read_text())
+    assert doc["kind"] == "flight"
+    assert doc["reason"] == "serve.slo_violation"
+    entries = doc["exemplars"]["entries"]
+    assert entries, "dump carries no exemplar timelines"
+    mine = [e for e in entries if e["trace"] == "slo-breach-1"]
+    assert mine, "the breaching request is not among the exemplars"
+    segs = {m["seg"] for m in mine[0]["timeline"]}
+    assert {"queue", "device"} <= segs
+    assert mine[0]["class"] == "interactive"
+    # and the analyzer folds the dump directly
+    report = reqtrace.analyze_files([str(dumped()[0])])
+    assert report["exemplars"] >= 1
+    assert "device" in report["segments"]
+
+
+# -- critical-path attribution ----------------------------------------------
+
+
+@pytest.mark.chaos
+def test_analyzer_attributes_tail_to_device_stall(tmp_path):
+    """The e2e attribution receipt: among fast requests, ONE rides a
+    chaos device-edge stall; the analyzer's tail block names that
+    request as worst and attributes its latency to the ``device``
+    segment — the question aggregate histograms cannot answer."""
+    batcher = ContinuousBatcher(_engine(seed=14),
+                                max_delay_s=0.002).start()
+    tracer.start()
+    try:
+        x = numpy.zeros(16, numpy.float32)
+        for i in range(12):
+            req = batcher.submit(x, trace="fast-%d" % i)
+            assert req.done.wait(10) and req.error is None
+        chaos.install(chaos.FaultPlan(seed=2).add(
+            "serve.device.stall", "stall", nth=1, param=0.25))
+        try:
+            req = batcher.submit(x, trace="tail-dev-1")
+            assert req.done.wait(10) and req.error is None
+        finally:
+            chaos.uninstall()
+    finally:
+        tracer.stop()
+        batcher.stop()
+    path = tracer.save(str(tmp_path / "serve_trace.json"))
+    validate_trace(json.loads(open(path).read()))
+    report = reqtrace.analyze_files([path])
+    assert report["requests"] == 13
+    assert report["segments"]["device"]["max_ms"] >= 200.0
+    worst = report["tail"]["worst"]
+    assert worst["trace"] == "tail-dev-1"
+    assert worst["dominant"] == "device"
+    assert report["tail"]["dominant"].get("device", 0) >= 1
+
+
+# -- fleet: hedged two-leg stitch under one id -------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.fleet
+def test_hedged_two_leg_stitch_under_one_id(tmp_path):
+    """The tentpole receipt: a chaos-stalled primary forces a hedge;
+    the merged timeline shows BOTH legs under ONE request id — the
+    fleet-tier parent, one leg span per dispatch on two distinct
+    hosts, and the winning host's own segment spans — and the
+    analyzer folds the two per-process files into one record via the
+    merge.py offset-corrected stitch."""
+    plans, params = _mlp_spec(seed=3)
+    hosts = _Hosts(2, plans, params)
+    router = FleetRouter(hedge_factor=1.5, hedge_floor_s=0.05,
+                         hedge_tick_s=0.01).start()
+    try:
+        for i in range(2):
+            hosts.connect(router, i)
+        x = numpy.random.RandomState(4).rand(
+            3, 16).astype(numpy.float32)
+        for i in range(router.hedge_warmup):
+            router.infer(x[i % 2], timeout=15.0)
+        sampled = _counter("serve.reqtrace.sampled")
+        tracer.start()
+        chaos.install(chaos.FaultPlan(seed=1).add(
+            "serve.host.stall", "stall", nth=1, param=2.0))
+        try:
+            out = router.infer(x[2], timeout=15.0, trace="stitch-1")
+            assert out.shape == (4,)
+            # the fleet parent emits on the reader thread after
+            # done.set(); the winning host emitted before its reply
+            _wait_for(lambda: _counter("serve.reqtrace.sampled")
+                      >= sampled + 2, what="request-span emission")
+        finally:
+            chaos.uninstall()
+            tracer.stop()
+    finally:
+        router.stop()
+        hosts.stop()
+
+    events = tracer.events
+    validate_trace({"traceEvents": events})
+    named = lambda e: (e.get("args") or {})
+    fleet_req = [e for e in events
+                 if e.get("name") == reqtrace.REQUEST_SPAN
+                 and named(e).get("tier") == "fleet"]
+    assert len(fleet_req) == 1
+    assert named(fleet_req[0])["trace"] == "stitch-1"
+    assert named(fleet_req[0])["hedges"] >= 1
+    legs = [e for e in events if e.get("name") == reqtrace.LEG_SPAN]
+    assert len(legs) >= 2
+    assert len({named(e).get("host") for e in legs}) == 2
+    host_req = [e for e in events
+                if e.get("name") == reqtrace.REQUEST_SPAN
+                and named(e).get("tier") == "host"]
+    assert host_req, "the winning host emitted no request span"
+    assert all(named(e)["trace"] == "stitch-1" for e in host_req)
+    assert all(named(e).get("host") in ("h0", "h1") for e in host_req)
+
+    # split into per-process files (front vs host) and round-trip the
+    # analyzer through the offset-corrected merge stitch
+    saved = json.loads(open(tracer.save(
+        str(tmp_path / "all.json"))).read())
+    other = saved["otherData"]
+    front, host = [], []
+    for e in events:
+        if e.get("ph") == "i" or named(e).get("tier") == "fleet" or \
+                e.get("name") == reqtrace.LEG_SPAN:
+            front.append(e)
+        elif e.get("cat") == "req":
+            host.append(e)
+    paths = []
+    for label, evts in (("front", front), ("host0", host)):
+        doc = {"traceEvents": evts,
+               "otherData": dict(other, label=label)}
+        path = tmp_path / (label + ".json")
+        path.write_text(json.dumps(doc))
+        paths.append(str(path))
+    report = reqtrace.analyze_files(paths)
+    assert report["files"] == ["front", "host0"]
+    assert report["requests"] == 1  # both legs fold under ONE id
+    assert report["legs"] >= 3  # 2 front leg spans + the host leg
+    assert report["hedge"]["fired"] >= 1
+    assert report["hedge"]["hedged_requests"] == 1
+    assert report["tail"]["worst"]["trace"] == "stitch-1"
+    assert "device" in report["segments"]
+
+
+# -- arrival-anchored latency under requeue ----------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.fleet
+def test_requeue_latency_anchored_at_original_arrival():
+    """Satellite regression: end-to-end latency is measured from the
+    ORIGINAL front-door arrival.  Requests wedged on a host that dies
+    are requeued to the survivor; a requeue must never restart the
+    latency clock, so the reported latency covers the wedge, not just
+    the survivor's quick service."""
+    plans, params = _mlp_spec(seed=3)
+    hosts = _Hosts(2, plans, params)
+    router = FleetRouter(hedge=False).start()  # isolate the requeue
+    try:
+        for i in range(2):
+            hosts.connect(router, i)
+        x = numpy.random.RandomState(5).rand(
+            6, 16).astype(numpy.float32)
+        ref = hosts.entries[0][0].infer(x)
+        requeues_before = _counter("serve.fleet.requeues")
+        # wedge ONLY h0 (the host-scoped chaos point) so survivors
+        # answer fast and the wedge time is attributable
+        chaos.install(chaos.FaultPlan(seed=2).add(
+            "serve.host.stall:h0", "stall", times=8, param=5.0))
+        try:
+            t0 = time.perf_counter()
+            reqs = [router.submit(row, trace="rq-%d" % i)
+                    for i, row in enumerate(x)]
+            time.sleep(0.3)  # the wedged requests age on h0
+            hosts.stop(0)
+            for req in reqs:
+                assert req.done.wait(20), "request dropped"
+                assert req.error is None, req.error
+            elapsed = time.perf_counter() - t0
+        finally:
+            chaos.uninstall()
+        for req, want in zip(reqs, ref):
+            assert (req.result == want).all()
+        requeued = [r for r in reqs if r.requeues >= 1]
+        assert requeued, "no request was requeued off the dead host"
+        assert _counter("serve.fleet.requeues") > requeues_before
+        for req in requeued:
+            # anchored at arrival: the 0.3 s wedge is part of the
+            # latency; a clock restarted at requeue would report only
+            # the survivor's few-ms service time
+            assert req.latency >= 0.28, req.latency
+            assert req.latency <= elapsed + 0.05
+    finally:
+        router.stop()
+        hosts.stop(1)
+
+
+# -- analyzer CLI ------------------------------------------------------------
+
+
+def test_observe_requests_cli_roundtrip(tmp_path, capsys):
+    """``python -m veles_tpu.observe requests`` renders the digest
+    from a recorded SLO dump, ``--json`` emits the machine report, and
+    the ``summary`` command appends the per-request-segment digest."""
+    from veles_tpu.observe.__main__ import main
+    from veles_tpu.observe.flight import flight
+    # earlier serve tests left request spans in the process-shared
+    # flight ring; the analyzer would fold them into this dump too
+    flight.clear()
+    ring = reqtrace.ExemplarRing(capacity=8, window=8, min_samples=2)
+    marks = [("queue", 5.0, 0.002), ("device", 5.002, 0.120),
+             ("d2h", 5.122, 0.001)]
+    for i in range(3):
+        ring.note("cli-%d" % i, 0.123 + i * 0.01, marks=marks, t0=5.0,
+                  slo_class="interactive", budget_s=0.1)
+    path = str(tmp_path / "slo_dump.json")
+    assert ring.dump(path=path) == path
+
+    assert main(["requests", path]) == 0
+    text = capsys.readouterr().out
+    assert "request digest: 3 requests" in text
+    assert "device" in text and "tail" in text
+
+    assert main(["requests", path, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["kind"] == "requests"
+    assert report["exemplars"] == 3
+    assert report["segments"]["device"]["count"] == 3
+    assert report["tail"]["worst"]["dominant"] == "device"
+
+    assert main(["summary", path]) == 0
+    text = capsys.readouterr().out
+    assert "request segments: 3 requests" in text

@@ -35,6 +35,13 @@ Cluster scope (PR 5) adds four more:
   device-memory gauges, and the live ``mfu_pct`` from the compiled
   step's cost analysis (jax imported lazily, off the hot path).
 
+The serve tier (PR 19) adds:
+
+- :mod:`veles_tpu.observe.requests` — request-scoped serve tracing:
+  trace ids, per-segment timelines, the tail-exemplar ring dumped on
+  SLO violations, and the ``python -m veles_tpu.observe requests``
+  critical-path analyzer.
+
 Everything here is stdlib-only and import-light, so hot modules
 (units, pipeline_input, compiler-adjacent code) can import it without
 dragging in jax.
@@ -52,6 +59,10 @@ from veles_tpu.observe.profile import (HEARTBEAT_SCHEMA_VERSION, Heartbeat,
                                        ProfilerHook, install_profiler,
                                        profiler_step, uninstall_profiler,
                                        validate_heartbeat)
+from veles_tpu.observe.requests import (ExemplarRing, analyze_files,
+                                        exemplars, mint_trace_id,
+                                        normalize_trace_id,
+                                        render_requests)
 from veles_tpu.observe.trace import (CHUNK_SCHEMA_VERSION, SpanTracer,
                                      instant, span, traced, tracer,
                                      validate_trace)
@@ -67,4 +78,6 @@ __all__ = [
     "FlightRecorder", "flight", "validate_flight",
     "FLIGHT_SCHEMA_VERSION",
     "TraceCollector", "estimate_offset", "probe_sample",
+    "ExemplarRing", "exemplars", "mint_trace_id",
+    "normalize_trace_id", "analyze_files", "render_requests",
 ]

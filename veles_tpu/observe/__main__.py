@@ -9,6 +9,13 @@ Commands:
 - ``summary <trace.json|flight.json> [--top N]`` — print a textual
   digest (top spans by self time per track, counter last values) of a
   trace file or a flight-recorder dump, for CI logs and bug reports.
+- ``requests trace.json host0.json ... [--offset label=secs]`` —
+  request-scoped critical-path analysis over saved traces, flight
+  dumps, and merged documents: per-segment p50/p99 table, dominant-
+  segment tail attribution, hedge win/loss + requeue accounting.
+  Trace files are offset-stitched like ``merge`` first, so one hedged
+  request's legs on two hosts fold under one id (docs/observability.md
+  "Request tracing").
 """
 
 import argparse
@@ -49,6 +56,20 @@ def main(argv=None):
     ps.add_argument("input", metavar="TRACE_OR_FLIGHT")
     ps.add_argument("--top", type=int, default=10)
 
+    pr = sub.add_parser(
+        "requests",
+        help="critical-path analysis of request-scoped traces")
+    pr.add_argument("inputs", nargs="+", metavar="TRACE_OR_FLIGHT",
+                    help="saved trace files and/or flight dumps; "
+                         "trace files are offset-stitched first (the "
+                         "first is the reference clock)")
+    pr.add_argument("--offset", action="append", default=[],
+                    metavar="LABEL=SECS",
+                    help="clock offset for that process, as in merge")
+    pr.add_argument("--top", type=int, default=5)
+    pr.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+
     args = parser.parse_args(argv)
     if args.command == "merge":
         from veles_tpu.observe import merge
@@ -67,6 +88,20 @@ def main(argv=None):
         from veles_tpu.observe import summary
         doc = summary.load(args.input)
         summary.render(summary.summarize(doc, top=args.top))
+        line = summary.request_digest_line(doc, top=args.top)
+        if line:
+            print("  " + line)
+        return 0
+    if args.command == "requests":
+        from veles_tpu.observe import requests as reqtrace
+        report = reqtrace.analyze_files(
+            args.inputs, offsets=_parse_offsets(args.offset),
+            top=args.top)
+        if args.json:
+            import json
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            reqtrace.render_requests(report)
         return 0
     return 1
 

@@ -132,9 +132,13 @@ class FlightRecorder(object):
             events.append(event)
         return events
 
-    def document(self, reason=""):
+    def document(self, reason="", extra=None):
+        """``extra`` merges additional top-level blocks into the dump
+        (e.g. the request-tracing exemplar timelines,
+        observe/requests.py); required schema keys always win —
+        validate_flight tolerates the additions."""
         from veles_tpu import logger as _vlogger
-        return {
+        doc = {
             "kind": "flight",
             "schema": FLIGHT_SCHEMA_VERSION,
             "reason": reason or "dump",
@@ -146,8 +150,12 @@ class FlightRecorder(object):
             "capacity": self.capacity,
             "events": self.snapshot(),
         }
+        if extra:
+            for key, value in extra.items():
+                doc.setdefault(key, value)
+        return doc
 
-    def dump(self, reason="", path=None):
+    def dump(self, reason="", path=None, extra=None):
         """Write the ring to ``path`` (default: sequenced next to
         ``base_path``) atomically.  NEVER raises — the recorder runs on
         failure paths where a second fault must not mask the first.
@@ -155,7 +163,7 @@ class FlightRecorder(object):
         if not self.enabled:
             return None
         try:
-            doc = self.document(reason)
+            doc = self.document(reason, extra=extra)
             if path is None:
                 locked = self._lock.acquire(timeout=2.0)
                 try:

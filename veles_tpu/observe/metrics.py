@@ -245,6 +245,12 @@ _HEALTH_KEYS = (
     ("serve.tenant.batch.shed", "tenant_batch_shed"),
     ("serve.tenant.best_effort.requests", "tenant_best_effort_requests"),
     ("serve.tenant.best_effort.shed", "tenant_best_effort_shed"),
+    # request-scoped tracing (observe/requests.py): span-sampled and
+    # tail-exemplar volume ride heartbeats so a p99 cliff can be lined
+    # up against the request timelines captured for it; the full
+    # per-segment latency block is serve_snapshot()["segments"]
+    ("serve.reqtrace.sampled", "reqtrace_sampled"),
+    ("serve.reqtrace.exemplars", "reqtrace_exemplars"),
     # fleet canary (veles_tpu/serve/freshness.py FleetCanaryController):
     # host-sliced mirror volume and promote/rollback outcomes
     ("serve.fleet.canary.mirrors", "fleet_canary_mirrors"),

@@ -15,7 +15,7 @@ output when ``VELES_TRACE`` is set.
 import json
 
 __all__ = ["load", "summarize", "summarize_trace", "summarize_flight",
-           "render", "digest_line"]
+           "render", "digest_line", "request_digest_line"]
 
 
 def load(path):
@@ -168,6 +168,24 @@ def render(summary, out=None):
                   file=out)
 
 
+def request_digest_line(doc, top=3):
+    """One line of per-request-segment attribution when the document
+    carries request-scoped spans or exemplars (observe/requests.py);
+    None otherwise — ``observe summary`` and :func:`digest_line`
+    append it so CI logs show WHERE request time went."""
+    from veles_tpu.observe import requests as reqtrace
+    records, counts = reqtrace.extract_requests(doc)
+    if not records:
+        return None
+    report = reqtrace.analyze(records, counts, top=top)
+    segs = sorted(report["segments"].items(),
+                  key=lambda kv: -kv[1]["p99_ms"])[:top]
+    parts = ", ".join("%s p99 %.3f ms" % (name, row["p99_ms"])
+                      for name, row in segs)
+    return "request segments: %d requests, %d legs; %s" % (
+        report["requests"], report["legs"], parts or "no segments")
+
+
 def digest_line(doc, top=3):
     """One line: the global top-N spans by self time — what bench.py
     appends to CI logs when VELES_TRACE is set."""
@@ -181,5 +199,7 @@ def digest_line(doc, top=3):
     ranked = sorted(merged.items(), key=lambda kv: -kv[1][0])[:top]
     spans = ", ".join("%s %.3fs x%d" % (name, s, c)
                       for name, (s, c) in ranked) or "no spans"
-    return "trace digest: %d events; top self-time: %s" % (
+    line = "trace digest: %d events; top self-time: %s" % (
         summary["events"], spans)
+    req = request_digest_line(doc, top=top)
+    return line if req is None else "%s; %s" % (line, req)

@@ -158,6 +158,28 @@ class SpanTracer(object):
         seen record no events, so they are simply absent)."""
         return {self._tids[i] for i in idents if i in self._tids}
 
+    def request_track(self, key, label):
+        """Allocate (or reuse) a dedicated track for one request leg
+        (observe/requests.py).  Request-scoped spans cannot share the
+        recording thread's track: one batch completes many requests
+        whose queue spans overlap without nesting, and one hedged
+        request's legs run concurrently — each leg gets its own lane,
+        keyed by an arbitrary hashable (id, leg discriminator) and
+        labeled with the request id so legs group visually."""
+        key = ("req", key)
+        tid = self._tids.get(key)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.get(key)
+                if tid is None:
+                    tid = len(self._tids) + 1
+                    self._tids[key] = tid
+                    self._tid_names[tid] = label
+            self._append({
+                "name": "thread_name", "ph": "M", "pid": self._pid,
+                "tid": tid, "args": {"name": label}})
+        return tid
+
     def _append(self, event):
         if len(self._events) >= self._max_events:
             self.dropped += 1
@@ -167,12 +189,15 @@ class SpanTracer(object):
     def _ts(self, when):
         return (when - self._epoch) * 1e6
 
-    def complete(self, name, start, dur, cat="span", args=None):
+    def complete(self, name, start, dur, cat="span", args=None,
+                 tid=None):
         """Record a complete ("X") event from perf_counter timings —
         the primitive every instrumented timer calls, so the trace and
         the accumulated timers always report the SAME measurement.
         Always feeds the flight recorder's ring (compact tuple, no
-        serialization) so post-mortem dumps work without ``--trace``."""
+        serialization) so post-mortem dumps work without ``--trace``.
+        ``tid`` overrides the recording thread's track — request-
+        scoped spans land on their :meth:`request_track` lane."""
         flt = self._flight
         if flt.enabled:
             flt.record("span", name, cat, self.wall_time(start), dur,
@@ -181,7 +206,8 @@ class SpanTracer(object):
             return
         event = {"name": name, "cat": cat, "ph": "X",
                  "ts": self._ts(start), "dur": dur * 1e6,
-                 "pid": self._pid, "tid": self._tid()}
+                 "pid": self._pid,
+                 "tid": self._tid() if tid is None else tid}
         if args:
             event["args"] = args
         self._append(event)
@@ -368,6 +394,36 @@ def validate_trace(doc):
                     "nest within its enclosing span (ends %f)" %
                     (track, event["name"], event["ts"], end, stack[-1]))
             stack.append(end)
+    # request-span contract (observe/requests.py): every request-
+    # scoped event carries its id, one track never mixes requests,
+    # and segment spans ride under a serve.request parent
+    for i, event in enumerate(doc["traceEvents"]):
+        if event.get("cat") != "req" or event.get("ph") not in \
+                ("X", "i"):
+            continue
+        trace_id = (event.get("args") or {}).get("trace")
+        if not isinstance(trace_id, str) or not trace_id:
+            raise ValueError(
+                "event %d: request-scoped event %r has no args.trace "
+                "id (orphan)" % (i, event.get("name")))
+    for track, events in per_track.items():
+        req_events = [e for e in events if e.get("cat") == "req"]
+        if not req_events:
+            continue
+        ids = {(e.get("args") or {}).get("trace")
+               for e in req_events}
+        if len(ids) > 1:
+            raise ValueError(
+                "track %r: request track mixes trace ids %r" %
+                (track, sorted(ids)))
+        if any(e["name"].startswith("serve.req.")
+               for e in req_events) and \
+                not any(e["name"] == "serve.request"
+                        for e in req_events):
+            raise ValueError(
+                "track %r: segment spans for trace %r without an "
+                "enclosing serve.request span" %
+                (track, next(iter(ids))))
     return doc
 
 

@@ -42,10 +42,19 @@ saturated with interactive work itself.
 
 Chaos points (docs/health.md table): ``serve.drop`` (submit-side shed),
 ``serve.stall`` (worker sleeps ``param`` seconds — trips the SLO
-watch), ``serve.oom`` (simulated RESOURCE_EXHAUSTED — exercises the
-degrade path), ``serve.tenant.flood`` (``param`` synthetic best-effort
-requests storm the queue as real load — exercises class-ordered
-shedding).
+watch), ``serve.device.stall`` (sleeps at the DEVICE-dispatch edge so
+request timelines attribute the stall to the device segment — the
+tail-attribution chaos hook), ``serve.oom`` (simulated
+RESOURCE_EXHAUSTED — exercises the degrade path),
+``serve.tenant.flood`` (``param`` synthetic best-effort requests storm
+the queue as real load — exercises class-ordered shedding).
+
+Request tracing (docs/observability.md "Request tracing"): while
+``VELES_REQTRACE`` is on, the worker stamps each request's segment
+timeline (queue / assemble / h2d / device / d2h) on the request object
+before ``done.set()``, feeds the tail-exemplar ring, and emits
+request-track spans for sampled ids; an SLO-breach ENTER edge dumps
+the exemplar ring with the flight recorder.
 """
 
 import collections
@@ -58,6 +67,7 @@ import numpy
 from veles_tpu import chaos
 from veles_tpu.logger import Logger
 from veles_tpu.memory import Array
+from veles_tpu.observe import requests as reqtrace
 from veles_tpu.observe.metrics import percentiles
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
@@ -79,15 +89,27 @@ class ServeOverload(Exception):
 class _Request(object):
     __slots__ = ("sample", "enqueued", "done", "result", "error",
                  "cancelled", "block", "shadow", "latency", "slo_class",
-                 "claimed")
+                 "claimed", "trace", "marks", "child")
 
     def __init__(self, sample, block=False, shadow=False,
-                 slo_class=None):
+                 slo_class=None, trace=None):
         self.sample = sample
         #: canonical SLO class ("interactive" / "batch" /
         #: "best_effort") — decides shed order under overload and which
         #: serve.tenant.<class>.* series the request lands in
         self.slo_class = qos.normalize_class(slo_class)
+        #: request trace id (observe/requests.py id contract) — rides
+        #: the request through requeue/hedge/chunked replay unchanged
+        self.trace = trace
+        #: segment timeline [(segment, start_perf, dur_s)] stamped by
+        #: the worker at completion, BEFORE done.set() so a transport
+        #: waiter can echo it over the wire; None while VELES_REQTRACE
+        #: is off (the zero-overhead kill switch)
+        self.marks = None
+        #: OOM-replay slice of a block request: its marks fold into the
+        #: parent's timeline instead of emitting their own spans /
+        #: exemplars (the parent is the request the client knows)
+        self.child = False
         self.enqueued = time.perf_counter()
         self.done = threading.Event()
         self.result = None
@@ -163,6 +185,12 @@ class ContinuousBatcher(Logger):
         #: counters and histograms stay process-shared so fleet totals
         #: and latency percentiles aggregate by construction
         self.replica = replica
+        #: fleet host identity (set by BinaryTransportServer via
+        #: ``set_host_tag`` when host_meta names one): rides request-
+        #: span args so two in-process hosts' legs stay attributable
+        #: in a shared tracer, and a merged cross-host timeline can
+        #: name the slow leg
+        self.host_tag = None
         self._q = queue.Queue()
         self._thread = None
         self._stop_ = False
@@ -185,7 +213,18 @@ class ContinuousBatcher(Logger):
         self._m_shed = _registry.counter("serve.shed")
         self._m_errors = _registry.counter("serve.errors")
         self._m_slo = _registry.counter("serve.slo_violations")
+        # per-segment latency histograms (observe/requests.py segment
+        # taxonomy); queue is per-request, the rest per-batch — fed
+        # only while request tracing is enabled
+        self._h_seg = {
+            name: _registry.histogram("serve.segment.%s_s" % name)
+            for name in ("queue", "assemble", "h2d", "device", "d2h")}
         self._m_depth.set(0)
+
+    def set_host_tag(self, tag):
+        """Name the fleet host this batcher serves (transport hello
+        host_meta); request spans carry it as the leg attribution."""
+        self.host_tag = tag
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -387,21 +426,24 @@ class ContinuousBatcher(Logger):
         self._m_depth.set(self._q.qsize())
         return req
 
-    def submit(self, sample, slo_class=None):
+    def submit(self, sample, slo_class=None, trace=None):
         """Enqueue one sample; returns the pending request.  Raises
         :class:`ServeOverload` when shedding (full queue or chaos
         ``serve.drop``).  ``slo_class`` labels the request for the QoS
         layer (class-ordered shedding + per-class accounting);
-        un-labelled callers default to ``batch``."""
+        un-labelled callers default to ``batch``.  ``trace`` is the
+        request trace id (observe/requests.py) the worker stamps its
+        segment timeline against."""
         slo_class = qos.normalize_class(slo_class)
         self._admit(slo_class)
         sample = numpy.ascontiguousarray(sample, self.engine.dtype)
         if sample.shape != self.engine.sample_shape:
             raise ValueError("expected sample shape %s, got %s" %
                              (self.engine.sample_shape, sample.shape))
-        return self._enqueue(_Request(sample, slo_class=slo_class))
+        return self._enqueue(_Request(sample, slo_class=slo_class,
+                                      trace=trace))
 
-    def submit_block(self, block, slo_class=None):
+    def submit_block(self, block, slo_class=None, trace=None):
         """Enqueue a whole batch as ONE request whose rows stay in
         their caller-provided buffer.
 
@@ -432,16 +474,19 @@ class ContinuousBatcher(Logger):
                 "chunk at the caller" %
                 (block.shape[0], self.engine.max_batch))
         return self._enqueue(_Request(block, block=True,
-                                      slo_class=slo_class))
+                                      slo_class=slo_class,
+                                      trace=trace))
 
-    def submit_shadow(self, sample):
+    def submit_shadow(self, sample, trace=None):
         """Best-effort enqueue of a canary-mirror shadow copy: never
         raises :class:`ServeOverload` — a loaded (or chaos-shedding)
         canary simply mirrors less — and returns None instead of a
         request when dropped.  Shadow requests co-batch like real ones
         but are excluded from the served counters (``serve.requests``,
         ``serve.latency_s``) and never bump the shed counter: mirrored
-        traffic is an observation, not load."""
+        traffic is an observation, not load.  A shadow KEEPS the
+        primary's trace id (its spans are tagged ``shadow``) but is
+        excluded from the tail-exemplar ring."""
         if self._thread is None or self._stop_ or \
                 self._q.qsize() >= self.max_queue:
             return None
@@ -450,7 +495,8 @@ class ContinuousBatcher(Logger):
             raise ValueError("expected sample shape %s, got %s" %
                              (self.engine.sample_shape, sample.shape))
         try:
-            return self._enqueue(_Request(sample, shadow=True))
+            return self._enqueue(_Request(sample, shadow=True,
+                                          trace=trace))
         except ServeOverload:
             return None  # lost the race with stop(): drop the shadow
 
@@ -557,6 +603,7 @@ class ContinuousBatcher(Logger):
             # exactly skips the staging fill — Device.put gets the
             # caller's buffer (and on XLA:CPU makes the one hazard-safe
             # XLA-owned copy; see CPUDevice.put / submit_block)
+            t_h2d = start  # no staging fill: the put IS the H2D edge
             x_dev = self.engine.device.put(batch[0].sample)
         else:
             arr, slot = self._staging(rung)
@@ -574,15 +621,24 @@ class ContinuousBatcher(Logger):
                 # deterministic padding (bit-equality contract)
                 mem[n:] = 0
                 self._m_padded.inc(rung - n)
+            t_h2d = time.perf_counter()
             x_dev = arr.stage_put(self.engine.device)
+        t_dev = time.perf_counter()
         try:
             if chaos.plan is not None:
+                fault = chaos.plan.fire("serve.device.stall")
+                if fault is not None:
+                    # a slow accelerator (thermal throttle, preempted
+                    # chip): the stall lands INSIDE the device segment
+                    # so request timelines attribute it correctly
+                    time.sleep(fault.param if fault.param else 0.05)
                 fault = chaos.plan.fire("serve.oom")
                 if fault is not None:
                     raise MemoryError(
                         "RESOURCE_EXHAUSTED: chaos serve.oom (rung %d)"
                         % rung)
             out = self.engine.run(x_dev, rung)
+            t_d2h = time.perf_counter()
             # the ONE host sync of the whole batch (the old RESTfulAPI
             # synced per request)
             host = numpy.asarray(out)
@@ -598,6 +654,14 @@ class ContinuousBatcher(Logger):
         if served:
             self._m_requests.inc(served)
         self._m_batch.observe(n)
+        stamps = reqtrace.enabled
+        if stamps:
+            # per-batch segment histograms (serve_snapshot "segments"
+            # block); queue is per-request, observed in _note_request
+            self._h_seg["assemble"].observe(t_h2d - start)
+            self._h_seg["h2d"].observe(t_dev - t_h2d)
+            self._h_seg["device"].observe(t_d2h - t_dev)
+            self._h_seg["d2h"].observe(done - t_d2h)
         off = 0
         for req in batch:
             # hand out VIEWS of the one per-batch host block: the
@@ -611,6 +675,11 @@ class ContinuousBatcher(Logger):
                 req.result = host[off]
             off += req.rows
             req.latency = done - req.enqueued
+            if stamps:
+                # marks must land BEFORE done.set(): a transport
+                # waiter echoes them over the wire at wake-up
+                self._note_request(req, start, t_h2d, t_dev, t_d2h,
+                                   done, rung)
             if not req.shadow:
                 self._m_latency.observe(req.latency)
                 # per-class accounting (docs/serving.md "Multi-tenant
@@ -628,6 +697,47 @@ class ContinuousBatcher(Logger):
         if self._batches_since_check >= self.slo_check_every:
             self._batches_since_check = 0
             self._check_slo()
+
+    def _note_request(self, req, start, t_h2d, t_dev, t_d2h, done,
+                      rung):
+        """Stamp one completed request's segment timeline (observe/
+        requests.py taxonomy), feed the tail-exemplar ring, and emit
+        request-track spans when the request is sampled."""
+        queue_wait = start - req.enqueued
+        marks = [("queue", req.enqueued, queue_wait),
+                 ("assemble", start, t_h2d - start),
+                 ("h2d", t_h2d, t_dev - t_h2d),
+                 ("device", t_dev, t_d2h - t_dev),
+                 ("d2h", t_d2h, done - t_d2h)]
+        if req.marks:
+            # a front (HTTP admit, transport wire_rx) stamped marks
+            # before the queue segment began: keep them at the head
+            marks = list(req.marks) + marks
+        req.marks = marks
+        if req.child:
+            return  # the sliced parent reports for the whole request
+        self._h_seg["queue"].observe(queue_wait)
+        self._emit_request(req, done, rung=rung)
+
+    def _emit_request(self, req, done, rung=None):
+        reqtrace.exemplars.note(
+            req.trace, req.latency, marks=req.marks or (),
+            t0=req.enqueued, slo_class=req.slo_class,
+            budget_s=qos.slo_budget_s(req.slo_class), kind="host",
+            shadow=req.shadow)
+        if req.trace and _tracer.active and reqtrace.sampled(req.trace):
+            args = {"slo_class": req.slo_class, "tier": "host",
+                    "rows": req.rows}
+            if rung is not None:
+                args["rung"] = rung
+            if self.host_tag:
+                args["host"] = self.host_tag
+            if self.replica is not None:
+                args["replica"] = self.replica
+            if req.shadow:
+                args["shadow"] = True
+            reqtrace.emit_spans(_tracer, req.trace, req.enqueued,
+                                done, req.marks or (), args=args)
 
     def _run_chunked(self, batch, rung):
         """Replay a too-large batch within a capped rung: requests are
@@ -654,8 +764,10 @@ class ContinuousBatcher(Logger):
         children = []
         for i in range(0, req.rows, cap):
             child = _Request(req.sample[i:i + cap], block=True,
-                             shadow=req.shadow, slo_class=req.slo_class)
+                             shadow=req.shadow, slo_class=req.slo_class,
+                             trace=req.trace)
             child.enqueued = req.enqueued
+            child.child = True
             children.append(child)
         for child in children:
             self._run_batch([child])
@@ -665,6 +777,20 @@ class ContinuousBatcher(Logger):
         else:
             req.result = numpy.concatenate(
                 [c.result for c in children])
+        done = time.perf_counter()
+        req.latency = done - req.enqueued
+        if reqtrace.enabled and not errors:
+            # the parent's timeline is the chunk sequence: keep only
+            # the first chunk's queue mark (later "queues" would
+            # overlap the earlier chunks' spans on the request track)
+            marks = []
+            for index, child in enumerate(children):
+                for mark in (child.marks or ()):
+                    if index and mark[0] == "queue":
+                        continue
+                    marks.append(mark)
+            req.marks = marks
+            self._emit_request(req, done)
         req.done.set()
 
     def _degrade_or_fail(self, batch, rung, exc):
@@ -717,6 +843,11 @@ class ContinuousBatcher(Logger):
             # the log at batch rate
             self.warning("SLO violation began: %s", "; ".join(
                 "%s %.2fms > %.2fms budget" % b for b in breaches))
+            if reqtrace.enabled:
+                # the flight dump for this violation carries the tail
+                # exemplars, so the breach always ships the offending
+                # requests' full segment timelines (never raises)
+                reqtrace.exemplars.dump("serve.slo_violation")
         elif self._slo_breached and not breaches:
             self.info("SLO recovered (window p50 %.2fms p99 %.2fms)",
                       p50_ms, p99_ms)
@@ -782,7 +913,14 @@ def serve_snapshot(reg=None):
                         ("serve.fleet.canary.promotions",
                          "fleet_canary_promotions"),
                         ("serve.fleet.canary.rollbacks",
-                         "fleet_canary_rollbacks")):
+                         "fleet_canary_rollbacks"),
+                        # request tracing (docs/observability.md
+                        # "Request tracing"): sampled-span and tail-
+                        # exemplar volume; the per-segment breakdown
+                        # is the "segments" block below
+                        ("serve.reqtrace.sampled", "reqtrace_sampled"),
+                        ("serve.reqtrace.exemplars",
+                         "reqtrace_exemplars")):
         metric = reg.peek(name)
         if metric is not None and metric.value is not None:
             out[short] = metric.value
@@ -806,6 +944,21 @@ def serve_snapshot(reg=None):
     batch = reg.peek("serve.batch_size")
     if batch is not None and batch.count:
         out["batch_mean"] = round(batch.snapshot()["mean"], 2)
+    # per-segment latency breakdown (observe/requests.py taxonomy):
+    # WHERE the time goes, next to the end-to-end percentiles above —
+    # populated while request tracing is enabled
+    segments = {}
+    for name in reqtrace.SEGMENTS:
+        hist = reg.peek("serve.segment.%s_s" % name)
+        if hist is not None and hist.count:
+            snap = hist.snapshot()
+            segments[name] = {
+                "count": snap["count"],
+                "p50_ms": round((snap.get("p50") or 0.0) * 1e3, 3),
+                "p99_ms": round((snap.get("p99") or 0.0) * 1e3, 3),
+            }
+    if segments:
+        out["segments"] = segments
     tenants = qos.tenant_snapshot(reg)
     if tenants:
         out["tenants"] = tenants

@@ -225,6 +225,10 @@ class AOTEngine(Logger):
         self.compile_receipt = None
         self._compiled = {}
         self._params_dev = None
+        #: per-rung dispatch counters, minted on first use — lets the
+        #: request-trace device segment (observe/requests.py) be
+        #: correlated with WHICH executable ran when a tail shows up
+        self._dispatch_counters = {}
 
     @classmethod
     def from_workflow(cls, sw, **kwargs):
@@ -389,7 +393,15 @@ class AOTEngine(Logger):
 
     def run(self, x_dev, rung):
         """Dispatch one pre-compiled executable on an exact-rung device
-        batch; returns the device-side output (no host sync)."""
+        batch; returns the device-side output (no host sync).  Bumps
+        ``serve.engine.dispatches.rung<r>`` so device-segment tails in
+        the request traces attribute to the executable that ran."""
+        counter = self._dispatch_counters.get(rung)
+        if counter is None:
+            counter = self._dispatch_counters[rung] = \
+                _registry.counter(
+                    "serve.engine.dispatches.rung%d" % rung)
+        counter.inc()
         return self._compiled[rung](self._params_dev, x_dev)
 
     def infer(self, x):

@@ -69,6 +69,7 @@ from veles_tpu.logger import Logger
 from veles_tpu.network_common import (
     ProtocolError, default_secret, machine_id, pack_frame,
     read_frame_sync)
+from veles_tpu.observe import requests as reqtrace
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.serve import qos
@@ -172,7 +173,8 @@ class HostLink(object):
 
     # -- API ----------------------------------------------------------------
 
-    def send_infer(self, wid, arr, slo_class=None, shadow=False):
+    def send_infer(self, wid, arr, slo_class=None, shadow=False,
+                   trace=None):
         meta, raw = encode_tensor(arr)
         msg = {"op": "infer", "id": wid}
         if slo_class is not None:
@@ -184,6 +186,11 @@ class HostLink(object):
             # submit_shadow — computed and answered, never counted in
             # the served/tenant metrics
             msg["shadow"] = True
+        if trace is not None:
+            # request trace id rides the copy so both hedge legs of
+            # one request stamp the SAME id on their host timelines
+            # (plain bounded string — observe/requests.py contract)
+            msg["trace"] = trace
         msg.update(meta)
         self._send(msg, raw)
 
@@ -191,9 +198,11 @@ class HostLink(object):
         self._send({"op": "cancel", "id": wid})
 
     def start_reader(self, on_result, on_error, on_down):
-        """Spawn the reply-dispatch thread: ``on_result(wid, arr)`` /
-        ``on_error(wid, exc)`` per answered frame, ``on_down()`` once
-        when the link dies (or closes)."""
+        """Spawn the reply-dispatch thread: ``on_result(wid, arr,
+        msg)`` / ``on_error(wid, exc)`` per answered frame (``msg`` is
+        the reply header — carries the host's echoed ``trace``/
+        ``segs``), ``on_down()`` once when the link dies (or
+        closes)."""
 
         def loop():
             try:
@@ -213,7 +222,7 @@ class HostLink(object):
                         except ProtocolError as exc:
                             on_error(msg.get("id"), exc)
                             continue
-                        on_result(msg.get("id"), arr)
+                        on_result(msg.get("id"), arr, msg)
                     elif op == "error":
                         if msg.get("transient"):
                             exc = ServeOverload(
@@ -265,9 +274,10 @@ class FleetRequest(object):
     __slots__ = ("sample", "rows", "block", "enqueued", "done",
                  "result", "error", "cancelled", "epoch", "copies",
                  "sheds", "hedges", "resolved", "slo_class", "latency",
-                 "mirror")
+                 "mirror", "trace", "requeues", "legs")
 
-    def __init__(self, sample, block=False, slo_class=None):
+    def __init__(self, sample, block=False, slo_class=None,
+                 trace=None):
         self.sample = sample
         self.rows = sample.shape[0] if block else 1
         self.block = block
@@ -292,13 +302,23 @@ class FleetRequest(object):
         #: the canary host; cleared once the pair is emitted (or the
         #: shadow failed)
         self.mirror = None
+        #: request trace id (observe/requests.py) — rides every
+        #: dispatched copy so hedge legs stitch under one id
+        self.trace = trace
+        #: times this request was requeued to a survivor after losing
+        #: ALL its live copies (host death / send failure)
+        self.requeues = 0
+        #: dispatch-leg records, appended per copy: {"host", "start",
+        #: "end", "hedge", "outcome", "segs"} — the front-tier
+        #: critical-path story (serve.req.leg spans + exemplars)
+        self.legs = []
 
 
 class _Copy(object):
     """One dispatched copy of a request (original or hedge)."""
 
     __slots__ = ("wid", "entry", "host_id", "epoch", "sent_at",
-                 "hedge")
+                 "hedge", "leg")
 
     def __init__(self, wid, entry, host_id, epoch, hedge):
         self.wid = wid
@@ -307,6 +327,8 @@ class _Copy(object):
         self.epoch = epoch
         self.sent_at = time.perf_counter()
         self.hedge = hedge
+        #: this copy's record in entry.legs (None when untraced)
+        self.leg = None
 
 
 class _Host(object):
@@ -505,7 +527,8 @@ class FleetRouter(Logger):
             host = self._hosts[hid] = _Host(hid, link, epoch)
             self._publish_membership()
         link.start_reader(
-            lambda wid, arr: self._on_result(host, wid, arr),
+            lambda wid, arr, msg=None: self._on_result(
+                host, wid, arr, msg),
             lambda wid, exc: self._on_error(host, wid, exc),
             lambda: self._on_link_down(host))
         _tracer.instant("serve.fleet.join", cat="serve", host=hid,
@@ -558,6 +581,7 @@ class FleetRouter(Logger):
         self._publish_membership()
         _tracer.instant("serve.fleet.leave", cat="serve",
                         host=host.host_id, epoch=epoch, reason=reason)
+        now = time.perf_counter()
         wids, host.inflight = list(host.inflight), set()
         for wid in wids:
             shadow = self._shadow_wire.pop(wid, None)
@@ -571,11 +595,24 @@ class FleetRouter(Logger):
                 continue
             entry = copy.entry
             entry.copies.pop(wid, None)
+            if copy.leg is not None and copy.leg["end"] is None:
+                copy.leg["end"] = now
+                copy.leg["outcome"] = "lost"
             if entry.resolved or entry.cancelled:
                 continue
             if entry.copies:
                 continue  # a hedged sibling still lives: let it win
             self._m_requeues.inc()
+            entry.requeues += 1
+            if _tracer.active:
+                # cat stays "serve": instants land on the caller's
+                # thread track, which mixes request ids — the analyzer
+                # matches by NAME, the trace arg attributes it
+                kwargs = {"host": host.host_id, "reason": reason}
+                if entry.trace is not None:
+                    kwargs["trace"] = entry.trace
+                _tracer.instant("serve.fleet.requeue", cat="serve",
+                                **kwargs)
             try:
                 self._send_copy(entry, exclude=set(entry.sheds))
             except ServeOverload as exc:
@@ -645,14 +682,24 @@ class FleetRouter(Logger):
             self._wire[wid] = copy
             entry.copies[wid] = host.host_id
             host.inflight.add(wid)
+            if reqtrace.enabled:
+                copy.leg = {"host": host.host_id,
+                            "start": copy.sent_at, "end": None,
+                            "hedge": hedge, "outcome": None,
+                            "segs": None}
+                entry.legs.append(copy.leg)
             try:
                 host.link.send_infer(wid, entry.sample,
-                                     slo_class=entry.slo_class)
+                                     slo_class=entry.slo_class,
+                                     trace=entry.trace)
                 return copy
             except Exception:
                 del self._wire[wid]
                 entry.copies.pop(wid, None)
                 host.inflight.discard(wid)
+                if copy.leg is not None:
+                    copy.leg["end"] = time.perf_counter()
+                    copy.leg["outcome"] = "send_failed"
                 exclude.add(host.host_id)
                 if host.state == "live":
                     host.state = "dead"
@@ -660,11 +707,13 @@ class FleetRouter(Logger):
                     self._retired.append(host.link)
                     host.link.close(join=False)
 
-    def submit(self, sample, slo_class=None):
+    def submit(self, sample, slo_class=None, trace=None):
         """Enqueue one sample on the fleet; returns the pending
         request (the batcher contract).  Raises ServeOverload when
         every live host sheds.  ``slo_class`` labels the request for
-        the QoS layer; un-labelled callers default to ``batch``."""
+        the QoS layer; un-labelled callers default to ``batch``.
+        ``trace`` is the request's trace id (observe/requests.py),
+        already normalized by the front door."""
         if self._profile is None:
             raise ServeOverload("fleet has no hosts", retry_after=1.0)
         sample = numpy.ascontiguousarray(sample, self._profile.dtype)
@@ -672,9 +721,9 @@ class FleetRouter(Logger):
             raise ValueError("expected sample shape %s, got %s" %
                              (self._profile.sample_shape, sample.shape))
         return self._submit_entry(
-            FleetRequest(sample, slo_class=slo_class))
+            FleetRequest(sample, slo_class=slo_class, trace=trace))
 
-    def submit_block(self, block, slo_class=None):
+    def submit_block(self, block, slo_class=None, trace=None):
         """Enqueue a contiguous batch as ONE request (the transport's
         block path); rows stay together on one host per copy."""
         if self._profile is None:
@@ -690,7 +739,8 @@ class FleetRouter(Logger):
                 " chunk at the caller" %
                 (block.shape[0], self._profile.max_batch))
         return self._submit_entry(
-            FleetRequest(block, block=True, slo_class=slo_class))
+            FleetRequest(block, block=True, slo_class=slo_class,
+                         trace=trace))
 
     def _inflight_total(self):
         return sum(len(pool) for pool in self._unresolved.values())
@@ -786,7 +836,7 @@ class FleetRouter(Logger):
         try:
             host.link.send_infer(wid, entry.sample,
                                  slo_class=entry.slo_class,
-                                 shadow=True)
+                                 shadow=True, trace=entry.trace)
         except Exception:
             self._shadow_wire.pop(wid, None)
             host.inflight.discard(wid)
@@ -796,14 +846,17 @@ class FleetRouter(Logger):
         slice_.mirrored += 1
         self._m_mirrors.inc()
 
-    def infer(self, sample, timeout=30.0, slo_class=None):
+    def infer(self, sample, timeout=30.0, slo_class=None, trace=None):
         """Blocking single-sample round-trip through the fleet."""
-        return self._wait(self.submit(sample, slo_class=slo_class),
-                          timeout)
-
-    def infer_block(self, block, timeout=30.0, slo_class=None):
         return self._wait(
-            self.submit_block(block, slo_class=slo_class), timeout)
+            self.submit(sample, slo_class=slo_class, trace=trace),
+            timeout)
+
+    def infer_block(self, block, timeout=30.0, slo_class=None,
+                    trace=None):
+        return self._wait(
+            self.submit_block(block, slo_class=slo_class, trace=trace),
+            timeout)
 
     def _wait(self, entry, timeout):
         if not entry.done.wait(timeout):
@@ -836,7 +889,7 @@ class FleetRouter(Logger):
 
     # -- completion (reader-thread callbacks) -------------------------------
 
-    def _on_result(self, host, wid, arr):
+    def _on_result(self, host, wid, arr, msg=None):
         now = time.perf_counter()
         with self._lock:
             shadow = self._shadow_wire.pop(wid, None)
@@ -870,6 +923,10 @@ class FleetRouter(Logger):
             self._unresolved[entry.slo_class].discard(entry)
             host.inflight.discard(wid)
             entry.copies.pop(wid, None)
+            if copy.leg is not None:
+                copy.leg["end"] = now
+                copy.leg["outcome"] = "win"
+                copy.leg["segs"] = self._leg_segments(msg)
             latency = now - copy.sent_at
             self.fleet.observe_throughput(
                 host.host_id, entry.rows / max(latency, 1e-9))
@@ -889,11 +946,95 @@ class FleetRouter(Logger):
         # tenant served counters are bumped at the HOST batcher (the
         # serving edge), never here: an in-process front + host pair
         # shares one registry and would double-count otherwise
+        # end-to-end latency is anchored at the ORIGINAL front-door
+        # arrival (entry.enqueued, stamped once in FleetRequest): a
+        # requeue or hedge re-dispatch must never restart the clock
         entry.latency = now - entry.enqueued
         self._m_latency.observe(entry.latency)
         self._latencies.append(entry.latency)
         entry.done.set()
+        self._emit_entry(entry, now)
         self._maybe_emit_pair(entry)
+
+    @staticmethod
+    def _leg_segments(msg):
+        """The host's echoed per-segment totals off a result frame —
+        defensively re-validated (plain floats, known segment names
+        only) even though the link is HMAC-authenticated."""
+        segs = (msg or {}).get("segs")
+        if not isinstance(segs, dict):
+            return None
+        clean = {}
+        for name in reqtrace.SEGMENTS:
+            value = segs.get(name)
+            if isinstance(value, (int, float)) and value >= 0:
+                clean[name] = float(value)
+        return clean or None
+
+    def _emit_entry(self, entry, now):
+        """Outside the lock: the front tier's request-scoped
+        observability for one resolved entry — tail exemplar + (for
+        sampled ids) a ``serve.request`` span with ``serve.req.leg``
+        children on the entry's own request track.  Per-SEGMENT spans
+        live on the HOST tracks under the same id; the merge stitch
+        (observe/merge.py) is what joins the two tiers."""
+        if not reqtrace.enabled:
+            return
+        start = entry.enqueued
+        marks = []
+        win_segs = None
+        for leg in entry.legs:
+            end = min(leg["end"] if leg["end"] is not None else now,
+                      now)
+            marks.append(("leg", leg["start"],
+                          max(0.0, end - leg["start"])))
+            if leg["outcome"] == "win" and leg["segs"]:
+                win_segs = (leg["start"], leg["segs"])
+        if win_segs is not None:
+            # synthesize sequential segment marks from the winning
+            # leg's echoed totals so the exemplar timeline carries a
+            # real breakdown even when the host dump is not at hand
+            cursor, segs = win_segs
+            for name in reqtrace.SEGMENTS:
+                if name in segs:
+                    marks.append((name, cursor, segs[name]))
+                    cursor += segs[name]
+        reqtrace.exemplars.note(
+            entry.trace, entry.latency, marks=marks, t0=start,
+            slo_class=entry.slo_class,
+            budget_s=qos.slo_budget_s(entry.slo_class), kind="fleet",
+            extra={"hedges": entry.hedges,
+                   "requeues": entry.requeues,
+                   "legs": [{"host": leg["host"],
+                             "hedge": leg["hedge"],
+                             "outcome": leg["outcome"]}
+                            for leg in entry.legs]})
+        if entry.trace is None or not _tracer.active or \
+                not reqtrace.sampled(entry.trace):
+            return
+        tid = _tracer.request_track((entry.trace, start),
+                                    "req:%s" % entry.trace)
+        _registry.counter("serve.reqtrace.sampled").inc()
+        _tracer.complete(
+            reqtrace.REQUEST_SPAN, start, max(0.0, now - start),
+            cat="req", args={"trace": entry.trace, "tier": "fleet",
+                             "slo_class": entry.slo_class,
+                             "hedges": entry.hedges,
+                             "requeues": entry.requeues,
+                             "legs": len(entry.legs)}, tid=tid)
+        for leg in entry.legs:
+            # clamp to the parent span so a loser cancelled
+            # microseconds after resolution still nests
+            end = min(leg["end"] if leg["end"] is not None else now,
+                      now)
+            args = {"trace": entry.trace, "host": leg["host"],
+                    "hedge": leg["hedge"]}
+            if leg["outcome"]:
+                args["outcome"] = leg["outcome"]
+            _tracer.complete(
+                reqtrace.LEG_SPAN, leg["start"],
+                max(0.0, end - leg["start"]), cat="req", args=args,
+                tid=tid)
 
     def _cancel_losers(self, entry):
         """Under the lock: retire every other live copy of a resolved
@@ -913,6 +1054,10 @@ class FleetRouter(Logger):
         for wid, hid in list(entry.copies.items()):
             lcopy = self._wire.pop(wid, None)
             entry.copies.pop(wid, None)
+            if lcopy is not None and lcopy.leg is not None and \
+                    lcopy.leg["end"] is None:
+                lcopy.leg["end"] = now
+                lcopy.leg["outcome"] = "cancelled"
             loser = self._hosts.get(hid)
             if loser is None:
                 continue
@@ -947,6 +1092,10 @@ class FleetRouter(Logger):
             entry = copy.entry
             host.inflight.discard(wid)
             entry.copies.pop(wid, None)
+            if copy.leg is not None and copy.leg["end"] is None:
+                copy.leg["end"] = time.perf_counter()
+                copy.leg["outcome"] = "shed" \
+                    if isinstance(exc, ServeOverload) else "error"
             if isinstance(exc, ServeOverload):
                 # host-granular overload cascade: remember this host's
                 # promise, try the next live sibling; only when every

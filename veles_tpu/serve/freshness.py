@@ -610,7 +610,9 @@ class FreshnessController(Logger):
         or fail what the client receives."""
         if self._rng.random() >= self.mirror_fraction:
             return
-        shadow = self.pool.cutover.shadow(numpy.array(sample, copy=True))
+        shadow = self.pool.cutover.shadow(
+            numpy.array(sample, copy=True),
+            trace=getattr(primary_req, "trace", None))
         if shadow is not None:
             self._pairs.append((primary_req, shadow))
 

@@ -43,7 +43,8 @@ __all__ = [
     "SLO_CLASSES", "DEFAULT_CLASS", "SHED_ORDER", "normalize_class",
     "class_rank", "TokenBucket", "TenantQuota", "parse_quota_spec",
     "RetryJitter", "HedgeBudget", "note_request", "note_shed",
-    "note_latency", "tenant_snapshot",
+    "note_latency", "tenant_snapshot", "DEFAULT_SLO_BUDGETS_S",
+    "slo_budget_s",
 ]
 
 #: SLO classes, most- to least-important.  The taxonomy mirrors the
@@ -237,6 +238,27 @@ class RetryJitter(object):
             ("%d:%s:%d" % (self.seed, cls, n)).encode()).digest()
         frac = int.from_bytes(digest[:8], "big") / float(1 << 64)
         return float(base) * (1.0 + frac * self.spread)
+
+
+#: Default per-class end-to-end latency budgets in SECONDS, measured
+#: from the ORIGINAL front-door arrival (requeues/hedges never restart
+#: the clock).  This is the tail-exemplar trigger (observe/requests.py):
+#: a non-shadow request past its class budget keeps its full segment
+#: timeline in the bounded exemplar ring, dumped with the flight
+#: recorder on ``serve.slo_violation``.  Deliberately loose defaults —
+#: deployments with real SLOs pass their own dict to ``slo_budget_s``.
+DEFAULT_SLO_BUDGETS_S = {
+    "interactive": 0.100,
+    "batch": 1.0,
+    "best_effort": 5.0,
+}
+
+
+def slo_budget_s(slo_class, budgets=None):
+    """The class's end-to-end latency budget in seconds (None when the
+    class has no budget configured)."""
+    budgets = DEFAULT_SLO_BUDGETS_S if budgets is None else budgets
+    return budgets.get(normalize_class(slo_class))
 
 
 #: Default per-class hedge budgets (tokens/second, burst).  Interactive

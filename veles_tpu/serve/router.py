@@ -282,18 +282,21 @@ class CanaryCutover(Logger):
                       rep.index, engine.digest)
             return rep
 
-    def shadow(self, sample):
+    def shadow(self, sample, trace=None):
         """Mirror one sample to the canary replica (best-effort; see
         ``ContinuousBatcher.submit_shadow``).  Returns the shadow
         request or None.  Deliberately LOCK-FREE (atomic attribute
         reads only): promote/rollback hold the state lock across
         engine compiles, and a client thread mirroring through here
         must never stall behind them — at worst a shadow lands just as
-        a verdict executes, and shadows are discardable by design."""
+        a verdict executes, and shadows are discardable by design.
+        ``trace`` tags the mirror with the PRIMARY request's trace id
+        so a merged timeline shows the shadow leg, while the shadow
+        flag keeps it out of tail exemplars and served counters."""
         rep = self.canary_replica if self.state == "canary" else None
         if rep is None:
             return None
-        return rep.batcher.submit_shadow(sample)
+        return rep.batcher.submit_shadow(sample, trace=trace)
 
     def promote(self):
         """Candidate judged healthy: roll it fleet-wide.  Live replicas
@@ -600,6 +603,14 @@ class ReplicaPool(Logger):
             rep.batcher.stop()
         self._m_depth.set(0)
 
+    def set_host_tag(self, tag):
+        """Propagate the serving host's fleet identity to every
+        replica's batcher, so request-scoped spans emitted here carry
+        ``host=<tag>`` — two in-process hosts of one test fleet stay
+        attributable after their traces are merged."""
+        for rep in self.replicas:
+            rep.batcher.set_host_tag(tag)
+
     # -- routing ------------------------------------------------------------
 
     def _update_depth(self):
@@ -657,9 +668,10 @@ class ReplicaPool(Logger):
             # every pick raced a cutover transition: re-rank and retry
         raise ServeOverload("fleet reconfiguring", retry_after=0.1)
 
-    def submit(self, sample, slo_class=None):
+    def submit(self, sample, slo_class=None, trace=None):
         req = self._submit(
-            lambda batcher: batcher.submit(sample, slo_class=slo_class))
+            lambda batcher: batcher.submit(sample, slo_class=slo_class,
+                                           trace=trace))
         hook = self.mirror_hook
         if hook is not None:
             try:
@@ -670,21 +682,24 @@ class ReplicaPool(Logger):
                 self.exception("canary mirror hook failed")
         return req
 
-    def submit_block(self, block, slo_class=None):
+    def submit_block(self, block, slo_class=None, trace=None):
         return self._submit(
             lambda batcher: batcher.submit_block(
-                block, slo_class=slo_class))
+                block, slo_class=slo_class, trace=trace))
 
-    def infer(self, sample, timeout=30.0, slo_class=None):
+    def infer(self, sample, timeout=30.0, slo_class=None, trace=None):
         """Blocking submit through the router (single sample)."""
-        return self._wait(self.submit(sample, slo_class=slo_class),
-                          timeout)
+        return self._wait(
+            self.submit(sample, slo_class=slo_class, trace=trace),
+            timeout)
 
-    def infer_block(self, block, timeout=30.0, slo_class=None):
+    def infer_block(self, block, timeout=30.0, slo_class=None,
+                    trace=None):
         """Blocking whole-batch submit (the binary transport's path):
         one request, zero row copies, result is the 2-D block."""
         return self._wait(
-            self.submit_block(block, slo_class=slo_class), timeout)
+            self.submit_block(block, slo_class=slo_class, trace=trace),
+            timeout)
 
     @staticmethod
     def _wait(req, timeout):

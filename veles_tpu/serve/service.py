@@ -25,6 +25,7 @@ histogram and a per-request ``serve.request`` span via the
 
 import json
 import threading
+import time
 
 import numpy
 
@@ -148,7 +149,8 @@ class ServeService(Logger):
 
     # -- request handling (executor thread) ---------------------------------
 
-    def infer_payload(self, sample, tenant=None, slo_class=None):
+    def infer_payload(self, sample, tenant=None, slo_class=None,
+                      trace=None):
         """Blocking inference for one payload: a single sample or a
         batch.  Batch payloads are submitted row-by-row, so their rows
         co-batch with every other in-flight request — a large payload
@@ -161,8 +163,23 @@ class ServeService(Logger):
         "Multi-tenant QoS"): the tenant's token-bucket quota is charged
         per SAMPLE here — one admission decision covers the payload —
         and the class labels every row for class-ordered shedding;
-        un-labelled legacy payloads serve as class ``batch``."""
+        un-labelled legacy payloads serve as class ``batch``.
+
+        ``trace`` is the request trace id (docs/observability.md
+        "Request tracing"): a client-supplied id is validated through
+        ``normalize_trace_id`` (bounded plain string — the trust
+        boundary is unchanged), an absent one is minted here so every
+        admitted payload is attributable; all rows of one payload
+        share the id.  The answer echoes it as ``"trace"``."""
+        from veles_tpu.observe import requests as reqtrace
         slo_class = qos.normalize_class(slo_class)
+        if reqtrace.enabled:
+            trace = reqtrace.normalize_trace_id(trace) or \
+                reqtrace.mint_trace_id()
+            t_admit = time.perf_counter()
+        else:
+            trace = None
+            t_admit = None
         x = numpy.asarray(sample, self.engine.dtype)
         if x.shape == self.engine.sample_shape:
             x = x[None]
@@ -177,8 +194,16 @@ class ServeService(Logger):
         requests = []
         try:
             for row in x:
-                requests.append(
-                    self.batcher.submit(row, slo_class=slo_class))
+                req = self.batcher.submit(row, slo_class=slo_class,
+                                          trace=trace)
+                if t_admit is not None and \
+                        getattr(req, "marks", 0) is None:
+                    # front-door admission segment (decode + quota
+                    # charge); the worker appends the queue/batch
+                    # marks behind it at completion
+                    req.marks = [("admit", t_admit,
+                                  req.enqueued - t_admit)]
+                requests.append(req)
         except Exception:
             for req in requests:
                 req.cancelled = True
@@ -197,7 +222,10 @@ class ServeService(Logger):
         # needs no stack at all — [None] is a view
         block = probs[0][None] if len(probs) == 1 \
             else numpy.stack(probs)
-        return format_result(block, self.labels_mapping)
+        answer = format_result(block, self.labels_mapping)
+        if trace is not None:
+            answer["trace"] = trace
+        return answer
 
     # -- snapshot hot-reload ------------------------------------------------
 
@@ -278,13 +306,18 @@ class ServeService(Logger):
                     self.request.headers.get("X-Tenant")
                 slo_class = body.get("slo_class") or \
                     self.request.headers.get("X-SLO-Class")
+                # request trace id (docs/observability.md "Request
+                # tracing"): body field wins over header; invalid or
+                # absent ids are re-minted inside infer_payload
+                trace = body.get("trace") or \
+                    self.request.headers.get("X-Trace-Id")
                 loop = asyncio.get_event_loop()
                 try:
                     answer = await loop.run_in_executor(
                         svc._executor,
                         lambda: svc.infer_payload(
                             payload, tenant=tenant,
-                            slo_class=slo_class))
+                            slo_class=slo_class, trace=trace))
                 except ServeOverload as exc:
                     # the blacklist protocol's transient-reject shape
                     self.set_status(503)

@@ -24,14 +24,17 @@ connection in order):
 ===========  ==========================================================
 frame        JSON header + payload
 ===========  ==========================================================
-hello  ->    ``{"op": "hello", "mid", "shm"?, "shm_reply"?}``
+hello  ->    ``{"op": "hello", "mid", "shm"?, "shm_reply"?,
+             "trace"?: true}``
 hello  <-    ``{"op": "hello", "mid", "digest", "dtype",
              "sample_shape", "max_batch", "shm_ok",
              "shm_reply_ok"}``
 infer  ->    ``{"op": "infer", "id", "dtype", "shape", "codec",
-             "shm"?: [off, len]}`` + raw tensor bytes (inline or shm)
+             "shm"?: [off, len], "trace"?: str}`` + raw tensor bytes
+             (inline or shm)
 result <-    ``{"op": "result", "id", "dtype", "shape", "codec",
-             "shm"?: [off, len]}`` + raw tensor bytes
+             "shm"?: [off, len], "trace"?, "segs"?}`` + raw tensor
+             bytes
 error  <-    ``{"op": "error", "id", "error", "transient"?,
              "retry_after"?}``
 ping/bye     liveness / clean shutdown
@@ -85,6 +88,7 @@ from veles_tpu.logger import Logger
 from veles_tpu.network_common import (
     ProtocolError, ShmChannel, default_secret, get_codec, machine_id,
     pack_frame, read_frame, read_frame_sync, write_frame)
+from veles_tpu.observe import requests as reqtrace
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
 from veles_tpu.serve import qos
@@ -262,6 +266,16 @@ class BinaryTransportServer(Logger):
         self._m_shm_tx = _registry.counter(
             "serve.transport.shm_tx_bytes")
         self._m_latency = _registry.histogram("transport.request_s")
+        # transport-owned request segments (observe/requests.py
+        # taxonomy): frame decode, admission, reply encode+write
+        self._h_wire_rx = _registry.histogram("serve.segment.wire_rx_s")
+        self._h_wire_tx = _registry.histogram("serve.segment.wire_tx_s")
+        self._h_admit = _registry.histogram("serve.segment.admit_s")
+        if self.host_meta and hasattr(pool, "set_host_tag"):
+            # leg attribution: request spans emitted by this host's
+            # batchers carry the fleet host id, so merged cross-host
+            # timelines can name the slow leg
+            pool.set_host_tag(self.host_meta.get("host_id"))
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -411,6 +425,11 @@ class BinaryTransportServer(Logger):
             # un-labelled legacy clients fall through to class "batch"
             conn_tenant = hello.get("tenant")
             conn_class = hello.get("slo_class")
+            # connection-default request tracing: a truthy hello
+            # "trace" asks the server to mint an id for every frame
+            # that does not carry its own (fleet links send explicit
+            # per-frame ids instead)
+            conn_trace = bool(hello.get("trace"))
             reply = {
                 "op": "hello", "mid": machine_id(),
                 "digest": engine.digest,
@@ -453,7 +472,8 @@ class BinaryTransportServer(Logger):
             if pipelined:
                 await self._handle_pipelined(reader, writer,
                                              tenant=conn_tenant,
-                                             slo_class=conn_class)
+                                             slo_class=conn_class,
+                                             trace_default=conn_trace)
                 return
             while True:
                 try:
@@ -479,7 +499,8 @@ class BinaryTransportServer(Logger):
                 # two-slot shm layout race-free
                 await self._serve_one(msg, payload, chan_in, chan_out,
                                       writer, tenant=conn_tenant,
-                                      slo_class=conn_class)
+                                      slo_class=conn_class,
+                                      trace_default=conn_trace)
         except ProtocolError as exc:
             self._m_errors.inc()
             self.debug("transport protocol error: %s", exc)
@@ -495,7 +516,7 @@ class BinaryTransportServer(Logger):
                 pass
 
     async def _handle_pipelined(self, reader, writer, tenant=None,
-                                slo_class=None):
+                                slo_class=None, trace_default=False):
         """The fleet-link loop: every ``infer`` frame becomes its own
         task (replies out of order, matched by id), ``cancel`` frames
         retire in-flight scopes, and frame WRITES are serialized by
@@ -512,7 +533,8 @@ class BinaryTransportServer(Logger):
                 await self._serve_one(msg, payload, None, None, writer,
                                       write_lock=write_lock,
                                       scope=scope, tenant=tenant,
-                                      slo_class=slo_class)
+                                      slo_class=slo_class,
+                                      trace_default=trace_default)
             except (ConnectionError, OSError):
                 # chaos sever / peer gone: drop the whole connection
                 try:
@@ -594,7 +616,8 @@ class BinaryTransportServer(Logger):
 
     async def _serve_one(self, msg, payload, chan_in, chan_out,
                          writer, write_lock=None, scope=None,
-                         tenant=None, slo_class=None):
+                         tenant=None, slo_class=None,
+                         trace_default=False):
         start = time.perf_counter()
         rid = msg.get("id")
         self._m_requests.inc()
@@ -602,6 +625,16 @@ class BinaryTransportServer(Logger):
         tenant = msg.get("tenant", tenant)
         slo_class = qos.normalize_class(msg.get("slo_class", slo_class))
         shadow = bool(msg.get("shadow"))
+        # request trace id: per-frame id (validated — plain bounded
+        # string, the never-unpickle trust boundary is unchanged) wins;
+        # the hello's trace default mints one per frame for clients
+        # that opted in without supplying ids
+        trace = None
+        if reqtrace.enabled:
+            trace = reqtrace.normalize_trace_id(msg.get("trace"))
+            if trace is None and (trace_default or
+                                  msg.get("trace") is True):
+                trace = reqtrace.mint_trace_id()
 
         async def reply_frame(frame, raw=b""):
             if write_lock is None:
@@ -632,6 +665,7 @@ class BinaryTransportServer(Logger):
             stall = self._fire_host_chaos()
             if stall:
                 await asyncio.sleep(stall)
+            t_rx = time.perf_counter()
             if "shm" in msg:
                 if chan_in is None:
                     raise ProtocolError(
@@ -643,16 +677,39 @@ class BinaryTransportServer(Logger):
                 raw = payload
                 self._m_sock_rx.inc(len(raw))
             arr = decode_tensor(msg, raw)
+            wire_rx = time.perf_counter() - t_rx
+            if trace is not None:
+                # admit covers quota + chaos gating (start -> decode
+                # begin); wire_rx the frame decode — kept sequential so
+                # the request track nests cleanly
+                self._h_admit.observe(t_rx - start)
+                self._h_wire_rx.observe(wire_rx)
             loop = asyncio.get_event_loop()
-            result = await loop.run_in_executor(
+            result, reqs = await loop.run_in_executor(
                 self._executor, self._infer, arr, scope, slo_class,
-                shadow)
+                shadow, trace, [("admit", start, t_rx - start),
+                                ("wire_rx", t_rx, wire_rx)]
+                if trace is not None else None)
             if scope is not None and scope.cancelled:
                 return  # hedged loser: the peer forgot this copy
+            t_tx = time.perf_counter()
             meta, raw_out = encode_tensor(
                 result, codec=str(msg.get("codec", "none")))
             reply = {"op": "result", "id": rid}
             reply.update(meta)
+            if trace is not None:
+                # echo the id + the aggregated per-segment seconds so
+                # a fleet front (or any client) can attribute this
+                # leg's time without a trace file round-trip — plain
+                # bounded JSON values only
+                reply["trace"] = trace
+                segs = {}
+                for req in reqs:
+                    for name, _, dur in (req.marks or ()):
+                        segs[name] = segs.get(name, 0.0) + max(0.0, dur)
+                if segs:
+                    reply["segs"] = {name: round(dur, 6)
+                                     for name, dur in segs.items()}
             if chan_out is not None:
                 slot = None
                 try:
@@ -666,6 +723,8 @@ class BinaryTransportServer(Logger):
             if raw_out:
                 self._m_sock_tx.inc(len(raw_out))
             await reply_frame(reply, raw_out)
+            if trace is not None:
+                self._h_wire_tx.observe(time.perf_counter() - t_tx)
         except _CancelledByPeer:
             return  # no reply: cancelled requests answer with nothing
         except ServeOverload as exc:
@@ -690,20 +749,26 @@ class BinaryTransportServer(Logger):
             elapsed = time.perf_counter() - start
             self._m_latency.observe(elapsed)
             if _tracer.active:
+                args = {"trace": trace} if trace is not None else None
                 _tracer.complete("transport.request", start, elapsed,
-                                 cat="serve")
+                                 cat="serve", args=args)
 
-    def _infer(self, arr, scope=None, slo_class=None, shadow=False):
+    def _infer(self, arr, scope=None, slo_class=None, shadow=False,
+               trace=None, marks_prefix=None):
         """Blocking dispatch (executor thread): single samples ride
         :meth:`submit`, contiguous blocks ride :meth:`submit_block` —
         the zero-intermediate-copy path — chunked at the ladder top.
-        Always returns a 2-D block.  ``scope`` (pipelined mode)
-        registers every batcher request so a wire cancel can retire
-        them mid-flight instead of computing for a departed peer.
-        ``shadow`` frames (canary mirrors from a fleet front) ride
-        :meth:`submit_shadow` so they are excluded from the served and
-        tenant counters; a dropped shadow answers with a transient
-        error — lost evidence, never a failed request."""
+        Returns ``(block, requests)`` — the 2-D result plus the
+        batcher requests it rode, so the caller can echo their segment
+        timelines.  ``scope`` (pipelined mode) registers every batcher
+        request so a wire cancel can retire them mid-flight instead of
+        computing for a departed peer.  ``shadow`` frames (canary
+        mirrors from a fleet front) ride :meth:`submit_shadow` so they
+        are excluded from the served and tenant counters; a dropped
+        shadow answers with a transient error — lost evidence, never a
+        failed request.  ``trace`` labels every request of the frame;
+        ``marks_prefix`` (wire_rx/admit marks stamped by the IO side)
+        is prepended to the first request's timeline."""
         engine = self.pool.engine
         shape = engine.sample_shape
         track = scope.add if scope is not None else (lambda req: req)
@@ -712,7 +777,7 @@ class BinaryTransportServer(Logger):
                 raise ValueError(
                     "shadow frames mirror single samples only, got %s"
                     % (arr.shape,))
-            req = self.pool.submit_shadow(arr)
+            req = self.pool.submit_shadow(arr, trace=trace)
             if req is None:
                 raise ServeOverload(
                     "shadow mirror dropped (host loaded)",
@@ -720,7 +785,8 @@ class BinaryTransportServer(Logger):
             requests, single = [track(req)], True
         elif arr.shape == shape:
             requests = [track(self.pool.submit(arr,
-                                               slo_class=slo_class))]
+                                               slo_class=slo_class,
+                                               trace=trace))]
             single = True
         elif arr.shape[1:] == shape and arr.ndim == len(shape) + 1 \
                 and arr.shape[0] >= 1:
@@ -730,7 +796,7 @@ class BinaryTransportServer(Logger):
                 for i in range(0, arr.shape[0], engine.max_batch):
                     requests.append(track(self.pool.submit_block(
                         arr[i:i + engine.max_batch],
-                        slo_class=slo_class)))
+                        slo_class=slo_class, trace=trace)))
             except Exception:
                 for req in requests:
                     req.cancelled = True
@@ -738,6 +804,11 @@ class BinaryTransportServer(Logger):
         else:
             raise ValueError("expected sample shape %s or a batch of "
                              "them, got %s" % (shape, arr.shape))
+        if marks_prefix and \
+                getattr(requests[0], "marks", None) is None:
+            # best-effort: the worker may already have completed the
+            # request, in which case the wire marks stay histogram-only
+            requests[0].marks = list(marks_prefix)
         rows = []
         try:
             for req in requests:
@@ -756,8 +827,9 @@ class BinaryTransportServer(Logger):
                     req.cancelled = True
             raise
         if single:
-            return rows[0][None]
-        return rows[0] if len(rows) == 1 else numpy.concatenate(rows)
+            return rows[0][None], requests
+        return (rows[0] if len(rows) == 1
+                else numpy.concatenate(rows)), requests
 
 
 class BinaryTransportClient(object):
@@ -774,13 +846,21 @@ class BinaryTransportClient(object):
 
     def __init__(self, host="127.0.0.1", port=None, sock=None,
                  secret=None, shm=True, shm_slot_mb=4.0, codec="none",
-                 timeout=30.0, tenant=None, slo_class=None):
+                 timeout=30.0, tenant=None, slo_class=None,
+                 trace=False):
         #: QoS identity stamped into the hello as this connection's
         #: default (every frame inherits it server-side; per-call
         #: overrides ride infer(..., slo_class=...)).  None = legacy
         #: un-labelled client, served as class "batch"
         self.tenant = tenant
         self.slo_class = slo_class
+        #: request tracing opt-in: a truthy hello "trace" makes the
+        #: server mint an id per frame; per-call ids override via
+        #: infer(..., trace="...").  The reply's id + per-segment
+        #: breakdown land in :attr:`last_trace` / :attr:`last_segments`
+        self.trace = bool(trace)
+        self.last_trace = None
+        self.last_segments = None
         if sock is None:
             sock = _socketmod.create_connection((host, port), timeout)
         else:
@@ -803,6 +883,8 @@ class BinaryTransportClient(object):
             hello["tenant"] = tenant
         if slo_class is not None:
             hello["slo_class"] = slo_class
+        if self.trace:
+            hello["trace"] = True
         if shm:
             # the client creates BOTH segments (it owns size and
             # lifetime; the server only attaches what it acks), so
@@ -862,12 +944,15 @@ class BinaryTransportClient(object):
     def shm_active(self):
         return self._chan_out is not None
 
-    def infer(self, x, slo_class=None, tenant=None):
+    def infer(self, x, slo_class=None, tenant=None, trace=None):
         """One tensor round-trip: a sample or a contiguous batch in,
         the probability block out (numpy).  Overload answers raise
         :class:`ServeOverload` with the server's ``retry_after``.
         ``slo_class``/``tenant`` override this connection's hello
-        default for one request."""
+        default for one request; ``trace`` carries an explicit request
+        trace id (the hello's ``trace=True`` default mints one
+        server-side instead).  The reply's id and per-segment seconds
+        are kept in :attr:`last_trace`/:attr:`last_segments`."""
         with self._lock:
             meta, raw = encode_tensor(x, self.codec)
             rid = self._next_id
@@ -877,6 +962,8 @@ class BinaryTransportClient(object):
                 msg["slo_class"] = slo_class
             if tenant is not None:
                 msg["tenant"] = tenant
+            if trace is not None:
+                msg["trace"] = trace
             msg.update(meta)
             payload = raw
             if self._chan_out is not None:
@@ -905,6 +992,8 @@ class BinaryTransportClient(object):
                 raise RuntimeError(reply.get("error", "serve error"))
             if reply.get("op") != "result" or reply.get("id") != rid:
                 raise ProtocolError("unexpected reply %r" % reply)
+            self.last_trace = reply.get("trace")
+            self.last_segments = reply.get("segs")
             if "shm" in reply and self._chan_in is not None:
                 offset, length = (int(v) for v in reply["shm"])
                 rraw = self._chan_in.read(offset, length)

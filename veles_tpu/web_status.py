@@ -393,6 +393,19 @@ class WebStatusServer(Logger):
                 value = s.get(k)
                 if value is None:
                     return ""
+                if k == "serve" and isinstance(value, dict) and \
+                        isinstance(value.get("segments"), dict):
+                    # per-request-segment breakdown (docs/
+                    # observability.md "Request tracing"): fold the
+                    # histogram block into one p99-per-segment line so
+                    # the cell answers "where does the time go" at a
+                    # glance
+                    value = dict(value)
+                    segments = value.pop("segments")
+                    value["segments_p99_ms"] = {
+                        name: row.get("p99_ms")
+                        for name, row in segments.items()}
+                    return json.dumps(value)
                 if k in ("metrics", "health", "serve", "fleet"):
                     return json.dumps(value)
                 return str(value)
