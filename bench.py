@@ -41,6 +41,7 @@ the list of sections shed to fit the deadline.
 import functools
 import json
 import os
+import sys
 import threading
 import time
 
@@ -112,6 +113,10 @@ SECTION_EST = {
     # tracing"): one small AOT ladder + interleaved closed-loop legs
     # with the per-request segment stamps on vs VELES_REQTRACE=0
     "trace_overhead": 30.0,
+    # fleet-telemetry-plane overhead A/B (docs/observability.md
+    # "Fleet telemetry"): the same small serve harness with a series
+    # ring ticking + the default alert rules sweeping vs fully off
+    "telemetry_overhead": 25.0,
     # elastic-mesh reshard A/B (docs/distributed.md "Elastic mesh
     # contract"): two ZeRO-1 compiles (initial + cold shrink; the
     # grow-back is the compile-cache hit under test) + 4 small steps
@@ -210,6 +215,9 @@ def _compact_record(value, small, extras):
     reqtrace = extras.get("trace_overhead") or {}
     if reqtrace.get("trace_overhead_pct") is not None:
         rec["trace_overhead_pct"] = reqtrace["trace_overhead_pct"]
+    tele = extras.get("telemetry_overhead") or {}
+    if tele.get("telemetry_overhead_pct") is not None:
+        rec["telemetry_overhead_pct"] = tele["telemetry_overhead_pct"]
     reshard = extras.get("reshard_ab") or {}
     if reshard.get("reshard_bytes_saved_pct") is not None:
         rec["reshard_bytes_saved"] = reshard["reshard_bytes_saved_pct"]
@@ -1750,6 +1758,119 @@ def bench_trace_overhead(small):
     }
 
 
+def bench_telemetry_overhead(small):
+    """Fleet-telemetry-plane overhead A/B (docs/observability.md
+    "Fleet telemetry"): the SAME continuously-batched serve knee with
+    the telemetry plane running hot — a private series ring ticking at
+    50 ms (40x the shipping 2 s poll cadence) with the default alert
+    rules sweeping every closed bucket — vs fully off, interleaved
+    passes.  One tick is a registry scan + dict folds and one alert
+    sweep is a handful of digest merges per rule, all on a side
+    thread, so the gate is <= 1% rps: if this A/B ever reports more,
+    the rollup/alert-eval path regressed."""
+    import threading as _threading
+
+    from veles_tpu.backends import Device
+    from veles_tpu.compiler import LayerPlan
+    from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.observe.alerts import AlertManager, default_rules
+    from veles_tpu.observe.timeseries import SeriesRing
+    from veles_tpu.serve import AOTEngine, ContinuousBatcher
+
+    fan_in, hidden, classes = (196, 64, 10) if small else (784, 256, 10)
+    rng = numpy.random.RandomState(11)
+    plans = [LayerPlan(All2AllTanh), LayerPlan(All2AllSoftmax)]
+    params = [
+        {"weights": rng.rand(fan_in, hidden).astype(numpy.float32),
+         "bias": numpy.zeros(hidden, numpy.float32)},
+        {"weights": rng.rand(hidden, classes).astype(numpy.float32),
+         "bias": numpy.zeros(classes, numpy.float32)},
+    ]
+    ladder = (1, 8, 32) if small else (1, 8, 32, 128)
+    engine = AOTEngine(plans, params, (fan_in,), ladder=ladder,
+                       device=Device())
+    engine.compile()
+    samples = rng.rand(256, fan_in).astype(numpy.float32)
+    duration = 0.5 if small else 1.0
+    clients = 8 if small else 32
+    batcher = ContinuousBatcher(engine, max_delay_s=0.002).start()
+
+    def leg(telemetry_on):
+        stop = _threading.Event()
+        worker = None
+        if telemetry_on:
+            ring = SeriesRing(interval_s=0.05)
+            manager = AlertManager(default_rules())
+
+            def sweep():
+                while not stop.wait(0.01):
+                    # dump=False: a (never-expected) firing must cost
+                    # an eval, not a flight-recorder file write
+                    if ring.maybe_tick() is not None:
+                        manager.evaluate(ring.buckets(last=32),
+                                         dump=False)
+
+            worker = _threading.Thread(target=sweep, daemon=True)
+            worker.start()
+        done, lock = [0], _threading.Lock()
+        stop_at = time.perf_counter() + duration
+
+        def client(k):
+            n = 0
+            while time.perf_counter() < stop_at:
+                batcher.infer(
+                    samples[(k * 37 + n) % len(samples)],
+                    timeout=30.0)
+                n += 1
+            with lock:
+                done[0] += n
+
+        threads = [_threading.Thread(target=client, args=(k,))
+                   for k in range(clients)]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        stop.set()
+        if worker is not None:
+            worker.join(timeout=5)
+        return done[0] / elapsed
+
+    passes = 5
+    rps = {"off": [], "on": []}
+    try:
+        leg(False)  # warm the ladder + thread pool
+        for _ in range(passes):
+            for mode in ("off", "on"):
+                rps[mode].append(leg(mode == "on"))
+    finally:
+        batcher.stop()
+
+    def median(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    # per-PASS paired deltas, then the median (the hedge_ab
+    # discipline): closed-loop rps drifts minute to minute on a
+    # shared host, and pairing each on leg with its adjacent off leg
+    # cancels the drift a median-of-legs comparison would publish as
+    # overhead
+    pcts = [100.0 * (off - on) / max(off, 1e-9)
+            for off, on in zip(rps["off"], rps["on"])]
+    pct = median(pcts)
+    return {
+        "clients": clients,
+        "passes": passes,
+        "rps_telemetry_off": round(median(rps["off"]), 1),
+        "rps_telemetry_on": round(median(rps["on"]), 1),
+        "pass_overhead_pcts": [round(p, 2) for p in pcts],
+        "telemetry_overhead_pct": round(pct, 2),
+        "gate_pct": 1.0,
+        "within_gate": pct <= 1.0,
+    }
+
+
 def bench_hedge_ab(small):
     """Multi-host hedging A/B (docs/serving.md "Multi-host tier"):
     closed-loop p50/p95/p99 through a :class:`FleetRouter` over two
@@ -2299,6 +2420,14 @@ def main():
     if reqtrace_res is not None:
         extras["trace_overhead"] = reqtrace_res
 
+    # fleet-telemetry-plane overhead A/B (docs/observability.md
+    # "Fleet telemetry"): serve rps with a hot series ring + default
+    # alert rules sweeping vs off — the <= 1% gate on the plane's cost
+    tele_res = section("telemetry_overhead",
+                       lambda: bench_telemetry_overhead(small))
+    if tele_res is not None:
+        extras["telemetry_overhead"] = tele_res
+
     # elastic-mesh reshard A/B (docs/distributed.md "Elastic mesh
     # contract"): time-to-recover + bytes moved for a consistent-hash
     # live reshard vs the full-gather baseline, cold and warm legs
@@ -2427,7 +2556,49 @@ def main():
         except Exception as exc:
             print("trace digest unavailable: %s" % exc, flush=True)
     emit()
+    return _compact_record(result["value"], small, extras)
+
+
+def _load_record(path):
+    """The last machine-readable JSON object in ``path``: a plain
+    record file parses whole; a captured bench log falls back to the
+    newest parseable line (the compact record is always last)."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        record = json.loads(text)
+        if isinstance(record, dict):
+            return record
+    except ValueError:
+        pass
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(record, dict):
+            return record
+    raise BenchError("no JSON record found in %s" % path)
+
+
+def _gate_main(argv):
+    """``bench.py --gate [record.json]``: hold a compact bench record
+    (given, or freshly measured when omitted) against the committed
+    PERF_BASELINE.json via the perf-regression sentinel.  Exit 1 on a
+    regression — for CI lanes that opt in, never for tier-1."""
+    from veles_tpu.observe import baseline as _baseline
+    paths = [a for a in argv[1:] if a != "--gate"]
+    record = _load_record(paths[0]) if paths else main()
+    ok, report = _baseline.gate(record)
+    for line in _baseline.render_report(report):
+        print(line, flush=True)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
+    if "--gate" in sys.argv:
+        sys.exit(_gate_main(sys.argv))
     main()

@@ -20,9 +20,14 @@ Usage::
 
     python scripts/qos_soak.py --out QOS.json          # full
     python scripts/qos_soak.py --fast --out /tmp/Q.json  # smoke
+    python scripts/qos_soak.py --alerts --out ALERTS.json  # alerting
 
 The fast profile is the slow-marked test in tests/test_qos.py; the
-full profile is the committed QOS.json receipt.
+full profile is the committed QOS.json receipt.  ``--alerts`` runs
+the burn-rate alerting soak instead (``fleet_soak.run_alert_soak``
+-> ALERTS.json): the steady leg must fire zero alerts, the stall-
+chaos leg must fire the fleet-scope SLO burn pair with its flight-
+recorder + tail-exemplar dump.
 """
 
 import os

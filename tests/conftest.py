@@ -123,6 +123,23 @@ def _exemplar_ring_reset():
 
 
 @pytest.fixture(autouse=True)
+def _telemetry_plane_reset():
+    """The global series ring (observe/timeseries.py) and alert
+    manager (observe/alerts.py) are process singletons fed by every
+    metrics tick and rule sweep — one test's closed buckets or
+    edge-triggered firing state must never leak into another's
+    rollup, burn-rate, or zero-alerts assertions."""
+    import sys
+    yield
+    ts_mod = sys.modules.get("veles_tpu.observe.timeseries")
+    if ts_mod is not None:
+        ts_mod.series.clear()
+    al_mod = sys.modules.get("veles_tpu.observe.alerts")
+    if al_mod is not None:
+        al_mod.alerts.clear()
+
+
+@pytest.fixture(autouse=True)
 def _calibration_to_tmp(tmp_path, monkeypatch):
     """The post-training quantization pass writes a calibration
     sidecar JSON on every quantize (veles_tpu/quant/ptq.py) — those

@@ -510,7 +510,14 @@ def test_drop_during_apply_defers_requeue_never_doubles():
         conn = list(server.slaves.values())[0]
         # the slave is dropped while the apply is still on the executor
         server._loop.call_soon_threadsafe(server._drop, conn, "test")
-        _wait_for(lambda: conn.dropped, what="drop flag")
+        # _drop flips conn.dropped BEFORE it registers the deferral
+        # (the flag is the stale-update fence and must come first) —
+        # wait on the deferral itself, the state the assertions read,
+        # not the flag; the sleep is then purely the negative window
+        # for a wrong requeue to surface
+        _wait_for(lambda: conn.slave.id in server._deferred_drops,
+                  what="deferred drop registered")
+        assert conn.dropped
         time.sleep(0.3)
         assert master.drops == [], \
             "requeue must be DEFERRED while the update is mid-apply"
@@ -677,7 +684,12 @@ def test_owner_drop_during_backup_apply_defers_requeue():
         # the owner's reservation
         server._loop.call_soon_threadsafe(server._drop, a_conn,
                                           "owner-timeout")
-        _wait_for(lambda: a_conn.dropped, what="owner drop flag")
+        # same discipline as the drop-during-apply test above: the
+        # dropped flag precedes the deferral registration, so wait on
+        # the registration the assertions read
+        _wait_for(lambda: a_sid in server._deferred_drops,
+                  what="deferred owner drop registered")
+        assert a_conn.dropped
         time.sleep(0.3)
         assert master.drops == [], \
             "the owner's requeue must defer on the apply target"

@@ -96,6 +96,7 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
         self.clock_offset = None
         self.clock_delay = None
         self.trace_chunks_sent = 0
+        self.series_chunks_sent = 0
         self._mid = "%s:%d" % (os.uname().nodename, os.getpid())
         self._trace_tracer = tracer if tracer is not None else _tracer
         # "process": ship every recorded event (one-process-per-role
@@ -305,6 +306,7 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             await self._job_loop(reader, writer)
         finally:
             self._ship_trace_chunk(writer, final=True)
+            self._ship_series_chunk(writer, final=True)
             self._close_shm()
             writer.close()
 
@@ -377,6 +379,31 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             self.trace_chunks_sent += 1
         except Exception as exc:
             self.debug("trace chunk shipping failed: %s", exc)
+
+    def _ship_series_chunk(self, writer, final=False):
+        """Ship new telemetry buckets (observe/timeseries.py) to the
+        master over the same inline path as trace chunks.  Gated on
+        the same capability signal (a trace id in the ack) so stub or
+        older masters never see a frame they would misparse; ticks
+        the process ring first so slaves without a Heartbeat still
+        bucketize at update cadence.  Never raises into the job
+        cycle."""
+        if not self._handshaken or self.trace_id is None:
+            return
+        try:
+            from veles_tpu.observe.timeseries import series
+            series.maybe_tick()
+            if final:
+                series.tick()  # flush the partial tail bucket
+            chunk = series.take_chunk(label="slave:" + self._mid)
+            if chunk is None:
+                return
+            self._send(writer, {"type": "series_chunk",
+                                "codec": self.codec}, payload=chunk,
+                       use_shm=False)
+            self.series_chunks_sent += 1
+        except Exception as exc:
+            self.debug("series chunk shipping failed: %s", exc)
 
     async def _job_loop(self, reader, writer):
         self._send(writer, {"type": "job_request"})
@@ -477,9 +504,11 @@ class Client(Logger, metaclass=CommandLineArgumentsRegistry):
             _registry.counter("client.jobs_done").inc()
             _tracer.instant("proto.update_out", cat="proto", job=job8,
                             trace=str(self.trace_id or "")[:8])
-            # trace chunks ride back WITH the update cadence: bounded,
-            # so a chatty tracer never starves the data plane
+            # trace + telemetry chunks ride back WITH the update
+            # cadence: bounded, so a chatty tracer never starves the
+            # data plane
             self._ship_trace_chunk(writer)
+            self._ship_series_chunk(writer)
             if not self.async_slave:
                 self._send(writer, {"type": "job_request"})
 

@@ -57,6 +57,11 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.profile_dir = kwargs.get(
             "profile", obs.get("profile", "")) or \
             os.environ.get("VELES_PROFILE", "")
+        # --alerts: install the stock serve/train alert rule set on
+        # the process-global manager; the heartbeat then evaluates it
+        # every interval (docs/observability.md "Fleet telemetry")
+        self.alerts_enabled = bool(kwargs.get(
+            "alerts", obs.get("alerts", False)))
         self._workflow = None
         self.device = None
         self.stopped = False
@@ -94,6 +99,12 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             help="heartbeat JSONL destination (default: <trace>."
                  "heartbeat.jsonl next to --trace, else "
                  "veles_heartbeat.jsonl)")
+        parser.add_argument(
+            "--alerts", action="store_true", default=False,
+            help="arm the stock burn-rate + anomaly alert rules "
+                 "(observe/alerts.py) on this process; evaluated at "
+                 "the --metrics-interval heartbeat cadence, firings "
+                 "dump the flight recorder and land in /healthz")
         parser.add_argument(
             "--profile", default="", metavar="DIR",
             help="capture a jax.profiler trace into DIR around a "
@@ -133,6 +144,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "metrics_interval": getattr(args, "metrics_interval", 0),
             "metrics_path": getattr(args, "metrics_path", ""),
             "profile": getattr(args, "profile", ""),
+            "alerts": getattr(args, "alerts", False),
         })
         train_cfg = {}
         if getattr(args, "grad_bucket_mb", None) is not None:
@@ -357,6 +369,17 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         if self.profile_dir:
             observe.install_profiler(
                 observe.ProfilerHook(self.profile_dir))
+        if self.alerts_enabled:
+            from veles_tpu.observe.alerts import alerts, default_rules
+            if not alerts.rules:
+                alerts.configure([r.spec() for r in default_rules()])
+            self.info("alerting armed: %d rules (%s)",
+                      len(alerts.rules),
+                      ", ".join(r.name for r in alerts.rules))
+            if self.metrics_interval <= 0:
+                self.warning("--alerts without --metrics-interval: "
+                             "rules are armed but nothing evaluates "
+                             "them (the heartbeat is the evaluator)")
         if self.metrics_interval > 0:
             path = self.metrics_path or (
                 self.trace_path + ".heartbeat.jsonl"

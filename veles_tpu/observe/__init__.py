@@ -42,11 +42,32 @@ The serve tier (PR 19) adds:
   SLO violations, and the ``python -m veles_tpu.observe requests``
   critical-path analyzer.
 
+The fleet telemetry plane (PR 20) adds the decisions layer:
+
+- :mod:`veles_tpu.observe.timeseries` — fixed-interval bucket rings
+  fed from the registry (counter->rate, gauge->last, histogram->
+  mergeable digest), shipped as bounded chunks over the trace-chunk
+  links, merged fleet-side with the PR 5 clock offsets
+  (``FleetTelemetry``; ``python -m veles_tpu.observe fleet``);
+- :mod:`veles_tpu.observe.alerts` — declarative multi-window
+  burn-rate + EMA-spike alert rules over those series,
+  edge-triggered with flight + exemplar evidence dumps;
+- :mod:`veles_tpu.observe.baseline` — the perf-regression sentinel:
+  bench compact records + steady-state rates vs the committed
+  ``PERF_BASELINE.json`` (``bench.py --gate``; ``python -m
+  veles_tpu.observe regress``).
+
 Everything here is stdlib-only and import-light, so hot modules
 (units, pipeline_input, compiler-adjacent code) can import it without
 dragging in jax.
 """
 
+from veles_tpu.observe.alerts import (ALERTS_SCHEMA_VERSION,
+                                      AlertManager, BurnRateRule,
+                                      EmaSpikeRule, alerts,
+                                      default_rules)
+from veles_tpu.observe.baseline import (gate, load_baseline,
+                                        steady_state_rates)
 from veles_tpu.observe.cluster import (TraceCollector, estimate_offset,
                                        probe_sample)
 from veles_tpu.observe.flight import (FLIGHT_SCHEMA_VERSION,
@@ -63,6 +84,12 @@ from veles_tpu.observe.requests import (ExemplarRing, analyze_files,
                                         exemplars, mint_trace_id,
                                         normalize_trace_id,
                                         render_requests)
+from veles_tpu.observe.timeseries import (SERIES_SCHEMA_VERSION,
+                                          FleetTelemetry, SeriesRing,
+                                          digest_percentiles,
+                                          digest_values,
+                                          fleet_summary,
+                                          merge_digests, series)
 from veles_tpu.observe.trace import (CHUNK_SCHEMA_VERSION, SpanTracer,
                                      instant, span, traced, tracer,
                                      validate_trace)
@@ -80,4 +107,10 @@ __all__ = [
     "TraceCollector", "estimate_offset", "probe_sample",
     "ExemplarRing", "exemplars", "mint_trace_id",
     "normalize_trace_id", "analyze_files", "render_requests",
+    "SeriesRing", "FleetTelemetry", "series", "fleet_summary",
+    "digest_values", "merge_digests", "digest_percentiles",
+    "SERIES_SCHEMA_VERSION",
+    "AlertManager", "BurnRateRule", "EmaSpikeRule", "alerts",
+    "default_rules", "ALERTS_SCHEMA_VERSION",
+    "gate", "load_baseline", "steady_state_rates",
 ]

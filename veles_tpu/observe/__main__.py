@@ -16,6 +16,15 @@ Commands:
   Trace files are offset-stitched like ``merge`` first, so one hedged
   request's legs on two hosts fold under one id (docs/observability.md
   "Request tracing").
+- ``fleet series0.json series1.json [--offset label=secs] [--rules]``
+  — merge per-host telemetry series snapshots (observe/timeseries.py)
+  into offset-corrected fleet rollups and print the per-metric table;
+  ``--rules`` evaluates the stock serve alert rules over the rollup
+  (docs/observability.md "Fleet telemetry").
+- ``regress record.json [--baseline PERF_BASELINE.json] [trace...]``
+  — the perf-regression sentinel: compare a compact bench record
+  against the committed baseline; exits 1 naming the regressed
+  metric (and the dominant tail segment when traces are given).
 """
 
 import argparse
@@ -70,6 +79,34 @@ def main(argv=None):
     pr.add_argument("--json", action="store_true",
                     help="emit the full report as JSON")
 
+    pf = sub.add_parser(
+        "fleet",
+        help="merge per-host telemetry snapshots into fleet rollups")
+    pf.add_argument("inputs", nargs="+", metavar="SERIES",
+                    help="per-host series snapshot files "
+                         "(observe/timeseries.py snapshot/take_chunk)")
+    pf.add_argument("--offset", action="append", default=[],
+                    metavar="LABEL=SECS",
+                    help="clock offset to ADD to that host's stamps")
+    pf.add_argument("--interval", type=float, default=None,
+                    help="rollup bucket width (default: the first "
+                         "snapshot's interval)")
+    pf.add_argument("--rules", action="store_true",
+                    help="evaluate the stock serve alert rules over "
+                         "the rollup")
+    pf.add_argument("--json", action="store_true")
+
+    pg = sub.add_parser(
+        "regress", help="perf-regression gate vs PERF_BASELINE.json")
+    pg.add_argument("record", metavar="RECORD_JSON",
+                    help="compact bench record (bench.py's last "
+                         "line, saved as JSON)")
+    pg.add_argument("traces", nargs="*", metavar="TRACE_OR_FLIGHT",
+                    help="optional traces/flight dumps; a failing "
+                         "gate then names the dominant tail segment")
+    pg.add_argument("--baseline", default=None)
+    pg.add_argument("--json", action="store_true")
+
     args = parser.parse_args(argv)
     if args.command == "merge":
         from veles_tpu.observe import merge
@@ -103,6 +140,84 @@ def main(argv=None):
         else:
             reqtrace.render_requests(report)
         return 0
+    if args.command == "fleet":
+        import json
+        import os
+        from veles_tpu.observe.timeseries import (FleetTelemetry,
+                                                  fleet_summary)
+        offsets = _parse_offsets(args.offset)
+        fleet = None
+        for path in args.inputs:
+            with open(path) as fh:
+                snap = json.load(fh)
+            if snap.get("kind") != "series":
+                raise SystemExit(
+                    "%s is not a series snapshot (kind=%r)"
+                    % (path, snap.get("kind")))
+            host = snap.get("label") or \
+                os.path.splitext(os.path.basename(path))[0]
+            if fleet is None:
+                fleet = FleetTelemetry(
+                    interval_s=args.interval or
+                    snap.get("interval_s") or 5.0)
+            if host in offsets:
+                fleet.set_offset(host, offsets[host])
+            if not fleet.add_chunk(host, snap):
+                print("warning: dropped malformed snapshot %s" % path,
+                      file=sys.stderr)
+        rollup = fleet.rollup()
+        summary = fleet_summary(rollup)
+        fired = []
+        if args.rules:
+            from veles_tpu.observe.alerts import (AlertManager,
+                                                  default_rules)
+            manager = AlertManager(default_rules())
+            manager.evaluate(rollup, dump=False)
+            fired = manager.history()
+        if args.json:
+            import json as _json
+            print(_json.dumps({"summary": summary, "alerts": fired,
+                               "fleet": fleet.snapshot()},
+                              indent=2, sort_keys=True))
+            return 0
+        print("fleet rollup: %d buckets from %d host(s) %s"
+              % (summary["buckets"], len(summary["hosts"]),
+                 ",".join(summary["hosts"])))
+        for name in sorted(summary["counters"]):
+            row = summary["counters"][name]
+            print("  counter %-32s total %-10s %s/s"
+                  % (name, row["delta"], row["rate"]))
+        for name in sorted(summary["gauges"]):
+            print("  gauge   %-32s max %s"
+                  % (name, summary["gauges"][name]))
+        for name in sorted(summary["hists"]):
+            row = summary["hists"][name]
+            print("  hist    %-32s n=%-7d p50 %s p95 %s p99 %s"
+                  % (name, row["count"], row.get("p50"),
+                     row.get("p95"), row.get("p99")))
+        for record in fired:
+            print("  alert   %-32s %s %s" % (
+                record["alert"], record["state"],
+                record.get("reason", "")))
+        return 0
+    if args.command == "regress":
+        import json
+        from veles_tpu.observe import baseline
+        with open(args.record) as fh:
+            record = json.load(fh)
+        analysis = None
+        if args.traces:
+            from veles_tpu.observe import requests as reqtrace
+            analysis = reqtrace.analyze_files(args.traces)
+        ok, report = baseline.gate(record,
+                                   baseline_path=args.baseline,
+                                   analysis=analysis)
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            for line in baseline.render_report(report):
+                print(line)
+        return 0 if ok else 1
     return 1
 
 

@@ -117,6 +117,21 @@ class Histogram(object):
         with self._lock:
             return list(self._buf)
 
+    def recent(self, n):
+        """The last ``min(n, window)`` observations in CHRONOLOGICAL
+        order — the timeseries bucketizer (observe/timeseries.py)
+        digests exactly the values that arrived since its previous
+        tick, which the count delta names and the ring still holds as
+        long as the tick interval outpaces ``window`` observations."""
+        if n <= 0:
+            return []
+        with self._lock:
+            if len(self._buf) < self._window:
+                buf = list(self._buf)
+            else:
+                buf = self._buf[self._pos:] + self._buf[:self._pos]
+        return buf[-n:]
+
     def snapshot(self):
         """{"count","mean","min","max","p50","p95","p99"} — count/mean
         over the lifetime, percentiles over the recent window."""
@@ -163,6 +178,13 @@ class MetricsRegistry(object):
         """The metric if it was ever registered, else None — readers
         (health_snapshot, dashboards) must not create empty metrics."""
         return self._metrics.get(name)
+
+    def items(self):
+        """Stable (name, metric) pairs of the LIVE objects — the
+        timeseries bucketizer needs them (histogram count deltas +
+        ``recent``), not the plain-data snapshot."""
+        with self._lock:
+            return sorted(self._metrics.items())
 
     def snapshot(self):
         """Plain-data view: {"counters": {...}, "gauges": {...},
@@ -294,6 +316,15 @@ _HEALTH_KEYS = (
     ("mesh.epoch", "mesh_epoch"),
     ("mesh.reshards", "mesh_reshards"),
     ("mesh.bytes_moved", "mesh_bytes_moved"),
+    # fleet telemetry plane (observe/timeseries.py + alerts.py):
+    # alert volume rides heartbeats so a post-mortem can line a
+    # latency cliff up against the burn-rate firing that announced
+    # it; the full alert-history ring is alerts.snapshot() on
+    # /healthz and the dashboard
+    ("alerts.fired", "alerts_fired"),
+    ("alerts.active", "alerts_active"),
+    ("telemetry.buckets", "telemetry_buckets"),
+    ("telemetry.chunks_shipped", "telemetry_chunks_shipped"),
 )
 
 

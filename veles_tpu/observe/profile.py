@@ -28,9 +28,14 @@ from veles_tpu.observe.metrics import registry as _registry
 
 __all__ = ["ProfilerHook", "install_profiler", "uninstall_profiler",
            "profiler_step", "Heartbeat", "validate_heartbeat",
-           "HEARTBEAT_SCHEMA_VERSION"]
+           "HEARTBEAT_SCHEMA_VERSION", "HEARTBEAT_SCHEMAS"]
 
-HEARTBEAT_SCHEMA_VERSION = 2
+HEARTBEAT_SCHEMA_VERSION = 3
+
+#: Schemas ``validate_heartbeat`` accepts: v2 files (pre-telemetry)
+#: stay readable by ``observe summary``/``merge`` forever; v3 adds
+#: the ``series`` rollup block and the ``alerts`` block.
+HEARTBEAT_SCHEMAS = (2, 3)
 
 
 class ProfilerHook(object):
@@ -173,8 +178,16 @@ def validate_heartbeat(record):
                              (key, type(record[key]).__name__))
     if record["kind"] != "heartbeat":
         raise ValueError("kind must be 'heartbeat'")
-    if record["schema"] != HEARTBEAT_SCHEMA_VERSION:
+    if record["schema"] not in HEARTBEAT_SCHEMAS:
         raise ValueError("unknown heartbeat schema %r" % record["schema"])
+    if record["schema"] >= 3:
+        # v3: the telemetry-plane blocks are part of the contract
+        for key in ("series", "alerts"):
+            if not isinstance(record.get(key), dict):
+                raise ValueError(
+                    "schema 3 heartbeat needs a %r block" % key)
+        if "schema" not in record["series"]:
+            raise ValueError("series block lacks a schema")
     if "mfu_pct" in record and record["mfu_pct"] is not None and \
             not isinstance(record["mfu_pct"], (int, float)):
         raise ValueError("mfu_pct must be numeric or null")
@@ -242,6 +255,29 @@ class Heartbeat(object):
         if xla is not None:
             record["compile"] = xla.compile_snapshot(self.registry)
             record["mfu_pct"] = mfu
+        # the telemetry plane rides the heartbeat cadence: tick the
+        # process-global series ring against the SAME wall stamp this
+        # line carries, then embed the compact v3 blocks (the full
+        # buckets ship over links, not the JSONL file)
+        try:
+            from veles_tpu.observe.alerts import alerts
+            from veles_tpu.observe.timeseries import series
+            series.maybe_tick(now=now, wall=record["ts"])
+            if alerts.rules:
+                # single-process alerting rides the heartbeat: the
+                # same rules a fleet router sweeps over rollups run
+                # here over the local ring (edge-triggered, so a
+                # persisting breach costs one firing, not one per
+                # heartbeat line)
+                alerts.evaluate(series.buckets(last=32),
+                                wall=record["ts"])
+            record["series"] = series.heartbeat_block()
+            record["alerts"] = alerts.snapshot(history=4)
+        except Exception:
+            record["series"] = {"schema": 0}
+            record["alerts"] = {"schema": 0, "active": [],
+                                "firing": [], "fired_total": 0,
+                                "history": []}
         last_t, last_samples = self._last_sample
         samples = self._samples()
         if now > last_t:

@@ -15,12 +15,31 @@ output when ``VELES_TRACE`` is set.
 import json
 
 __all__ = ["load", "summarize", "summarize_trace", "summarize_flight",
-           "render", "digest_line", "request_digest_line"]
+           "summarize_heartbeats", "render", "digest_line",
+           "request_digest_line"]
 
 
 def load(path):
+    """A trace file, a flight dump, or a heartbeat JSONL file
+    (``--metrics-path`` output) — JSONL is detected by failing the
+    single-document parse and folded into a ``heartbeats`` doc."""
     with open(path) as fin:
-        return json.load(fin)
+        text = fin.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        records = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # a torn final line from a killed process
+        if not records:
+            raise
+        return {"kind": "heartbeats", "records": records}
 
 
 def _self_times(events):
@@ -131,10 +150,66 @@ def summarize_flight(doc, top=10):
             "events": len(doc.get("events", ()))}
 
 
+def summarize_heartbeats(doc, top=10):
+    """Digest a heartbeat JSONL file: schema v2 lines (pre-telemetry)
+    and v3 lines (``series`` + ``alerts`` blocks) side by side —
+    counter RATES derived from consecutive lines' cumulative values,
+    published under the measure.py filter-passes discipline, plus the
+    last line's health and any alerts the file recorded."""
+    from veles_tpu.observe.profile import validate_heartbeat
+    from veles_tpu.tune.measure import (filter_passes,
+                                        positive_majority_median)
+    lines, schemas, invalid = [], {}, 0
+    for record in doc.get("records", ()):
+        try:
+            validate_heartbeat(record)
+        except ValueError:
+            invalid += 1
+            continue
+        lines.append(record)
+        schema = record["schema"]
+        schemas[schema] = schemas.get(schema, 0) + 1
+    samples = {}
+    prev = None
+    for record in lines:
+        if prev is not None and record["ts"] > prev["ts"] and \
+                record["session"] == prev["session"]:
+            dt = record["ts"] - prev["ts"]
+            for name, value in record["counters"].items():
+                delta = value - prev["counters"].get(name, 0)
+                if delta >= 0:  # a reset between lines is not a rate
+                    samples.setdefault(name, []).append(delta / dt)
+        prev = record
+    rates = {}
+    for name, rate_samples in samples.items():
+        med = positive_majority_median(filter_passes(rate_samples))
+        if med is not None:
+            rates[name] = round(med, 3)
+    ranked = sorted(rates.items(), key=lambda kv: -kv[1])[:top]
+    last = lines[-1] if lines else {}
+    alert_names = set()
+    for record in lines:
+        for entry in (record.get("alerts") or {}).get("history", ()):
+            if entry.get("state") == "firing":
+                alert_names.add(entry.get("alert"))
+    return {"kind": "heartbeats", "events": len(lines),
+            "invalid": invalid, "schemas": schemas,
+            "sessions": len({r["session"] for r in lines}),
+            "rates": dict(ranked),
+            "health": last.get("health") or {},
+            "throughput_sps": last.get("throughput_sps"),
+            "series": last.get("series") or {},
+            "alerts_fired": sorted(a for a in alert_names if a),
+            "tracks": {}, "counters": {}, "instants": {}}
+
+
 def summarize(doc, top=10):
-    """Dispatch on document shape: flight dump or trace file."""
+    """Dispatch on document shape: flight dump, heartbeat JSONL, or
+    trace file."""
     if doc.get("kind") == "flight":
         return summarize_flight(doc, top=top)
+    if doc.get("kind") == "heartbeats":
+        return summarize_heartbeats(doc, top=top)
     return summarize_trace(doc, top=top)
 
 
@@ -147,6 +222,29 @@ def render(summary, out=None):
     if summary.get("reason"):
         header += " (reason: %s)" % summary["reason"]
     print(header, file=out)
+    if summary["kind"] == "heartbeats":
+        print("  lines: %d valid (%d invalid), schemas %s, "
+              "%d session(s)"
+              % (summary["events"], summary["invalid"],
+                 ",".join("v%d x%d" % (s, n) for s, n in
+                          sorted(summary["schemas"].items())),
+                 summary["sessions"]), file=out)
+        if summary.get("throughput_sps") is not None:
+            print("  last throughput: %.3f samples/s"
+                  % summary["throughput_sps"], file=out)
+        if summary.get("rates"):
+            print("  steady-state rates (per second):", file=out)
+            for name, rate in sorted(summary["rates"].items()):
+                print("    %-32s %s" % (name, rate), file=out)
+        series = summary.get("series") or {}
+        if series.get("schema"):
+            print("  series ring: %s buckets @ %ss"
+                  % (series.get("buckets_held"),
+                     series.get("interval_s")), file=out)
+        if summary.get("alerts_fired"):
+            print("  alerts fired: %s"
+                  % ", ".join(summary["alerts_fired"]), file=out)
+        return
     for label in sorted(summary["tracks"]):
         rows = summary["tracks"][label]
         if not rows:

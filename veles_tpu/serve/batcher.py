@@ -920,7 +920,16 @@ def serve_snapshot(reg=None):
                         # is the "segments" block below
                         ("serve.reqtrace.sampled", "reqtrace_sampled"),
                         ("serve.reqtrace.exemplars",
-                         "reqtrace_exemplars")):
+                         "reqtrace_exemplars"),
+                        # fleet telemetry plane (docs/observability.md
+                        # "Fleet telemetry"): alert firings + what is
+                        # burning RIGHT NOW next to load; the alert
+                        # history ring is /healthz's "alerts" block
+                        ("alerts.fired", "alerts_fired"),
+                        ("alerts.active", "alerts_active"),
+                        ("telemetry.buckets", "telemetry_buckets"),
+                        ("telemetry.chunks_shipped",
+                         "telemetry_chunks_shipped")):
         metric = reg.peek(name)
         if metric is not None and metric.value is not None:
             out[short] = metric.value

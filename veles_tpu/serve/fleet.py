@@ -197,12 +197,21 @@ class HostLink(object):
     def send_cancel(self, wid):
         self._send({"op": "cancel", "id": wid})
 
-    def start_reader(self, on_result, on_error, on_down):
+    def send_telemetry_poll(self):
+        """One telemetry poll frame (transport ``telemetry`` op):
+        ``t0`` stamps the send so the reply's t1/t2 plus receipt t3
+        close an NTP clock-probe sample.  Thread-safe (the send lock)
+        — the router's watchdog fires it off-reader."""
+        self._send({"op": "telemetry", "id": -2, "t0": time.time()})
+
+    def start_reader(self, on_result, on_error, on_down,
+                     on_telemetry=None):
         """Spawn the reply-dispatch thread: ``on_result(wid, arr,
         msg)`` / ``on_error(wid, exc)`` per answered frame (``msg`` is
         the reply header — carries the host's echoed ``trace``/
-        ``segs``), ``on_down()`` once when the link dies (or
-        closes)."""
+        ``segs``), ``on_down()`` once when the link dies (or closes),
+        ``on_telemetry(msg, t3)`` per telemetry-poll reply (``t3`` is
+        the receipt wall stamp that closes the clock sample)."""
 
         def loop():
             try:
@@ -233,6 +242,12 @@ class HostLink(object):
                             exc = RuntimeError(
                                 msg.get("error", "serve error"))
                         on_error(msg.get("id"), exc)
+                    elif op == "telemetry":
+                        if on_telemetry is not None:
+                            try:
+                                on_telemetry(msg, time.time())
+                            except Exception:
+                                pass  # telemetry never kills a link
                     # pong / unknown: ignore
             except (ConnectionError, OSError, ProtocolError,
                     ValueError):
@@ -425,7 +440,9 @@ class FleetRouter(Logger):
                  hedge_floor_s=0.05, hedge_tick_s=0.02, max_hedges=1,
                  hedge_warmup=8, throughput_alpha=0.2,
                  link_timeout=30.0, keepalive_s=5.0, hedge_budget=None,
-                 max_inflight=None, retry_jitter=None, **kwargs):
+                 max_inflight=None, retry_jitter=None,
+                 telemetry_interval_s=2.0, alert_rules=None,
+                 **kwargs):
         super(FleetRouter, self).__init__(**kwargs)
         self._secret = secret
         self.hedge = bool(hedge)
@@ -484,6 +501,33 @@ class FleetRouter(Logger):
         self._m_latency = _registry.histogram("serve.fleet.latency_s")
         self._g_live.set(0)
         self._g_epoch.set(0)
+        #: the fleet telemetry plane (observe/timeseries.py +
+        #: observe/alerts.py): the watchdog polls every live host's
+        #: link every ``telemetry_interval_s`` (0/None disables), the
+        #: reply's NTP echo feeds the clock offsets, and the router's
+        #: OWN alert manager evaluates ``alert_rules`` (declarative
+        #: specs or AlertRule objects; None = the stock serve set)
+        #: over the offset-corrected rollup after each poll round.
+        self.telemetry_interval_s = float(telemetry_interval_s or 0.0)
+        self.telemetry = None
+        self.alerts = None
+        if self.telemetry_interval_s > 0:
+            from veles_tpu.observe.alerts import (AlertManager,
+                                                  default_rules,
+                                                  rule_from_spec)
+            from veles_tpu.observe.timeseries import FleetTelemetry
+            self.telemetry = FleetTelemetry(
+                interval_s=self.telemetry_interval_s)
+            if alert_rules is None:
+                # fleet scope: the burn rules watch the front's
+                # end-to-end class histograms (the ones that see
+                # transport stalls), not the host serving-edge ones
+                rules = default_rules(scope="fleet")
+            else:
+                rules = [rule_from_spec(r) if isinstance(r, dict)
+                         else r for r in alert_rules]
+            self.alerts = AlertManager(rules)
+        self._last_poll = 0.0
 
     # -- membership ---------------------------------------------------------
 
@@ -530,7 +574,10 @@ class FleetRouter(Logger):
             lambda wid, arr, msg=None: self._on_result(
                 host, wid, arr, msg),
             lambda wid, exc: self._on_error(host, wid, exc),
-            lambda: self._on_link_down(host))
+            lambda: self._on_link_down(host),
+            on_telemetry=(
+                (lambda msg, t3: self._on_telemetry(hid, msg, t3))
+                if self.telemetry is not None else None))
         _tracer.instant("serve.fleet.join", cat="serve", host=hid,
                         epoch=epoch,
                         new_compiles=host.info.get("new_compiles"))
@@ -951,6 +998,14 @@ class FleetRouter(Logger):
         # requeue or hedge re-dispatch must never restart the clock
         entry.latency = now - entry.enqueued
         self._m_latency.observe(entry.latency)
+        # per-class END-TO-END latency under the FLEET name (distinct
+        # from the host batcher's serve.tenant.* serving-edge series,
+        # which an in-process front+host pair would double-count):
+        # this is the digest the fleet-scoped SLO burn rules watch —
+        # it includes transport stalls the batcher clock never sees
+        _registry.histogram(
+            "serve.fleet.%s.latency_s" % entry.slo_class).observe(
+                entry.latency)
         self._latencies.append(entry.latency)
         entry.done.set()
         self._emit_entry(entry, now)
@@ -1125,11 +1180,68 @@ class FleetRouter(Logger):
         entry.error = exc
         entry.done.set()
 
+    # -- telemetry polling --------------------------------------------------
+
+    def _on_telemetry(self, host_id, msg, t3):
+        """One host's telemetry-poll reply (reader thread): the NTP
+        echo closes a clock-probe sample (min-delay estimate, same as
+        trace merging), the carried series chunk lands in the fleet
+        merge, then the alert rules sweep the offset-corrected
+        rollup.  The router's own alert manager is EDGE-triggered —
+        a stall that keeps burning fires once, with the flight +
+        exemplar evidence dump riding the firing."""
+        telemetry = self.telemetry
+        if telemetry is None:
+            return
+        t0, t1, t2 = msg.get("t0"), msg.get("t1"), msg.get("t2")
+        if all(isinstance(t, (int, float)) for t in (t0, t1, t2)):
+            # convention matches cluster.estimate_offset: host_wall +
+            # offset = router_wall
+            telemetry.add_probe(host_id, (t0, t1, t2, t3))
+        chunk = msg.get("series")
+        if chunk:
+            telemetry.add_chunk(host_id, chunk)
+        alerts = self.alerts
+        if alerts is not None:
+            fired = alerts.evaluate(
+                telemetry.rollup(window=64),
+                context={"scope": "fleet", "host": host_id})
+            for record in fired:
+                self.warning("fleet alert %s: %s", record["alert"],
+                             record["reason"])
+
+    def _poll_telemetry(self, now):
+        if self.telemetry is None or \
+                now - self._last_poll < self.telemetry_interval_s:
+            return
+        self._last_poll = now
+        # the router's own process metrics join the merge as host
+        # "front" (offset 0 by construction — it IS the reference
+        # clock); front + host series then roll up in one pass
+        try:
+            from veles_tpu.observe.timeseries import series
+            series.maybe_tick()
+            chunk = series.take_chunk(label="front")
+            if chunk is not None:
+                self.telemetry.add_chunk("front", chunk)
+        except Exception:
+            pass
+        with self._lock:
+            hosts = self._live_hosts()
+        for host in hosts:
+            try:
+                host.link.send_telemetry_poll()
+            except Exception:
+                pass  # a dying link's reader handles the death
+
     # -- hedging watchdog ---------------------------------------------------
 
     def _watch_loop(self):
         while not self._stop_.wait(self.hedge_tick_s):
             now = time.perf_counter()
+            self._poll_telemetry(now)
+            if not self.hedge:
+                continue
             with self._lock:
                 if len(self._live_hosts()) < 2:
                     continue  # nobody to hedge to
@@ -1185,7 +1297,8 @@ class FleetRouter(Logger):
             bool(self._live_hosts())
 
     def start(self):
-        if self.hedge and self._watchdog is None:
+        if (self.hedge or self.telemetry is not None) and \
+                self._watchdog is None:
             self._stop_.clear()
             self._watchdog = threading.Thread(
                 target=self._watch_loop, name="fleet-hedge")
@@ -1395,4 +1508,16 @@ class FleetRouter(Logger):
                     "pairs": self._canary.pairs,
                     "shadow_errors": self._canary.shadow_errors,
                 },
+                "telemetry": None if self.telemetry is None
+                else self.telemetry.snapshot(),
+                "alerts": None if self.alerts is None
+                else self.alerts.snapshot(),
             }
+
+    def fleet_rollup(self, window=None):
+        """Offset-corrected fleet rollup buckets (empty when
+        telemetry is off) — the ``observe fleet`` CLI's live
+        counterpart."""
+        if self.telemetry is None:
+            return []
+        return self.telemetry.rollup(window=window)

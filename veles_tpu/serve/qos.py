@@ -44,7 +44,7 @@ __all__ = [
     "class_rank", "TokenBucket", "TenantQuota", "parse_quota_spec",
     "RetryJitter", "HedgeBudget", "note_request", "note_shed",
     "note_latency", "tenant_snapshot", "DEFAULT_SLO_BUDGETS_S",
-    "slo_budget_s",
+    "slo_budget_s", "burn_rule_specs",
 ]
 
 #: SLO classes, most- to least-important.  The taxonomy mirrors the
@@ -259,6 +259,39 @@ def slo_budget_s(slo_class, budgets=None):
     class has no budget configured)."""
     budgets = DEFAULT_SLO_BUDGETS_S if budgets is None else budgets
     return budgets.get(normalize_class(slo_class))
+
+
+def burn_rule_specs(budgets=None, objective=0.99, fast_buckets=3,
+                    slow_buckets=12, factor=2.0, min_count=20,
+                    scope="tenant"):
+    """Declarative multi-window burn-rate rule specs, one per class
+    with a configured budget — the bridge from the QoS budget table
+    to the alert plane (observe/alerts.py ``rule_from_spec``): each
+    watches the class's ``serve.<scope>.<class>.latency_s`` digest
+    series and fires only when the fast AND slow windows both burn
+    the ``1 - objective`` error budget at >= ``factor``.
+
+    ``scope="tenant"`` (default) watches the HOST batcher's serving-
+    edge histograms (``note_latency``); ``scope="fleet"`` watches the
+    fleet front's end-to-end histograms — the ones that see transport
+    stalls and straggler tails the serving edge never measures (a
+    stalled frame parks BEFORE the batcher clock starts)."""
+    budgets = DEFAULT_SLO_BUDGETS_S if budgets is None else budgets
+    specs = []
+    for cls in SLO_CLASSES:
+        budget = budgets.get(cls)
+        if budget is None:
+            continue
+        name = ("slo_burn.%s" % cls if scope == "tenant"
+                else "slo_burn.%s.%s" % (scope, cls))
+        specs.append({
+            "name": name, "kind": "burn_rate",
+            "hist": "serve.%s.%s.latency_s" % (scope, cls),
+            "budget_s": float(budget), "objective": objective,
+            "fast_buckets": fast_buckets,
+            "slow_buckets": slow_buckets, "factor": factor,
+            "min_count": min_count})
+    return specs
 
 
 #: Default per-class hedge budgets (tokens/second, burst).  Interactive

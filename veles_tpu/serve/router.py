@@ -775,4 +775,11 @@ class ReplicaPool(Logger):
         }
         if self.cutover.state != "idle":
             out["canary"] = self.cutover.snapshot()
+        # single-host serving evaluates the process-global alert
+        # manager (heartbeat cadence — observe/profile.py); surface
+        # what is burning next to the queue depths it burns about
+        from veles_tpu.observe.alerts import alerts
+        active = alerts.active()
+        if alerts.rules or active:
+            out["alerts_active"] = sorted(r["alert"] for r in active)
         return out

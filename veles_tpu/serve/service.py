@@ -352,6 +352,16 @@ class ServeService(Logger):
                     health["last_reload"] = svc.last_reload
                 if svc.freshness is not None:
                     health["freshness"] = svc.freshness.snapshot()
+                # the alert-history ring (observe/alerts.py): a
+                # fleet front reports its router's OWN manager (the
+                # one sweeping fleet rollups); everything else the
+                # process-global one
+                manager = getattr(svc.router, "alerts", None) \
+                    if svc.router is not None else None
+                if manager is None:
+                    from veles_tpu.observe.alerts import alerts \
+                        as manager
+                health["alerts"] = manager.snapshot()
                 self.write(health)
 
         class MetricsHandler(RequestTimer, tornado.web.RequestHandler):

@@ -492,6 +492,11 @@ class BinaryTransportServer(Logger):
                                 secret=self._secret)
                     await writer.drain()
                     continue
+                if op == "telemetry":
+                    write_frame(writer, self._telemetry_reply(msg),
+                                secret=self._secret)
+                    await writer.drain()
+                    continue
                 if op != "infer":
                     raise ProtocolError("unknown op %r" % op)
                 # in-order per connection: the reply goes out before
@@ -568,6 +573,12 @@ class BinaryTransportServer(Logger):
                     if scope is not None:
                         scope.cancel()
                     continue
+                if op == "telemetry":
+                    async with write_lock:
+                        write_frame(writer, self._telemetry_reply(msg),
+                                    secret=self._secret)
+                        await writer.drain()
+                    continue
                 if op != "infer":
                     raise ProtocolError("unknown op %r" % op)
                 scope = inflight[msg.get("id")] = _InflightScope()
@@ -579,6 +590,29 @@ class BinaryTransportServer(Logger):
                 scope.cancel()
             for task in list(tasks):
                 task.cancel()
+
+    def _telemetry_reply(self, msg):
+        """One telemetry poll answered in-line: NTP echo timestamps
+        (the poller's t0 comes back with our t1/t2, so the router's
+        t3 closes a clock-probe sample — telemetry polls double as
+        the fleet's clock sync) plus the series buckets NEW since the
+        last poll, straight in the JSON frame.  Ticks the process
+        ring first so a serve host needs no Heartbeat to bucketize.
+        A telemetry failure costs the buckets, never the link."""
+        now = time.time()
+        reply = {"op": "telemetry", "id": msg.get("id"),
+                 "t0": msg.get("t0"), "t1": now, "t2": now}
+        host_id = self.host_meta.get("host_id") \
+            if self.host_meta else None
+        if host_id is not None:
+            reply["host"] = host_id
+        try:
+            from veles_tpu.observe.timeseries import series
+            series.maybe_tick()
+            reply["series"] = series.take_chunk(label=host_id)
+        except Exception:
+            reply["series"] = None
+        return reply
 
     def _fire_host_chaos(self):
         """The fleet-host fault surface (docs/health.md table), fired

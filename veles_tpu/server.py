@@ -59,6 +59,7 @@ from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.observe.cluster import TraceCollector
+from veles_tpu.observe.timeseries import FleetTelemetry
 from veles_tpu.observe.flight import flight as _flight
 from veles_tpu.observe.metrics import registry as _registry
 from veles_tpu.observe.trace import tracer as _tracer
@@ -228,6 +229,9 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
         #: shipped slave trace chunks + per-slave clock offsets
         #: (docs/observability.md, distributed tracing)
         self.trace_collector = TraceCollector()
+        # master-side half of the fleet telemetry plane: per-slave
+        # series chunks merged with the trace-merge clock offsets
+        self.fleet_telemetry = FleetTelemetry()
         self.quarantined = 0
         self.slaves = {}
         self._waiting = deque()     # parked requesters (sync points)
@@ -483,6 +487,12 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                 # additive correction merge_parts applies
                 self.trace_collector.set_offset(
                     conn.slave.mid, float(offset), msg.get("delay"))
+                # the fleet telemetry merge corrects with the SAME
+                # estimate trace merging uses: "slave:" prefix matches
+                # the label series chunks arrive under
+                self.fleet_telemetry.set_offset(
+                    "slave:" + conn.slave.mid, float(offset),
+                    msg.get("delay"))
                 self.debug("slave %s clock offset %.6fs (delay %.6fs)",
                            conn.slave.id[:8], offset,
                            msg.get("delay") or -1.0)
@@ -495,6 +505,19 @@ class Server(Logger, metaclass=CommandLineArgumentsRegistry):
                              type(exc).__name__, exc)
             else:
                 self.trace_collector.add_chunk(conn.slave.mid, chunk)
+        elif mtype == "series_chunk":
+            # telemetry buckets ride the same inline path as trace
+            # chunks and get the same validate-and-drop discipline: a
+            # malformed chunk costs the chunk, never the session
+            try:
+                chunk = unpack_payload(payload, msg.get("codec", "none"))
+            except Exception as exc:
+                self.warning("undecodable series chunk from slave %s "
+                             "dropped (%s: %s)", conn.slave.id[:8],
+                             type(exc).__name__, exc)
+            else:
+                self.fleet_telemetry.add_chunk(
+                    "slave:" + conn.slave.mid, chunk)
         return conn
 
     def _blacklist(self, mid):

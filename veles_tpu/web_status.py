@@ -406,22 +406,31 @@ class WebStatusServer(Logger):
                         name: row.get("p99_ms")
                         for name, row in segments.items()}
                     return json.dumps(value)
+                if k == "alerts" and isinstance(value, dict):
+                    # the alerts column answers "is anything burning"
+                    # at a glance: active alert names, or the firing
+                    # total when everything has resolved
+                    active = value.get("active") or []
+                    if active:
+                        return "FIRING: " + ", ".join(active)
+                    fired = value.get("fired_total") or 0
+                    return "ok (%d fired)" % fired if fired else "ok"
                 if k in ("metrics", "health", "serve", "fleet"):
                     return json.dumps(value)
                 return str(value)
             cells = "".join(
                 "<td>%s</td>" % html.escape(cell(k))
                 for k in ("workflow", "mode", "epoch", "metrics",
-                          "health", "serve", "fleet", "slaves",
-                          "updated"))
+                          "health", "serve", "fleet", "alerts",
+                          "slaves", "updated"))
             rows.append(
                 "<tr><td><a href='/session/%s'>%s</a></td>%s<td>%s</td>"
                 "</tr>" % (quote(sid, safe=""),
                            html.escape(sid), cells, spark))
         return ("<table><tr><th>id</th><th>workflow</th><th>mode</th>"
                 "<th>epoch</th><th>metrics</th><th>health</th>"
-                "<th>serve</th><th>fleet</th><th>slaves</th>"
-                "<th>updated</th><th>trend</th></tr>"
+                "<th>serve</th><th>fleet</th><th>alerts</th>"
+                "<th>slaves</th><th>updated</th><th>trend</th></tr>"
                 "%s</table>"
                 % "\n".join(rows))
 
@@ -501,7 +510,21 @@ class StatusReporter(object):
             # train state moved, and the reshard-latency histogram —
             # only on masters training through a MeshManager
             "mesh": mesh_snapshot() or None,
+            # the alert plane (docs/observability.md "Fleet
+            # telemetry"): active + recently-fired alerts from the
+            # process-global manager — the dashboard's alerts column
+            "alerts": self._alerts_block(),
         }
+
+    @staticmethod
+    def _alerts_block():
+        try:
+            from veles_tpu.observe.alerts import alerts
+            if not alerts.rules and not alerts.history(last=1):
+                return None  # nothing configured, nothing ever fired
+            return alerts.snapshot(history=4)
+        except Exception:
+            return None
 
     def _post_json(self, path, payload):
         import urllib.request
